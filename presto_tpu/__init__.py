@@ -1,0 +1,59 @@
+"""presto_tpu — a TPU-native distributed SQL query engine.
+
+A from-scratch rebuild of the capabilities of the reference MPP SQL engine
+(Trino ~v360, see /root/reference) designed TPU-first:
+
+- Columnar data lives in HBM as struct-of-arrays JAX arrays with validity
+  masks (the analog of trino-spi's Page/Block, reference
+  core/trino-spi/src/main/java/io/trino/spi/Page.java:33).
+- Row expressions compile to jitted XLA kernels instead of JVM bytecode
+  (reference core/trino-main/.../sql/gen/ExpressionCompiler.java).
+- Group-by / join hash tables are static-shape scatter/gather kernels on
+  device (reference operator/MultiChannelGroupByHash.java:55,
+  operator/join/PagesHash.java:35).
+- Distribution is a jax.sharding.Mesh + shard_map: hash repartition is an
+  all_to_all over ICI, broadcast join build sides are all_gathers, and
+  partial->final aggregation is the psum-tree analog of Trino's
+  partial aggregation (reference sql/planner/optimizations/AddExchanges.java).
+
+Static shapes everywhere: filters carry selection masks instead of
+compacting, hash tables have planner-chosen capacities with host-side
+retry on overflow, and exchanges pad to fixed per-partition capacities.
+"""
+
+import jax
+
+# SQL semantics need 64-bit integers (BIGINT, scaled DECIMAL) and float64.
+# This must run before any array is materialised.
+jax.config.update("jax_enable_x64", True)
+
+from presto_tpu.types import (  # noqa: E402
+    BIGINT,
+    BOOLEAN,
+    DATE,
+    DOUBLE,
+    INTEGER,
+    VARCHAR,
+    DecimalType,
+    DataType,
+)
+from presto_tpu.block import Column, Table  # noqa: E402
+from presto_tpu.session import Session  # noqa: E402
+from presto_tpu.engine import Engine  # noqa: E402
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "BIGINT",
+    "BOOLEAN",
+    "DATE",
+    "DOUBLE",
+    "INTEGER",
+    "VARCHAR",
+    "DecimalType",
+    "DataType",
+    "Column",
+    "Table",
+    "Session",
+    "Engine",
+]

@@ -1,0 +1,159 @@
+"""Columnar data model: Column and Table.
+
+The analog of the reference's Page/Block
+(core/trino-spi/src/main/java/io/trino/spi/Page.java:33,
+spi/block/Block.java:25). Differences, chosen for TPU execution:
+
+- Struct-of-arrays: a Table is an ordered map of name -> Column where each
+  column's values are one flat device array in HBM.
+- Static shapes: instead of compacting after a filter (dynamic output
+  cardinality breaks XLA), a Table carries a boolean selection ``mask``.
+  Downstream kernels treat masked-off rows as absent. This replaces the
+  reference's positions list in PageProcessor
+  (operator/project/PageProcessor.java:54).
+- Null handling: each Column may carry a ``valid`` bitmap (True = non-null),
+  the analog of Block.isNull.
+- Strings are dictionary codes (spi/block/DictionaryBlock.java:35 precedent)
+  with the **sorted** host-side dictionary, so code order == collation order
+  and device-side <, min, max, sort on codes are correct for any single
+  dictionary.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterable, Mapping
+
+import numpy as np
+
+from presto_tpu import types as T
+
+
+@dataclasses.dataclass
+class Column:
+    dtype: T.DataType
+    data: object  # jnp.ndarray | np.ndarray, shape [N] physical values
+    valid: object | None = None  # bool[N]; None means all valid
+    dictionary: np.ndarray | None = None  # host-side str array for VARCHAR
+
+    def __len__(self) -> int:
+        return int(self.data.shape[0])
+
+    def with_data(self, data, valid=...) -> "Column":
+        return Column(
+            self.dtype,
+            data,
+            self.valid if valid is ... else valid,
+            self.dictionary,
+        )
+
+
+def dictionary_encode(values: Iterable[str]) -> tuple[np.ndarray, np.ndarray]:
+    """Encode strings to (codes int32, sorted dictionary).
+
+    The dictionary is sorted so that code comparisons implement string
+    collation on device.
+    """
+    arr = np.asarray(values, dtype=object)
+    # np.unique on object arrays sorts lexicographically.
+    dictionary, codes = np.unique(arr.astype("U"), return_inverse=True)
+    return codes.astype(np.int32), dictionary.astype(object)
+
+
+def column_from_numpy(
+    dtype: T.DataType, values: np.ndarray, valid: np.ndarray | None = None
+) -> Column:
+    """Build a Column from host values. Strings are dictionary-encoded;
+    decimals must already be scaled integers."""
+    if isinstance(dtype, T.VarcharType):
+        codes, dictionary = dictionary_encode(values)
+        return Column(dtype, codes, valid, dictionary)
+    return Column(dtype, np.asarray(values, dtype=dtype.physical_dtype), valid)
+
+
+@dataclasses.dataclass
+class Table:
+    """An ordered collection of equal-length Columns plus a selection mask.
+
+    ``nrows`` is the physical array length; ``mask`` (bool[nrows] or None)
+    selects the live rows. ``None`` means all rows live.
+    """
+
+    columns: dict[str, Column]
+    nrows: int
+    mask: object | None = None
+
+    def column(self, name: str) -> Column:
+        return self.columns[name]
+
+    @property
+    def names(self) -> list[str]:
+        return list(self.columns.keys())
+
+    def with_mask(self, mask) -> "Table":
+        return Table(dict(self.columns), self.nrows, mask)
+
+    def select(self, names: list[str]) -> "Table":
+        return Table({n: self.columns[n] for n in names}, self.nrows, self.mask)
+
+    @staticmethod
+    def from_numpy(
+        schema: Mapping[str, T.DataType], data: Mapping[str, np.ndarray]
+    ) -> "Table":
+        cols = {}
+        n = None
+        for name, dtype in schema.items():
+            col = column_from_numpy(dtype, data[name])
+            if n is None:
+                n = len(col)
+            elif len(col) != n:
+                raise ValueError(f"column {name} length mismatch")
+            cols[name] = col
+        return Table(cols, n or 0)
+
+    # ---- host-side result extraction -------------------------------------
+
+    def to_pylist(self) -> list[tuple]:
+        """Decode live rows to Python tuples (host side, for results/tests)."""
+        mask = None if self.mask is None else np.asarray(self.mask)
+        decoded = []
+        valids = []
+        for col in self.columns.values():
+            data = np.asarray(col.data)
+            valid = None if col.valid is None else np.asarray(col.valid)
+            decoded.append(_decode_column(col.dtype, data, col.dictionary))
+            valids.append(valid)
+        rows = []
+        for i in range(self.nrows):
+            if mask is not None and not mask[i]:
+                continue
+            rows.append(
+                tuple(
+                    None
+                    if valids[j] is not None and not valids[j][i]
+                    else decoded[j][i]
+                    for j in range(len(decoded))
+                )
+            )
+        return rows
+
+
+def _decode_column(dtype: T.DataType, data: np.ndarray, dictionary):
+    if isinstance(dtype, T.VarcharType):
+        if not len(dictionary):
+            return np.full(len(data), "", object)
+        safe = np.clip(data, 0, len(dictionary) - 1)
+        out = dictionary[safe]
+        # Out-of-range codes (e.g. -1 padding from outer-join fill) -> "".
+        out = np.where((data < 0) | (data >= len(dictionary)), "", out)
+        return out
+    if isinstance(dtype, T.DecimalType):
+        return data.astype(np.float64) / dtype.unscale_factor
+    if isinstance(dtype, T.DateType):
+        epoch = np.datetime64("1970-01-01")
+        return (epoch + data.astype("timedelta64[D]")).astype("datetime64[D]")
+    if isinstance(dtype, T.BooleanType):
+        return data.astype(bool)
+    if isinstance(dtype, T.DoubleType):
+        return data.astype(np.float64)
+    return data

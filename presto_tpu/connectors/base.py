@@ -1,0 +1,41 @@
+"""Connector SPI.
+
+Minimal analog of the reference's connector contract
+(spi/connector/ConnectorMetadata.java, ConnectorSplitManager,
+ConnectorPageSourceProvider). v1 exposes whole tables as columnar batches;
+split-granular streaming arrives with the block-streaming executor.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Mapping
+
+from presto_tpu import types as T
+from presto_tpu.block import Table
+
+
+@dataclasses.dataclass
+class TableStats:
+    """Planner statistics, analog of spi/statistics/TableStatistics."""
+
+    row_count: int
+    # per-column distinct-value estimates (used to size hash tables)
+    ndv: dict[str, int] = dataclasses.field(default_factory=dict)
+
+
+class Connector:
+    name: str = "connector"
+
+    def table_names(self) -> list[str]:
+        raise NotImplementedError
+
+    def table_schema(self, name: str) -> Mapping[str, T.DataType]:
+        raise NotImplementedError
+
+    def table(self, name: str) -> Table:
+        """Materialise the full table (host-side arrays)."""
+        raise NotImplementedError
+
+    def stats(self, name: str) -> TableStats:
+        raise NotImplementedError

@@ -1,0 +1,58 @@
+"""In-memory table connector.
+
+Analog of the reference's plugin/trino-memory (MemoryPagesStore): tables
+created/inserted at runtime, stored as host numpy columns.
+"""
+
+from __future__ import annotations
+
+from typing import Mapping
+
+import numpy as np
+
+from presto_tpu import types as T
+from presto_tpu.block import Table
+from presto_tpu.connectors.base import Connector, TableStats
+
+
+class MemoryConnector(Connector):
+    name = "memory"
+
+    def __init__(self) -> None:
+        self._schemas: dict[str, dict[str, T.DataType]] = {}
+        self._data: dict[str, dict[str, np.ndarray]] = {}
+
+    def create_table(
+        self, name: str, schema: Mapping[str, T.DataType],
+        data: Mapping[str, np.ndarray] | None = None,
+    ) -> None:
+        self._schemas[name] = dict(schema)
+        if data is None:
+            data = {c: np.empty(0, dtype=object if isinstance(t, T.VarcharType)
+                                else t.physical_dtype)
+                    for c, t in schema.items()}
+        self._data[name] = {c: np.asarray(v, dtype=object if isinstance(
+            self._schemas[name][c], T.VarcharType) else None)
+            for c, v in data.items()}
+
+    def insert(self, name: str, data: Mapping[str, np.ndarray]) -> None:
+        for c in self._schemas[name]:
+            self._data[name][c] = np.concatenate(
+                [self._data[name][c], np.asarray(data[c])])
+
+    def drop_table(self, name: str) -> None:
+        self._schemas.pop(name, None)
+        self._data.pop(name, None)
+
+    def table_names(self) -> list[str]:
+        return list(self._schemas)
+
+    def table_schema(self, name: str):
+        return self._schemas[name]
+
+    def table(self, name: str) -> Table:
+        return Table.from_numpy(self._schemas[name], self._data[name])
+
+    def stats(self, name: str) -> TableStats:
+        n = len(next(iter(self._data[name].values()))) if self._data[name] else 0
+        return TableStats(row_count=n)

@@ -1,0 +1,52 @@
+"""Engine: the in-process query runner.
+
+Analog of the reference's LocalQueryRunner
+(core/trino-main/src/main/java/io/trino/testing/LocalQueryRunner.java:227):
+parse -> analyze -> logical plan -> optimize -> fragment -> compile jitted
+kernels -> execute, all in one process. The distributed path executes
+fragments under shard_map over a jax Mesh instead of HTTP remote tasks.
+"""
+
+from __future__ import annotations
+
+from presto_tpu.block import Table
+from presto_tpu.connectors.base import Connector
+from presto_tpu.session import Session
+
+
+class Engine:
+    def __init__(self, session: Session | None = None):
+        self.session = session or Session()
+        self.catalogs: dict[str, Connector] = {}
+
+    def register_catalog(self, name: str, connector: Connector) -> None:
+        self.catalogs[name] = connector
+
+    # -- SQL entry points ---------------------------------------------------
+
+    def execute(self, sql: str) -> list[tuple]:
+        """Run SQL, return result rows as Python tuples."""
+        result = self.execute_table(sql)
+        return result.to_pylist()
+
+    def execute_table(self, sql: str) -> Table:
+        from presto_tpu.exec.executor import execute_plan
+        plan, _ = self.plan_sql(sql)
+        return execute_plan(self, plan)
+
+    def plan_sql(self, sql: str):
+        from presto_tpu.sql.parser import parse_statement
+        from presto_tpu.sql.analyzer import Analyzer
+        from presto_tpu.plan.planner import LogicalPlanner
+        from presto_tpu.plan.optimizer import optimize
+
+        stmt = parse_statement(sql)
+        analysis = Analyzer(self).analyze(stmt)
+        plan = LogicalPlanner(self, analysis).plan(stmt)
+        plan = optimize(plan, self)
+        return plan, analysis
+
+    def explain(self, sql: str) -> str:
+        from presto_tpu.plan.printer import format_plan
+        plan, _ = self.plan_sql(sql)
+        return format_plan(plan)

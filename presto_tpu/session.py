@@ -1,0 +1,60 @@
+"""Session: per-query configuration.
+
+Analog of the reference's Session + SystemSessionProperties
+(core/trino-main/src/main/java/io/trino/Session.java,
+SystemSessionProperties.java — 163 properties). Properties here control the
+TPU execution strategy instead of JVM task knobs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+
+# name -> (default, type, description)
+SYSTEM_SESSION_PROPERTIES: dict[str, tuple[Any, type, str]] = {
+    "block_rows": (1 << 20, int,
+                   "physical row-block granularity tables are padded to"),
+    "groupby_table_size": (0, int,
+                           "hash-table capacity override for group-by "
+                           "(0 = derive from stats)"),
+    "join_table_fill": (0.5, float,
+                        "target fill factor for join hash tables"),
+    "join_distribution_type": ("AUTOMATIC", str,
+                               "AUTOMATIC | BROADCAST | PARTITIONED"),
+    "broadcast_join_threshold_rows": (4_000_000, int,
+                                      "max build rows for broadcast joins"),
+    "max_hash_probes": (64, int,
+                        "bound on linear-probe steps in hash kernels"),
+    "data_parallel_shards": (1, int,
+                             "number of mesh shards for data-parallel scan"),
+    "enable_dynamic_filtering": (True, bool,
+                                 "build-side min/max filters onto probe scans"),
+    "partial_aggregation": (True, bool,
+                            "partial->final aggregation across shards"),
+}
+
+
+@dataclasses.dataclass
+class Session:
+    """Per-query session. ``catalog`` names the default connector."""
+
+    catalog: str = "tpch"
+    user: str = "presto"
+    properties: dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    def get(self, name: str) -> Any:
+        if name in self.properties:
+            return self.properties[name]
+        if name not in SYSTEM_SESSION_PROPERTIES:
+            raise KeyError(f"unknown session property: {name}")
+        return SYSTEM_SESSION_PROPERTIES[name][0]
+
+    def set(self, name: str, value: Any) -> None:
+        if name not in SYSTEM_SESSION_PROPERTIES:
+            raise KeyError(f"unknown session property: {name}")
+        default, typ, _ = SYSTEM_SESSION_PROPERTIES[name]
+        if typ is bool and isinstance(value, str):
+            value = value.lower() in ("true", "1", "on")
+        self.properties[name] = typ(value)

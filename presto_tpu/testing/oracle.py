@@ -1,0 +1,130 @@
+"""sqlite-backed correctness oracle.
+
+Loads the same connector data into an in-memory sqlite database and runs a
+sqlite-dialect rendering of each query; results are compared as (optionally
+ordered) multisets with numeric tolerance. This mirrors the reference's
+H2QueryRunner-based assertQuery flow
+(testing/trino-testing/src/main/java/io/trino/testing/H2QueryRunner.java:90).
+
+Encoding into sqlite: DECIMAL -> REAL (unscaled), DATE -> TEXT ISO-8601
+(lexicographic order == date order), VARCHAR -> TEXT.
+"""
+
+from __future__ import annotations
+
+import math
+import sqlite3
+
+import numpy as np
+
+from presto_tpu import types as T
+from presto_tpu.connectors.base import Connector
+
+
+class SqliteOracle:
+    def __init__(self) -> None:
+        self.conn = sqlite3.connect(":memory:")
+
+    def load_connector(self, connector: Connector) -> None:
+        for name in connector.table_names():
+            schema = connector.table_schema(name)
+            cols = ", ".join(f"{c} {_sqlite_type(t)}" for c, t in schema.items())
+            self.conn.execute(f"CREATE TABLE {name} ({cols})")
+            tbl = connector.table(name)
+            arrays = []
+            for cname, dtype in schema.items():
+                col = tbl.columns[cname]
+                data = np.asarray(col.data)
+                if isinstance(dtype, T.VarcharType):
+                    arrays.append([str(x) for x in col.dictionary[data]]
+                                  if len(col.dictionary) else [""] * len(data))
+                elif isinstance(dtype, T.DecimalType):
+                    arrays.append(
+                        (data.astype(np.float64) / dtype.unscale_factor).tolist())
+                elif isinstance(dtype, T.DateType):
+                    epoch = np.datetime64("1970-01-01")
+                    arrays.append(
+                        [str(d) for d in (epoch + data.astype("timedelta64[D]"))])
+                else:
+                    arrays.append(data.tolist())
+            rows = list(zip(*arrays)) if arrays else []
+            ph = ", ".join("?" for _ in schema)
+            self.conn.executemany(f"INSERT INTO {name} VALUES ({ph})", rows)
+        self.conn.commit()
+
+    def query(self, sql: str) -> list[tuple]:
+        return [tuple(r) for r in self.conn.execute(sql).fetchall()]
+
+
+def _sqlite_type(t: T.DataType) -> str:
+    if isinstance(t, (T.BigintType, T.IntegerType)):
+        return "INTEGER"
+    if isinstance(t, (T.DoubleType, T.DecimalType)):
+        return "REAL"
+    return "TEXT"
+
+
+def normalize_value(v):
+    if v is None:
+        return None
+    if isinstance(v, (np.integer,)):
+        return int(v)
+    if isinstance(v, (np.floating,)):
+        return float(v)
+    if isinstance(v, np.datetime64):
+        return str(v)
+    if isinstance(v, np.str_):
+        return str(v)
+    if isinstance(v, bool):
+        return int(v)
+    if isinstance(v, np.bool_):
+        return int(v)
+    return v
+
+
+def values_equal(a, b, rel: float = 1e-6, absol: float = 1e-6) -> bool:
+    a, b = normalize_value(a), normalize_value(b)
+    if a is None or b is None:
+        return a is None and b is None
+    if isinstance(a, float) or isinstance(b, float):
+        try:
+            return math.isclose(float(a), float(b), rel_tol=rel, abs_tol=absol)
+        except (TypeError, ValueError):
+            return False
+    return a == b
+
+
+def rows_equal(got: list[tuple], want: list[tuple], ordered: bool) -> tuple[bool, str]:
+    if len(got) != len(want):
+        return False, f"row count {len(got)} != expected {len(want)}"
+    g, w = list(got), list(want)
+    if not ordered:
+        key = lambda r: tuple(
+            (x is None, str(normalize_value(x))) for x in r)
+        g, w = sorted(g, key=key), sorted(w, key=key)
+    for i, (rg, rw) in enumerate(zip(g, w)):
+        if len(rg) != len(rw):
+            return False, f"row {i} width {len(rg)} != {len(rw)}"
+        for j, (x, y) in enumerate(zip(rg, rw)):
+            if not values_equal(x, y):
+                return False, (f"row {i} col {j}: got {x!r} want {y!r}\n"
+                               f"  got row:  {rg}\n  want row: {rw}")
+    return True, ""
+
+
+def assert_query(engine, oracle: SqliteOracle, sql: str,
+                 sqlite_sql: str | None = None, ordered: bool | None = None):
+    """Run ``sql`` on the engine and its sqlite rendering on the oracle;
+    assert equal results. ``ordered`` defaults to whether the query has a
+    top-level ORDER BY."""
+    if sqlite_sql is None:
+        from presto_tpu.sql.sqlite_dialect import to_sqlite
+        from presto_tpu.sql.parser import parse_statement
+        stmt = parse_statement(sql)
+        sqlite_sql = to_sqlite(stmt)
+    if ordered is None:
+        ordered = "order by" in sql.lower()
+    got = engine.execute(sql)
+    want = oracle.query(sqlite_sql)
+    ok, msg = rows_equal(got, want, ordered)
+    assert ok, f"query mismatch: {msg}\n  sql: {sql}\n  sqlite: {sqlite_sql}"

@@ -1,0 +1,210 @@
+"""SQL type system.
+
+The analog of the reference's spi/type package
+(core/trino-spi/src/main/java/io/trino/spi/type, 50 files). Each SQL type
+maps to a fixed-width physical dtype so every value can live in a TPU HBM
+array:
+
+- BIGINT/INTEGER -> int64/int32
+- DOUBLE         -> float64
+- BOOLEAN        -> bool
+- DATE           -> int32 days since 1970-01-01
+- DECIMAL(p, s)  -> int64 scaled by 10**s (reference spi/type/DecimalType
+                    uses int64 for short decimals the same way)
+- VARCHAR/CHAR   -> int32 dictionary codes; the byte strings live host-side
+                    in the column dictionary (reference
+                    spi/block/DictionaryBlock.java:35 is the precedent for
+                    dictionary-encoded execution)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class DataType:
+    """Base class for SQL types. Instances are immutable and hashable."""
+
+    name: str
+
+    def __str__(self) -> str:  # pragma: no cover - debug repr
+        return self.name
+
+    @property
+    def physical_dtype(self) -> np.dtype:
+        raise NotImplementedError
+
+    # Orderable in SQL ORDER BY / comparisons.
+    comparable: bool = dataclasses.field(default=True, init=False)
+
+
+@dataclasses.dataclass(frozen=True)
+class BigintType(DataType):
+    def __init__(self) -> None:
+        super().__init__("bigint")
+
+    @property
+    def physical_dtype(self) -> np.dtype:
+        return np.dtype(np.int64)
+
+
+@dataclasses.dataclass(frozen=True)
+class IntegerType(DataType):
+    def __init__(self) -> None:
+        super().__init__("integer")
+
+    @property
+    def physical_dtype(self) -> np.dtype:
+        return np.dtype(np.int32)
+
+
+@dataclasses.dataclass(frozen=True)
+class DoubleType(DataType):
+    def __init__(self) -> None:
+        super().__init__("double")
+
+    @property
+    def physical_dtype(self) -> np.dtype:
+        return np.dtype(np.float64)
+
+
+@dataclasses.dataclass(frozen=True)
+class BooleanType(DataType):
+    def __init__(self) -> None:
+        super().__init__("boolean")
+
+    @property
+    def physical_dtype(self) -> np.dtype:
+        return np.dtype(np.bool_)
+
+
+@dataclasses.dataclass(frozen=True)
+class DateType(DataType):
+    """Days since the 1970-01-01 epoch, int32."""
+
+    def __init__(self) -> None:
+        super().__init__("date")
+
+    @property
+    def physical_dtype(self) -> np.dtype:
+        return np.dtype(np.int32)
+
+
+@dataclasses.dataclass(frozen=True)
+class DecimalType(DataType):
+    """Short decimal: int64 scaled by 10**scale.
+
+    Matches reference semantics for precision <= 18
+    (spi/type/DecimalType.java); long decimals (>18) are not supported yet.
+    """
+
+    precision: int = 38
+    scale: int = 0
+
+    def __init__(self, precision: int, scale: int) -> None:
+        if precision > 18:
+            raise ValueError(
+                f"decimal({precision},{scale}): only short decimals "
+                "(precision <= 18) are supported"
+            )
+        object.__setattr__(self, "precision", precision)
+        object.__setattr__(self, "scale", scale)
+        super().__init__(f"decimal({precision},{scale})")
+
+    @property
+    def physical_dtype(self) -> np.dtype:
+        return np.dtype(np.int64)
+
+    @property
+    def unscale_factor(self) -> int:
+        return 10**self.scale
+
+
+@dataclasses.dataclass(frozen=True)
+class VarcharType(DataType):
+    """Dictionary-encoded string. Physical value is an int32 code indexing
+    the column's host-side dictionary; code -1 is reserved for padding."""
+
+    length: int | None = None
+
+    def __init__(self, length: int | None = None) -> None:
+        object.__setattr__(self, "length", length)
+        super().__init__("varchar" if length is None else f"varchar({length})")
+
+    @property
+    def physical_dtype(self) -> np.dtype:
+        return np.dtype(np.int32)
+
+
+@dataclasses.dataclass(frozen=True)
+class UnknownType(DataType):
+    """Type of NULL literals before coercion."""
+
+    def __init__(self) -> None:
+        super().__init__("unknown")
+
+    @property
+    def physical_dtype(self) -> np.dtype:
+        return np.dtype(np.int64)
+
+
+BIGINT = BigintType()
+INTEGER = IntegerType()
+DOUBLE = DoubleType()
+BOOLEAN = BooleanType()
+DATE = DateType()
+VARCHAR = VarcharType()
+UNKNOWN = UnknownType()
+
+
+def is_numeric(t: DataType) -> bool:
+    return isinstance(t, (BigintType, IntegerType, DoubleType, DecimalType))
+
+
+def is_integer_like(t: DataType) -> bool:
+    return isinstance(t, (BigintType, IntegerType))
+
+
+def is_string(t: DataType) -> bool:
+    return isinstance(t, VarcharType)
+
+
+def common_super_type(a: DataType, b: DataType) -> DataType:
+    """Implicit-coercion lattice, the analog of the reference's
+    TypeCoercion (sql/analyzer/TypeCoercion.java)."""
+    if a == b:
+        return a
+    if isinstance(a, UnknownType):
+        return b
+    if isinstance(b, UnknownType):
+        return a
+    # integer < bigint < decimal < double
+    def rank(t: DataType) -> int | None:
+        if isinstance(t, IntegerType):
+            return 0
+        if isinstance(t, BigintType):
+            return 1
+        if isinstance(t, DecimalType):
+            return 2
+        if isinstance(t, DoubleType):
+            return 3
+        return None
+
+    ra, rb = rank(a), rank(b)
+    if ra is not None and rb is not None:
+        if ra < rb:
+            a, b = b, a
+            ra, rb = rb, ra
+        if isinstance(a, DecimalType) and is_integer_like(b):
+            # integer literals widen to decimal(x, 0)
+            return DecimalType(18, a.scale)
+        if isinstance(a, DecimalType) and isinstance(b, DecimalType):
+            scale = max(a.scale, b.scale)
+            return DecimalType(18, scale)
+        return a
+    if is_string(a) and is_string(b):
+        return VARCHAR
+    raise TypeError(f"cannot unify types {a} and {b}")

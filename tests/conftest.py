@@ -1,0 +1,36 @@
+"""Test configuration: force an 8-virtual-device CPU platform so sharding
+tests exercise real meshes without TPU hardware (the driver's
+dryrun_multichip uses the same mechanism)."""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import pytest  # noqa: E402
+
+from presto_tpu.connectors.tpch import TpchConnector  # noqa: E402
+from presto_tpu.testing.oracle import SqliteOracle  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def tpch_tiny() -> TpchConnector:
+    return TpchConnector(scale=0.01)
+
+
+@pytest.fixture(scope="session")
+def oracle(tpch_tiny) -> SqliteOracle:
+    o = SqliteOracle()
+    o.load_connector(tpch_tiny)
+    return o
+
+
+@pytest.fixture(scope="session")
+def engine(tpch_tiny):
+    from presto_tpu import Engine
+    e = Engine()
+    e.register_catalog("tpch", tpch_tiny)
+    return e
