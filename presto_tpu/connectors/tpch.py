@@ -193,7 +193,7 @@ class TpchGenerator:
             "s_address": _comments(rng, n),
             "s_nationkey": nationkey,
             "s_phone": _phone(nationkey, rng),
-            "s_acctbal": rng.integers(-99999, 999999, n).astype(np.int64),
+            "s_acctbal": rng.integers(-99999, 1_000_000, n).astype(np.int64),
             "s_comment": _comments(rng, n),
         }
 
@@ -255,7 +255,7 @@ class TpchGenerator:
             "c_address": _comments(rng, n),
             "c_nationkey": nationkey,
             "c_phone": _phone(nationkey, rng),
-            "c_acctbal": rng.integers(-99999, 999999, n).astype(np.int64),
+            "c_acctbal": rng.integers(-99999, 1_000_000, n).astype(np.int64),
             "c_mktsegment": np.array(SEGMENTS, object)[seg],
             "c_comment": _comments(rng, n),
         }

@@ -1,0 +1,8 @@
+"""Execution: lowering logical plans to jitted XLA programs.
+
+The analog of the reference's LocalExecutionPlanner + Driver/Operator
+runtime (sql/planner/LocalExecutionPlanner.java, operator/Driver.java:63) —
+but where the reference pulls Pages through a pipeline of Java operators on
+worker threads, here the whole fragment traces into ONE jit so XLA fuses
+scan+filter+project+aggregate into fused HBM-resident kernels.
+"""

@@ -1,0 +1,304 @@
+"""Trace-time physical operators over masked columnar tables.
+
+Each function takes/returns a DTable (dict of symbol -> Val plus a live
+mask) during jit tracing. Static shapes: filters only update the live
+mask; aggregation/join outputs have planner-chosen static capacities.
+
+Operator parity map (reference core/trino-main/.../operator/):
+- apply_filter/apply_project  <- FilterAndProjectOperator, PageProcessor
+- apply_aggregate             <- HashAggregationOperator + GroupByHash
+- apply_join                  <- HashBuilderOperator + LookupJoinOperator
+- apply_semijoin              <- SetBuilderOperator + HashSemiJoinOperator
+- apply_sort/topn/limit       <- OrderByOperator, TopNOperator, LimitOperator
+- apply_distinct              <- DistinctLimitOperator/MarkDistinct family
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from presto_tpu import types as T
+from presto_tpu.expr import aggregates as A
+from presto_tpu.expr import ir
+from presto_tpu.expr.compile import ExprCompiler, Val, and_valid, cast_val
+from presto_tpu.ops import hash as H
+from presto_tpu.plan import nodes as N
+
+
+@dataclasses.dataclass
+class DTable:
+    cols: dict[str, Val]
+    live: object | None  # bool [n] or None (all live)
+    n: int
+
+    def live_mask(self):
+        if self.live is None:
+            return jnp.ones((self.n,), dtype=bool)
+        return self.live
+
+
+def _compiler(dt: DTable) -> ExprCompiler:
+    return ExprCompiler(dt.cols)
+
+
+def apply_filter(dt: DTable, predicate: ir.Expr) -> DTable:
+    v = _compiler(dt).compile(predicate)
+    keep = v.data if v.valid is None else (v.data & v.valid)  # null -> false
+    live = keep if dt.live is None else (dt.live & keep)
+    return DTable(dt.cols, live, dt.n)
+
+
+def apply_project(dt: DTable, assignments: dict[str, ir.Expr]) -> DTable:
+    c = _compiler(dt)
+    out = {}
+    for sym, expr in assignments.items():
+        v = c.compile(expr)
+        data = v.data
+        if getattr(data, "ndim", 1) == 0:  # broadcast scalar literal
+            data = jnp.broadcast_to(data, (dt.n,))
+            v = Val(v.dtype, data, v.valid, v.dictionary)
+        out[sym] = v
+    return DTable(out, dt.live, dt.n)
+
+
+def _row_hash(dt: DTable, keys: list[str]):
+    hs = []
+    for k in keys:
+        v = dt.cols[k]
+        if v.is_string:
+            hs.append(H.hash_string_column(v.data, v.dictionary, v.valid))
+        else:
+            hs.append(H.hash_int_column(v.data, v.valid))
+    return H.combine_hashes(hs)
+
+
+def apply_aggregate(dt: DTable, node: N.Aggregate, capacity: int) -> tuple:
+    """Returns (DTable of [capacity] rows, ok flag)."""
+    live = dt.live_mask()
+    c = _compiler(dt)
+
+    if node.group_keys:
+        rh = _row_hash(dt, node.group_keys)
+        slots, table, ok = H.group_by_slots(rh, live, capacity)
+        occupancy = table != jnp.uint64(0xFFFFFFFFFFFFFFFF)
+    else:
+        # global aggregation: one group in slot 0
+        slots = jnp.zeros((dt.n,), dtype=jnp.int32)
+        occupancy = jnp.ones((capacity,), dtype=bool)  # capacity == 1
+        ok = jnp.asarray(True)
+
+    safe_slots = slots  # masked rows fold with weight 0, slot harmless
+    out: dict[str, Val] = {}
+
+    for k in node.group_keys:
+        v = dt.cols[k]
+        # scatter key values: all contributors share the slot & value, so a
+        # plain set-scatter is deterministic
+        data = jnp.zeros((capacity,), dtype=v.data.dtype)
+        data = data.at[jnp.where(live, safe_slots, capacity)].set(
+            v.data, mode="drop")
+        if v.valid is not None:
+            valid = jnp.zeros((capacity,), dtype=bool)
+            valid = valid.at[jnp.where(live, safe_slots, capacity)].set(
+                v.valid, mode="drop")
+        else:
+            valid = None
+        out[k] = Val(v.dtype, data, valid, v.dictionary)
+
+    is_final = node.step == N.AggStep.FINAL
+    for sym, call in node.aggs.items():
+        out_dictionary = None
+        if is_final:
+            states = {f: dt.cols[f"{sym}${f}"].data
+                      for f in A.state_fields(call.fn)}
+            val_state = dt.cols.get(f"{sym}$val")
+            if val_state is not None:
+                out_dictionary = val_state.dictionary
+            states = A.merge(call.fn, states, safe_slots, capacity, live)
+            arg_type = None
+        else:
+            if call.arg is not None:
+                av = c.compile(call.arg)
+                weight = live if av.valid is None else (live & av.valid)
+                data = av.data
+                if getattr(data, "ndim", 1) == 0:
+                    data = jnp.broadcast_to(data, (dt.n,))
+                arg_type = av.dtype
+            else:
+                weight = live
+                data = jnp.ones((dt.n,), dtype=jnp.int64)
+                arg_type = None
+            states = A.fold(call.fn, data, weight, safe_slots, capacity)
+
+        if node.step == N.AggStep.PARTIAL:
+            for f, arr in states.items():
+                out[f"{sym}${f}"] = Val(
+                    T.BIGINT if f == "count" else call.dtype, arr, None,
+                    _arg_dictionary(c, call.arg) if f == "val" and call.arg
+                    is not None else None)
+        else:
+            fdata, fvalid = A.finalize(call.fn, states, call.dtype, arg_type)
+            if out_dictionary is None and call.arg is not None:
+                out_dictionary = _arg_dictionary(c, call.arg)
+            out[sym] = Val(call.dtype, fdata, fvalid, out_dictionary)
+
+    return DTable(out, occupancy, capacity), ok
+
+
+def _arg_dictionary(c: ExprCompiler, arg: ir.Expr):
+    """min/max over a string column keep its dictionary."""
+    if isinstance(arg, ir.ColumnRef):
+        v = c.columns.get(arg.name)
+        if v is not None and v.is_string:
+            return v.dictionary
+    return None
+
+
+def _and_key_valid(dt: DTable, keys: list[str], live):
+    for k in keys:
+        v = dt.cols[k]
+        if v.valid is not None:
+            live = live & v.valid
+    return live
+
+
+def apply_join(left: DTable, right: DTable, node: N.Join,
+               capacity: int) -> tuple:
+    """Hash join, probe side preserved (each probe row matches <= 1 build
+    row — FK->PK). Returns (DTable, ok)."""
+    lkeys = [lk for lk, _ in node.criteria]
+    rkeys = [rk for _, rk in node.criteria]
+    # SQL joins never match NULL keys: mask key-invalid rows out of both sides
+    build_live = _and_key_valid(right, rkeys, right.live_mask())
+    probe_live = _and_key_valid(left, lkeys, left.live_mask())
+
+    rh = _row_hash(right, rkeys)
+    table, table_row, ok = H.build_join_table(rh, build_live, capacity)
+    ph = _row_hash(left, lkeys)
+    build_row, found, probe_ok = H.probe_join_table(
+        table, table_row, ph, probe_live)
+    ok = ok & probe_ok
+
+    gather = jnp.clip(build_row, 0, right.n - 1)
+    out = dict(left.cols)
+    for sym, v in right.cols.items():
+        data = v.data[gather]
+        valid = found if v.valid is None else (found & v.valid[gather])
+        out[sym] = Val(v.dtype, data, valid, v.dictionary)
+
+    if node.filter is not None:
+        fv = ExprCompiler(out).compile(node.filter)
+        match_ok = fv.data if fv.valid is None else (fv.data & fv.valid)
+        found = found & match_ok
+
+    if node.join_type == N.JoinType.INNER:
+        live = probe_live & found
+    elif node.join_type == N.JoinType.LEFT:
+        live = probe_live
+        # un-matched rows: right columns become NULL
+        for sym in right.cols:
+            v = out[sym]
+            out[sym] = Val(v.dtype, v.data,
+                           found if v.valid is None else (found & v.valid),
+                           v.dictionary)
+    else:
+        raise NotImplementedError(f"join type {node.join_type}")
+    return DTable(out, live, left.n), ok
+
+
+def apply_semijoin(dt: DTable, filt: DTable, node: N.SemiJoin,
+                   capacity: int) -> tuple:
+    build_live = _and_key_valid(filt, [node.filter_key], filt.live_mask())
+    probe_live = _and_key_valid(dt, [node.source_key], dt.live_mask())
+    fh = _row_hash(filt, [node.filter_key])
+    table, table_row, ok = H.build_join_table(fh, build_live, capacity)
+    sh = _row_hash(dt, [node.source_key])
+    _, found, probe_ok = H.probe_join_table(table, table_row, sh, probe_live)
+    ok = ok & probe_ok
+    out = dict(dt.cols)
+    out[node.output] = Val(T.BOOLEAN, found, None)
+    return DTable(out, dt.live, dt.n), ok
+
+
+def _sort_perm(dt: DTable, orderings: list[N.Ordering]):
+    live = dt.live_mask()
+    keys = [(~live).astype(jnp.int32)]  # dead rows last
+    for o in orderings:
+        v = dt.cols[o.symbol]
+        data = v.data
+        if data.dtype == jnp.bool_:
+            data = data.astype(jnp.int32)
+        if jnp.issubdtype(data.dtype, jnp.floating):
+            big = jnp.asarray(jnp.inf, data.dtype)
+        else:
+            big = jnp.asarray(jnp.iinfo(data.dtype).max, data.dtype)
+        if not o.ascending:
+            data = -data
+            # nulls: reference semantics treat null as largest -> first in
+            # DESC unless overridden
+            null_key = -big if not _nulls_last(o) else big
+        else:
+            null_key = big if _nulls_last(o) else -big
+        if v.valid is not None:
+            data = jnp.where(v.valid, data, null_key)
+        keys.append(data)
+    operands = tuple(keys) + (jnp.arange(dt.n, dtype=jnp.int32),)
+    sorted_ops = jax.lax.sort(operands, num_keys=len(keys), is_stable=True)
+    return sorted_ops[-1]
+
+
+def _nulls_last(o: N.Ordering) -> bool:
+    if o.nulls_first is None:
+        # Trino default: nulls last in ASC, first in DESC (null = largest)
+        return o.ascending
+    return not o.nulls_first
+
+
+def _gather_table(dt: DTable, perm) -> DTable:
+    out = {}
+    for sym, v in dt.cols.items():
+        out[sym] = Val(v.dtype, v.data[perm],
+                       None if v.valid is None else v.valid[perm],
+                       v.dictionary)
+    live = None if dt.live is None else dt.live[perm]
+    return DTable(out, live, dt.n)
+
+
+def apply_sort(dt: DTable, orderings: list[N.Ordering]) -> DTable:
+    perm = _sort_perm(dt, orderings)
+    return _gather_table(dt, perm)
+
+
+def apply_topn(dt: DTable, count: int, orderings: list[N.Ordering]) -> DTable:
+    out = apply_sort(dt, orderings)
+    live = out.live_mask() & (jnp.arange(dt.n) < count)
+    return DTable(out.cols, live, dt.n)
+
+
+def apply_limit(dt: DTable, count: int) -> DTable:
+    live = dt.live_mask()
+    keep = jnp.cumsum(live.astype(jnp.int64)) <= count
+    return DTable(dt.cols, live & keep, dt.n)
+
+
+def apply_distinct(dt: DTable, capacity: int) -> tuple:
+    live = dt.live_mask()
+    rh = _row_hash(dt, list(dt.cols))
+    slots, table, ok = H.group_by_slots(rh, live, capacity)
+    occupancy = table != jnp.uint64(0xFFFFFFFFFFFFFFFF)
+    out = {}
+    for sym, v in dt.cols.items():
+        data = jnp.zeros((capacity,), dtype=v.data.dtype)
+        data = data.at[jnp.where(live, slots, capacity)].set(
+            v.data, mode="drop")
+        valid = None
+        if v.valid is not None:
+            valid = jnp.zeros((capacity,), dtype=bool)
+            valid = valid.at[jnp.where(live, slots, capacity)].set(
+                v.valid, mode="drop")
+        out[sym] = Val(v.dtype, data, valid, v.dictionary)
+    return DTable(out, occupancy, capacity), ok

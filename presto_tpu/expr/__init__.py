@@ -1,0 +1,26 @@
+"""Row-expression IR and its JAX compiler.
+
+The analog of the reference's sql/relational RowExpression IR
+(sql/relational/RowExpression.java: CallExpression, ConstantExpression,
+SpecialForm) plus sql/gen's ExpressionCompiler — but instead of emitting JVM
+bytecode per query, expressions trace to jitted XLA kernels, specialised
+per (expression, input types) exactly like PageFunctionCompiler's cache
+(sql/gen/PageFunctionCompiler.java:101).
+"""
+
+from presto_tpu.expr.ir import (
+    Call,
+    CaseWhen,
+    Cast,
+    ColumnRef,
+    Expr,
+    InList,
+    IsNull,
+    Literal,
+)
+from presto_tpu.expr.compile import ExprCompiler, Val
+
+__all__ = [
+    "Call", "CaseWhen", "Cast", "ColumnRef", "Expr", "InList", "IsNull",
+    "Literal", "ExprCompiler", "Val",
+]

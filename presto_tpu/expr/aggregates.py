@@ -1,0 +1,168 @@
+"""Aggregate function registry.
+
+Analog of the reference's accumulator framework
+(operator/aggregation/AccumulatorCompiler.java + ~90 @AggregationFunction
+implementations). Each aggregate defines how to fold masked rows into
+per-slot state via segment reductions, how to merge partial states
+(the partial->final split used across exchanges, reference
+PushPartialAggregationThroughExchange), and how to produce the final value.
+
+State columns are plain device arrays, so partial aggregation states flow
+through exchanges like any other column — exactly how the reference ships
+serialized accumulator state in Pages.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from presto_tpu import types as T
+from presto_tpu.expr import ir
+
+
+@dataclasses.dataclass(frozen=True)
+class AggCall:
+    """A planned aggregate: function name, argument expression (None for
+    count(*)), distinct flag, output type."""
+
+    fn: str
+    arg: ir.Expr | None
+    dtype: T.DataType
+    distinct: bool = False
+
+    def __str__(self) -> str:
+        inner = "*" if self.arg is None else str(self.arg)
+        d = "distinct " if self.distinct else ""
+        return f"{self.fn}({d}{inner})"
+
+
+def output_type(fn: str, arg_type: T.DataType | None) -> T.DataType:
+    if fn in ("count", "count_star"):
+        return T.BIGINT
+    if fn == "sum":
+        if isinstance(arg_type, T.DecimalType):
+            return T.DecimalType(18, arg_type.scale)
+        if isinstance(arg_type, T.DoubleType):
+            return T.DOUBLE
+        return T.BIGINT
+    if fn == "avg":
+        if isinstance(arg_type, T.DecimalType):
+            # reference: avg(decimal(p,s)) -> decimal(p,s)
+            return T.DecimalType(18, arg_type.scale)
+        return T.DOUBLE
+    if fn in ("min", "max", "arbitrary"):
+        return arg_type
+    raise NotImplementedError(f"aggregate {fn}")
+
+
+# state column suffixes per function (partial aggregation schema)
+def state_fields(fn: str) -> list[str]:
+    if fn in ("count", "count_star"):
+        return ["count"]
+    if fn == "sum":
+        return ["sum", "count"]  # count tracks non-null presence for SQL sum
+    if fn == "avg":
+        return ["sum", "count"]
+    if fn in ("min", "max", "arbitrary"):
+        return ["val", "count"]
+    raise NotImplementedError(fn)
+
+
+def fold(fn: str, data, weight, slots, capacity: int):
+    """Fold rows into per-slot states. ``weight`` is bool live&valid.
+    Returns dict state-field -> array[capacity]."""
+    w = weight
+    if fn in ("count", "count_star"):
+        return {"count": jax.ops.segment_sum(
+            w.astype(jnp.int64), slots, num_segments=capacity)}
+    if fn in ("sum", "avg"):
+        if jnp.issubdtype(data.dtype, jnp.integer):
+            data = data.astype(jnp.int64)  # int32 args must not wrap
+        zero = jnp.zeros((), dtype=data.dtype)
+        s = jax.ops.segment_sum(
+            jnp.where(w, data, zero), slots, num_segments=capacity)
+        c = jax.ops.segment_sum(
+            w.astype(jnp.int64), slots, num_segments=capacity)
+        return {"sum": s, "count": c}
+    if fn in ("min", "max", "arbitrary"):
+        if fn == "max" or fn == "arbitrary":
+            sentinel = _min_sentinel(data.dtype)
+            v = jax.ops.segment_max(jnp.where(w, data, sentinel), slots,
+                                    num_segments=capacity)
+        else:
+            sentinel = _max_sentinel(data.dtype)
+            v = jax.ops.segment_min(jnp.where(w, data, sentinel), slots,
+                                    num_segments=capacity)
+        c = jax.ops.segment_sum(w.astype(jnp.int64), slots,
+                                num_segments=capacity)
+        return {"val": v, "count": c}
+    raise NotImplementedError(fn)
+
+
+def merge(fn: str, states: dict, slots, capacity: int, live):
+    """Merge partial states (rows of state columns) into a final state
+    table — used on the final side of an exchange."""
+    w = live
+    if fn in ("count", "count_star"):
+        return {"count": jax.ops.segment_sum(
+            jnp.where(w, states["count"], 0), slots, num_segments=capacity)}
+    if fn in ("sum", "avg"):
+        zero = jnp.zeros((), dtype=states["sum"].dtype)
+        return {
+            "sum": jax.ops.segment_sum(
+                jnp.where(w, states["sum"], zero), slots,
+                num_segments=capacity),
+            "count": jax.ops.segment_sum(
+                jnp.where(w, states["count"], 0), slots,
+                num_segments=capacity),
+        }
+    if fn in ("min", "max", "arbitrary"):
+        if fn == "max" or fn == "arbitrary":
+            sentinel = _min_sentinel(states["val"].dtype)
+            v = jax.ops.segment_max(
+                jnp.where(w, states["val"], sentinel), slots,
+                num_segments=capacity)
+        else:
+            sentinel = _max_sentinel(states["val"].dtype)
+            v = jax.ops.segment_min(
+                jnp.where(w, states["val"], sentinel), slots,
+                num_segments=capacity)
+        return {"val": v, "count": jax.ops.segment_sum(
+            jnp.where(w, states["count"], 0), slots, num_segments=capacity)}
+    raise NotImplementedError(fn)
+
+
+def finalize(fn: str, states: dict, out_type: T.DataType,
+             arg_type: T.DataType | None):
+    """States -> (data, valid) final columns."""
+    if fn in ("count", "count_star"):
+        return states["count"], None
+    if fn == "sum":
+        return states["sum"], states["count"] > 0
+    if fn == "avg":
+        s, c = states["sum"], states["count"]
+        safe = jnp.maximum(c, 1)
+        if isinstance(out_type, T.DecimalType):
+            # integer rounding half up, reference AverageAggregations semantics
+            half = safe // 2
+            q = jnp.where(s >= 0, (s + half) // safe, -((-s + half) // safe))
+            return q, c > 0
+        return s.astype(jnp.float64) / safe.astype(jnp.float64), c > 0
+    if fn in ("min", "max", "arbitrary"):
+        return states["val"], states["count"] > 0
+    raise NotImplementedError(fn)
+
+
+def _min_sentinel(dtype):
+    if jnp.issubdtype(dtype, jnp.floating):
+        return jnp.asarray(-jnp.inf, dtype)
+    return jnp.asarray(jnp.iinfo(dtype).min, dtype)
+
+
+def _max_sentinel(dtype):
+    if jnp.issubdtype(dtype, jnp.floating):
+        return jnp.asarray(jnp.inf, dtype)
+    return jnp.asarray(jnp.iinfo(dtype).max, dtype)

@@ -1,0 +1,154 @@
+"""Typed row-expression IR.
+
+Every node carries its SQL result type. The analyzer builds these from AST
+expressions; the planner rewrites them; the compiler lowers them to JAX.
+Analog of sql/relational/RowExpression.java + SpecialForm.java in the
+reference.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Sequence
+
+from presto_tpu import types as T
+
+
+@dataclasses.dataclass(frozen=True)
+class Expr:
+    dtype: T.DataType
+
+
+@dataclasses.dataclass(frozen=True)
+class ColumnRef(Expr):
+    """Reference to an input column by symbol name."""
+
+    name: str = ""
+
+    def __str__(self) -> str:
+        return self.name
+
+
+@dataclasses.dataclass(frozen=True)
+class Literal(Expr):
+    """A constant. For VARCHAR the value is the raw Python string; it is
+    resolved against column dictionaries at compile (trace) time. For
+    DECIMAL the value is the *scaled* integer. For DATE, epoch days.
+    value=None means typed NULL."""
+
+    value: Any = None
+
+    def __str__(self) -> str:
+        return repr(self.value)
+
+
+@dataclasses.dataclass(frozen=True)
+class Call(Expr):
+    """Scalar function call, including operators (add, eq, and, or, like...).
+    Function semantics live in expr/functions.py."""
+
+    fn: str = ""
+    args: tuple[Expr, ...] = ()
+
+    def __str__(self) -> str:
+        return f"{self.fn}({', '.join(map(str, self.args))})"
+
+
+@dataclasses.dataclass(frozen=True)
+class Cast(Expr):
+    arg: Expr = None  # type: ignore[assignment]
+
+    def __str__(self) -> str:
+        return f"cast({self.arg} as {self.dtype})"
+
+
+@dataclasses.dataclass(frozen=True)
+class CaseWhen(Expr):
+    """Searched CASE: WHEN cond THEN value ... ELSE default.
+    conditions[i] pairs with results[i]; default may be a typed-NULL
+    Literal."""
+
+    conditions: tuple[Expr, ...] = ()
+    results: tuple[Expr, ...] = ()
+    default: Expr = None  # type: ignore[assignment]
+
+    def __str__(self) -> str:
+        parts = " ".join(
+            f"when {c} then {r}" for c, r in zip(self.conditions, self.results))
+        return f"case {parts} else {self.default} end"
+
+
+@dataclasses.dataclass(frozen=True)
+class InList(Expr):
+    """value IN (literals...). Non-literal IN lists lower to OR chains in
+    the planner; IN subqueries become semijoins before reaching here."""
+
+    arg: Expr = None  # type: ignore[assignment]
+    values: tuple[Literal, ...] = ()
+
+    def __str__(self) -> str:
+        return f"{self.arg} in ({', '.join(map(str, self.values))})"
+
+
+@dataclasses.dataclass(frozen=True)
+class IsNull(Expr):
+    arg: Expr = None  # type: ignore[assignment]
+    negated: bool = False
+
+    def __str__(self) -> str:
+        return f"{self.arg} is {'not ' if self.negated else ''}null"
+
+
+def walk(expr: Expr):
+    """Yield expr and all descendants."""
+    yield expr
+    if isinstance(expr, Call):
+        for a in expr.args:
+            yield from walk(a)
+    elif isinstance(expr, Cast):
+        yield from walk(expr.arg)
+    elif isinstance(expr, CaseWhen):
+        for c in expr.conditions:
+            yield from walk(c)
+        for r in expr.results:
+            yield from walk(r)
+        if expr.default is not None:
+            yield from walk(expr.default)
+    elif isinstance(expr, InList):
+        yield from walk(expr.arg)
+        for v in expr.values:
+            yield from walk(v)
+    elif isinstance(expr, IsNull):
+        yield from walk(expr.arg)
+
+
+def referenced_columns(exprs: Sequence[Expr]) -> set[str]:
+    out: set[str] = set()
+    for e in exprs:
+        for node in walk(e):
+            if isinstance(node, ColumnRef):
+                out.add(node.name)
+    return out
+
+
+def rewrite_refs(expr: Expr, mapping: dict[str, Expr]) -> Expr:
+    """Substitute ColumnRefs by name (used by pushdown/inlining rules)."""
+    if isinstance(expr, ColumnRef):
+        return mapping.get(expr.name, expr)
+    if isinstance(expr, Call):
+        return Call(expr.dtype, expr.fn,
+                    tuple(rewrite_refs(a, mapping) for a in expr.args))
+    if isinstance(expr, Cast):
+        return Cast(expr.dtype, rewrite_refs(expr.arg, mapping))
+    if isinstance(expr, CaseWhen):
+        return CaseWhen(
+            expr.dtype,
+            tuple(rewrite_refs(c, mapping) for c in expr.conditions),
+            tuple(rewrite_refs(r, mapping) for r in expr.results),
+            None if expr.default is None else rewrite_refs(expr.default, mapping),
+        )
+    if isinstance(expr, InList):
+        return InList(expr.dtype, rewrite_refs(expr.arg, mapping), expr.values)
+    if isinstance(expr, IsNull):
+        return IsNull(expr.dtype, rewrite_refs(expr.arg, mapping), expr.negated)
+    return expr

@@ -1,0 +1,26 @@
+"""Device kernels: hashing, group-by tables, join tables, sort utilities.
+
+The analog of the reference's hand-tuned operator internals
+(operator/MultiChannelGroupByHash.java:55, operator/join/PagesHash.java:35,
+sql/gen/JoinCompiler.java) re-designed for XLA: static-shape open-addressing
+tables built with vectorised scatter-claim rounds instead of sequential
+inserts, and bounded lax.while_loop probe sweeps instead of per-row loops.
+"""
+
+from presto_tpu.ops.hash import (
+    combine_hashes,
+    group_by_slots,
+    hash_int_column,
+    hash_string_dictionary,
+    build_join_table,
+    probe_join_table,
+)
+
+__all__ = [
+    "combine_hashes",
+    "group_by_slots",
+    "hash_int_column",
+    "hash_string_dictionary",
+    "build_join_table",
+    "probe_join_table",
+]

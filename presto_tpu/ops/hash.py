@@ -1,0 +1,189 @@
+"""Hashing and open-addressing hash tables as XLA-friendly kernels.
+
+Design notes (vs the reference's Java hash machinery):
+
+- The reference inserts rows into `MultiChannelGroupByHash` one at a time,
+  rehashing on load (MultiChannelGroupByHash.java:140-149). A TPU kernel
+  cannot grow tables or loop per row, so `group_by_slots` assigns every row
+  its slot with **parallel claim rounds**: each round every unresolved row
+  scatter-mins its 64-bit key hash into the table at its current probe slot;
+  winners keep the slot, losers advance one slot (linear probing). The table
+  is rebuilt from scratch every round, which keeps the claim semantics
+  monotone: once a slot is occupied it stays occupied, so the standard
+  probe-until-empty invariant holds for later lookups.
+- Capacity is static and chosen by the planner from connector stats
+  (reference sizes from `expectedGroups`); on overflow the kernel reports
+  failure and the host retries with a doubled capacity — the analog of the
+  reference's host-side rehash.
+- Group identity is the full 64-bit mixed hash (splitmix64 finaliser over
+  all key columns). Two distinct key tuples merging requires a 64-bit
+  collision *within one query's keys* (~N^2 / 2^64).
+- NULL group keys hash to a fixed sentinel so all-NULL keys form one group
+  (SQL semantics); NULL join keys are masked out before probing (SQL joins
+  never match NULLs).
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# Sentinel for an empty slot: max uint64. Real hashes are remapped off it.
+_EMPTY = jnp.uint64(0xFFFFFFFFFFFFFFFF)
+_NULL_KEY_HASH = jnp.uint64(0x9E3779B97F4A7C15)
+
+
+def _splitmix64(x):
+    x = x.astype(jnp.uint64)
+    x = (x + jnp.uint64(0x9E3779B97F4A7C15))
+    x = (x ^ (x >> jnp.uint64(30))) * jnp.uint64(0xBF58476D1CE4E5B9)
+    x = (x ^ (x >> jnp.uint64(27))) * jnp.uint64(0x94D049BB133111EB)
+    x = x ^ (x >> jnp.uint64(31))
+    return x
+
+
+def hash_int_column(data, valid=None):
+    """64-bit hash of an integer-like column (int64/int32/date/decimal/bool
+    physical). NULLs hash to a fixed sentinel."""
+    h = _splitmix64(data.astype(jnp.int64).view(jnp.uint64)
+                    if data.dtype == jnp.int64 else
+                    data.astype(jnp.int64).astype(jnp.uint64))
+    if valid is not None:
+        h = jnp.where(valid, h, _NULL_KEY_HASH)
+    return h
+
+
+# id(dictionary) -> (strong ref to the dictionary, hashes). Holding the
+# reference keeps the id stable, so a recycled address cannot alias.
+_DICT_HASH_CACHE: dict[int, tuple[np.ndarray, np.ndarray]] = {}
+
+
+def hash_string_dictionary(dictionary: np.ndarray) -> np.ndarray:
+    """Stable 64-bit hash per dictionary entry, content-based so string
+    joins/groupings agree across tables with different dictionaries."""
+    cached = _DICT_HASH_CACHE.get(id(dictionary))
+    if cached is not None and cached[0] is dictionary:
+        return cached[1]
+    out = np.empty(len(dictionary), dtype=np.uint64)
+    for i, s in enumerate(dictionary):
+        d = hashlib.blake2b(str(s).encode(), digest_size=8).digest()
+        out[i] = np.frombuffer(d, dtype=np.uint64)[0]
+    if len(_DICT_HASH_CACHE) > 256:
+        _DICT_HASH_CACHE.clear()
+    _DICT_HASH_CACHE[id(dictionary)] = (dictionary, out)
+    return out
+
+
+def hash_string_column(codes, dictionary: np.ndarray, valid=None):
+    lut = jnp.asarray(hash_string_dictionary(dictionary))
+    if len(dictionary) == 0:
+        h = jnp.zeros(codes.shape, dtype=jnp.uint64)
+    else:
+        h = lut[jnp.clip(codes, 0, len(dictionary) - 1)]
+    if valid is not None:
+        h = jnp.where(valid, h, _NULL_KEY_HASH)
+    return h
+
+
+def combine_hashes(hashes: list):
+    """Combine per-column hashes into one row hash."""
+    out = hashes[0]
+    for h in hashes[1:]:
+        out = _splitmix64(out ^ h)
+    # keep the EMPTY sentinel unreachable
+    return jnp.where(out == _EMPTY, out - jnp.uint64(1), out)
+
+
+def group_by_slots(row_hash, live, capacity: int, max_rounds: int = 64):
+    """Assign each live row a slot in a capacity-sized table such that rows
+    with equal hashes share a slot.
+
+    Returns (slot int32 [N], table_hash uint64 [capacity], ok bool scalar).
+    ``ok`` is False if any row failed to claim within max_rounds (host
+    should retry with larger capacity).
+    """
+    n = row_hash.shape[0]
+    cap = jnp.uint64(capacity)
+    home = (row_hash % cap).astype(jnp.int32)
+    h = jnp.where(live, row_hash, _EMPTY)
+
+    def cond(state):
+        _, _, settled, rounds = state
+        return (~settled) & (rounds < max_rounds)
+
+    def body(state):
+        _, slot, _, rounds = state
+        table = jnp.full((capacity,), _EMPTY, dtype=jnp.uint64)
+        table = table.at[slot].min(jnp.where(live, h, _EMPTY))
+        won = table[slot] == h
+        # losers advance one slot (linear probe)
+        new_slot = jnp.where(live & ~won, (slot + 1) % capacity, slot)
+        settled = jnp.all(jnp.where(live, won, True))
+        return table, new_slot, settled, rounds + 1
+
+    table0 = jnp.full((capacity,), _EMPTY, dtype=jnp.uint64)
+    table, slot, settled, rounds = jax.lax.while_loop(
+        cond, body,
+        (table0, home, jnp.asarray(False), jnp.asarray(0, jnp.int32)))
+    # final table consistent with final slots
+    table = jnp.full((capacity,), _EMPTY, dtype=jnp.uint64)
+    table = table.at[slot].min(jnp.where(live, h, _EMPTY))
+    ok = jnp.all(jnp.where(live, table[slot] == h, True))
+    return slot, table, ok
+
+
+def build_join_table(row_hash, live, capacity: int, max_rounds: int = 64):
+    """Build-side of a hash join: returns (table_hash uint64 [capacity],
+    table_row int32 [capacity] (source row index per slot, -1 empty), ok).
+
+    Duplicate build keys share one slot; the representative row is the one
+    with the largest row index (callers needing many-to-many semantics use
+    the expanding join path instead)."""
+    n = row_hash.shape[0]
+    slot, table, ok = group_by_slots(row_hash, live, capacity, max_rounds)
+    rows = jnp.arange(n, dtype=jnp.int32)
+    table_row = jnp.full((capacity,), -1, dtype=jnp.int32)
+    table_row = table_row.at[slot].max(jnp.where(live, rows, -1))
+    return table, table_row, ok
+
+
+def probe_join_table(table_hash, table_row, row_hash, live,
+                     max_probes: int = 256):
+    """Probe: for each row, find the slot whose stored hash equals the row
+    hash, walking linearly until an empty slot. Returns (build_row int32
+    [N] (-1 = no match), found bool [N], ok bool scalar). ``ok`` is False
+    if any probe chain was cut off by max_probes (host should retry with a
+    larger table, like the build-side overflow)."""
+    capacity = table_hash.shape[0]
+    cap = jnp.uint64(capacity)
+    slot = (row_hash % cap).astype(jnp.int32)
+    found = jnp.zeros(row_hash.shape, dtype=bool)
+    build_row = jnp.full(row_hash.shape, -1, dtype=jnp.int32)
+    active = live
+
+    def cond(state):
+        _, _, active, _, probes = state
+        return jnp.any(active) & (probes < max_probes)
+
+    def body(state):
+        slot, found, active, build_row, probes = state
+        at = table_hash[slot]
+        hit = active & (at == row_hash)
+        empty = at == _EMPTY
+        build_row = jnp.where(hit, table_row[slot], build_row)
+        found = found | hit
+        active = active & ~hit & ~empty
+        slot = jnp.where(active, (slot + 1) % capacity, slot)
+        return slot, found, active, build_row, probes + 1
+
+    _, found, active, build_row, _ = jax.lax.while_loop(
+        cond, body,
+        (slot, found, active, build_row, jnp.asarray(0, jnp.int32)))
+    return build_row, found, ~jnp.any(active)
+
+
+def next_pow2(x: int) -> int:
+    return 1 << max(int(x) - 1, 1).bit_length()

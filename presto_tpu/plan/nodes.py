@@ -1,0 +1,322 @@
+"""Logical plan nodes.
+
+The subset of the reference's 53 plan node types
+(sql/planner/plan/*.java) that TPC-H/TPC-DS execution needs, carrying
+symbol-based schemas: every node outputs named symbols; expressions
+reference symbols via ColumnRef.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Optional
+
+from presto_tpu import types as T
+from presto_tpu.expr import ir
+from presto_tpu.expr.aggregates import AggCall
+
+
+@dataclasses.dataclass
+class PlanNode:
+    def sources(self) -> list["PlanNode"]:
+        return []
+
+    @property
+    def output_symbols(self) -> list[str]:
+        raise NotImplementedError
+
+    def output_types(self) -> dict[str, T.DataType]:
+        raise NotImplementedError
+
+
+@dataclasses.dataclass
+class TableScan(PlanNode):
+    """Scan of catalog.table; assignments maps output symbol -> source
+    column name (reference plan/TableScanNode.java)."""
+
+    catalog: str
+    table: str
+    assignments: dict[str, str]
+    types: dict[str, T.DataType]
+
+    @property
+    def output_symbols(self):
+        return list(self.assignments)
+
+    def output_types(self):
+        return dict(self.types)
+
+
+@dataclasses.dataclass
+class Values(PlanNode):
+    """Inline rows (plan/ValuesNode.java)."""
+
+    symbols: list[str]
+    types: dict[str, T.DataType]
+    rows: list[list[object]]
+
+    @property
+    def output_symbols(self):
+        return list(self.symbols)
+
+    def output_types(self):
+        return dict(self.types)
+
+
+@dataclasses.dataclass
+class Filter(PlanNode):
+    source: PlanNode = None  # type: ignore[assignment]
+    predicate: ir.Expr = None  # type: ignore[assignment]
+
+    def sources(self):
+        return [self.source]
+
+    @property
+    def output_symbols(self):
+        return self.source.output_symbols
+
+    def output_types(self):
+        return self.source.output_types()
+
+
+@dataclasses.dataclass
+class Project(PlanNode):
+    source: PlanNode = None  # type: ignore[assignment]
+    assignments: dict[str, ir.Expr] = dataclasses.field(default_factory=dict)
+
+    def sources(self):
+        return [self.source]
+
+    @property
+    def output_symbols(self):
+        return list(self.assignments)
+
+    def output_types(self):
+        return {s: e.dtype for s, e in self.assignments.items()}
+
+
+class AggStep(enum.Enum):
+    SINGLE = "single"
+    PARTIAL = "partial"
+    FINAL = "final"
+
+
+@dataclasses.dataclass
+class Aggregate(PlanNode):
+    """Group-by aggregation (plan/AggregationNode.java). ``aggs`` maps
+    output symbol -> AggCall. PARTIAL outputs state columns named
+    ``{symbol}$state_field``; FINAL consumes them."""
+
+    source: PlanNode = None  # type: ignore[assignment]
+    group_keys: list[str] = dataclasses.field(default_factory=list)
+    aggs: dict[str, AggCall] = dataclasses.field(default_factory=dict)
+    step: AggStep = AggStep.SINGLE
+
+    def sources(self):
+        return [self.source]
+
+    @property
+    def output_symbols(self):
+        from presto_tpu.expr import aggregates as A
+        out = list(self.group_keys)
+        if self.step == AggStep.PARTIAL:
+            for s, call in self.aggs.items():
+                out += [f"{s}${f}" for f in A.state_fields(call.fn)]
+        else:
+            out += list(self.aggs)
+        return out
+
+    def output_types(self):
+        from presto_tpu.expr import aggregates as A
+        src = self.source.output_types()
+        out = {k: src[k] for k in self.group_keys}
+        for s, call in self.aggs.items():
+            if self.step == AggStep.PARTIAL:
+                for f in A.state_fields(call.fn):
+                    if f == "count":
+                        out[f"{s}${f}"] = T.BIGINT
+                    elif f in ("sum", "val"):
+                        out[f"{s}${f}"] = (
+                            call.dtype if call.fn != "avg" else
+                            (call.dtype if isinstance(call.dtype, T.DecimalType)
+                             else T.DOUBLE))
+            else:
+                out[s] = call.dtype
+        return out
+
+
+class JoinType(enum.Enum):
+    INNER = "inner"
+    LEFT = "left"
+    RIGHT = "right"
+    FULL = "full"
+    CROSS = "cross"
+
+
+@dataclasses.dataclass
+class Join(PlanNode):
+    """Hash equi-join (plan/JoinNode.java). left = probe, right = build.
+    ``criteria`` is a list of (left_symbol, right_symbol) equalities;
+    ``filter`` an optional residual non-equi condition."""
+
+    left: PlanNode = None  # type: ignore[assignment]
+    right: PlanNode = None  # type: ignore[assignment]
+    join_type: JoinType = JoinType.INNER
+    criteria: list[tuple[str, str]] = dataclasses.field(default_factory=list)
+    filter: Optional[ir.Expr] = None
+    # planner hint: probe-side rows match at most one build row (FK->PK)
+    build_unique: bool = True
+    distribution: str = "broadcast"  # broadcast | partitioned
+
+    def sources(self):
+        return [self.left, self.right]
+
+    @property
+    def output_symbols(self):
+        return self.left.output_symbols + self.right.output_symbols
+
+    def output_types(self):
+        return {**self.left.output_types(), **self.right.output_types()}
+
+
+@dataclasses.dataclass
+class SemiJoin(PlanNode):
+    """source rows tested for membership in filter_source keys
+    (plan/SemiJoinNode.java); adds boolean output symbol."""
+
+    source: PlanNode = None  # type: ignore[assignment]
+    filter_source: PlanNode = None  # type: ignore[assignment]
+    source_key: str = ""
+    filter_key: str = ""
+    output: str = ""
+    negated: bool = False  # NOT IN / NOT EXISTS handled at planner level
+
+    def sources(self):
+        return [self.source, self.filter_source]
+
+    @property
+    def output_symbols(self):
+        return self.source.output_symbols + [self.output]
+
+    def output_types(self):
+        return {**self.source.output_types(), self.output: T.BOOLEAN}
+
+
+@dataclasses.dataclass(frozen=True)
+class Ordering:
+    symbol: str
+    ascending: bool = True
+    nulls_first: bool | None = None  # None = Trino default (nulls last)
+
+
+@dataclasses.dataclass
+class Sort(PlanNode):
+    source: PlanNode = None  # type: ignore[assignment]
+    orderings: list[Ordering] = dataclasses.field(default_factory=list)
+
+    def sources(self):
+        return [self.source]
+
+    @property
+    def output_symbols(self):
+        return self.source.output_symbols
+
+    def output_types(self):
+        return self.source.output_types()
+
+
+@dataclasses.dataclass
+class TopN(PlanNode):
+    source: PlanNode = None  # type: ignore[assignment]
+    count: int = 0
+    orderings: list[Ordering] = dataclasses.field(default_factory=list)
+
+    def sources(self):
+        return [self.source]
+
+    @property
+    def output_symbols(self):
+        return self.source.output_symbols
+
+    def output_types(self):
+        return self.source.output_types()
+
+
+@dataclasses.dataclass
+class Limit(PlanNode):
+    source: PlanNode = None  # type: ignore[assignment]
+    count: int = 0
+
+    def sources(self):
+        return [self.source]
+
+    @property
+    def output_symbols(self):
+        return self.source.output_symbols
+
+    def output_types(self):
+        return self.source.output_types()
+
+
+@dataclasses.dataclass
+class Distinct(PlanNode):
+    """SELECT DISTINCT — group-by on all columns, no aggregates."""
+
+    source: PlanNode = None  # type: ignore[assignment]
+
+    def sources(self):
+        return [self.source]
+
+    @property
+    def output_symbols(self):
+        return self.source.output_symbols
+
+    def output_types(self):
+        return self.source.output_types()
+
+
+class ExchangeType(enum.Enum):
+    GATHER = "gather"  # all shards -> one
+    REPARTITION = "repartition"  # hash all_to_all
+    REPLICATE = "replicate"  # broadcast (all_gather)
+
+
+@dataclasses.dataclass
+class Exchange(PlanNode):
+    """Distribution boundary (plan/ExchangeNode.java). Inserted by the
+    fragmenter; executed as ICI collectives under shard_map."""
+
+    source: PlanNode = None  # type: ignore[assignment]
+    kind: ExchangeType = ExchangeType.GATHER
+    partition_keys: list[str] = dataclasses.field(default_factory=list)
+
+    def sources(self):
+        return [self.source]
+
+    @property
+    def output_symbols(self):
+        return self.source.output_symbols
+
+    def output_types(self):
+        return self.source.output_types()
+
+
+@dataclasses.dataclass
+class Output(PlanNode):
+    """Root node naming the result columns (plan/OutputNode.java)."""
+
+    source: PlanNode = None  # type: ignore[assignment]
+    names: list[str] = dataclasses.field(default_factory=list)
+    symbols: list[str] = dataclasses.field(default_factory=list)
+
+    def sources(self):
+        return [self.source]
+
+    @property
+    def output_symbols(self):
+        return list(self.symbols)
+
+    def output_types(self):
+        src = self.source.output_types()
+        return {s: src[s] for s in self.symbols}

@@ -18,6 +18,7 @@ import sqlite3
 import numpy as np
 
 from presto_tpu import types as T
+from presto_tpu.block import _decode_column
 from presto_tpu.connectors.base import Connector
 
 
@@ -34,19 +35,15 @@ class SqliteOracle:
             arrays = []
             for cname, dtype in schema.items():
                 col = tbl.columns[cname]
-                data = np.asarray(col.data)
-                if isinstance(dtype, T.VarcharType):
-                    arrays.append([str(x) for x in col.dictionary[data]]
-                                  if len(col.dictionary) else [""] * len(data))
-                elif isinstance(dtype, T.DecimalType):
-                    arrays.append(
-                        (data.astype(np.float64) / dtype.unscale_factor).tolist())
-                elif isinstance(dtype, T.DateType):
-                    epoch = np.datetime64("1970-01-01")
-                    arrays.append(
-                        [str(d) for d in (epoch + data.astype("timedelta64[D]"))])
+                decoded = _decode_column(
+                    dtype, np.asarray(col.data), col.dictionary)
+                if isinstance(dtype, T.DateType):
+                    decoded = [str(d) for d in decoded]  # ISO text in sqlite
+                elif isinstance(dtype, T.VarcharType):
+                    decoded = [str(s) for s in decoded]
                 else:
-                    arrays.append(data.tolist())
+                    decoded = decoded.tolist()
+                arrays.append(decoded)
             rows = list(zip(*arrays)) if arrays else []
             ph = ", ".join("?" for _ in schema)
             self.conn.executemany(f"INSERT INTO {name} VALUES ({ph})", rows)

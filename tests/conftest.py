@@ -4,11 +4,16 @@ dryrun_multichip uses the same mechanism)."""
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+# The env-var route (JAX_PLATFORMS) is overridden by the axon TPU plugin in
+# this environment; the config API wins.
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

@@ -1,0 +1,166 @@
+"""Hand-built operator-tree tests — the analog of the reference's
+HandTpchQuery1/6 (testing/trino-benchmark/.../HandTpchQuery6.java:50):
+physical plans constructed directly, results checked against the sqlite
+oracle running the equivalent SQL."""
+
+import numpy as np
+
+from presto_tpu import types as T
+from presto_tpu.exec.executor import execute_plan
+from presto_tpu.expr import ir
+from presto_tpu.expr.aggregates import AggCall
+from presto_tpu.plan import nodes as N
+from presto_tpu.testing.oracle import rows_equal
+
+DEC2 = T.DecimalType(12, 2)
+DEC4 = T.DecimalType(18, 4)
+DEC6 = T.DecimalType(18, 6)
+SUM2 = T.DecimalType(18, 2)
+
+
+def _scan(table, cols, types):
+    return N.TableScan("tpch", table, {c: c for c in cols},
+                       dict(zip(cols, types)))
+
+
+def _days(s):
+    return int((np.datetime64(s) - np.datetime64("1970-01-01")).astype(int))
+
+
+def ref(name, t):
+    return ir.ColumnRef(t, name)
+
+
+def test_hand_q6(engine, oracle):
+    # select sum(l_extendedprice * l_discount) from lineitem
+    # where l_shipdate >= date '1994-01-01' and l_shipdate < date '1995-01-01'
+    #   and l_discount between 0.05 and 0.07 and l_quantity < 24
+    scan = _scan("lineitem",
+                 ["l_extendedprice", "l_discount", "l_quantity", "l_shipdate"],
+                 [DEC2, DEC2, DEC2, T.DATE])
+    pred = ir.Call(T.BOOLEAN, "and", (
+        ir.Call(T.BOOLEAN, "gte", (ref("l_shipdate", T.DATE),
+                                   ir.Literal(T.DATE, _days("1994-01-01")))),
+        ir.Call(T.BOOLEAN, "lt", (ref("l_shipdate", T.DATE),
+                                  ir.Literal(T.DATE, _days("1995-01-01")))),
+        ir.Call(T.BOOLEAN, "gte", (ref("l_discount", DEC2),
+                                   ir.Literal(DEC2, 5))),
+        ir.Call(T.BOOLEAN, "lte", (ref("l_discount", DEC2),
+                                   ir.Literal(DEC2, 7))),
+        ir.Call(T.BOOLEAN, "lt", (ref("l_quantity", DEC2),
+                                  ir.Literal(DEC2, 2400))),
+    ))
+    filt = N.Filter(scan, pred)
+    proj = N.Project(filt, {"revenue_in": ir.Call(
+        DEC4, "multiply", (ref("l_extendedprice", DEC2),
+                           ref("l_discount", DEC2)))})
+    agg = N.Aggregate(proj, [], {
+        "revenue": AggCall("sum", ref("revenue_in", DEC4), DEC4)})
+    plan = N.Output(agg, ["revenue"], ["revenue"])
+
+    got = execute_plan(engine, plan).to_pylist()
+    want = oracle.query(
+        "SELECT sum(l_extendedprice * l_discount) FROM lineitem "
+        "WHERE l_shipdate >= '1994-01-01' AND l_shipdate < '1995-01-01' "
+        "AND l_discount BETWEEN 0.05 AND 0.07 AND l_quantity < 24")
+    ok, msg = rows_equal(got, want, ordered=True)
+    assert ok, msg
+
+
+def test_hand_q1(engine, oracle):
+    scan = _scan(
+        "lineitem",
+        ["l_returnflag", "l_linestatus", "l_quantity", "l_extendedprice",
+         "l_discount", "l_tax", "l_shipdate"],
+        [T.VARCHAR, T.VARCHAR, DEC2, DEC2, DEC2, DEC2, T.DATE])
+    pred = ir.Call(T.BOOLEAN, "lte", (
+        ref("l_shipdate", T.DATE), ir.Literal(T.DATE, _days("1998-09-02"))))
+    filt = N.Filter(scan, pred)
+
+    one_minus_disc = ir.Call(DEC2, "subtract", (
+        ir.Literal(DEC2, 100), ref("l_discount", DEC2)))
+    disc_price = ir.Call(DEC4, "multiply", (
+        ref("l_extendedprice", DEC2), one_minus_disc))
+    one_plus_tax = ir.Call(DEC2, "add", (
+        ir.Literal(DEC2, 100), ref("l_tax", DEC2)))
+    charge = ir.Call(DEC6, "multiply", (disc_price, one_plus_tax))
+    proj = N.Project(filt, {
+        "l_returnflag": ref("l_returnflag", T.VARCHAR),
+        "l_linestatus": ref("l_linestatus", T.VARCHAR),
+        "l_quantity": ref("l_quantity", DEC2),
+        "l_extendedprice": ref("l_extendedprice", DEC2),
+        "l_discount": ref("l_discount", DEC2),
+        "disc_price": disc_price,
+        "charge": charge,
+    })
+    agg = N.Aggregate(proj, ["l_returnflag", "l_linestatus"], {
+        "sum_qty": AggCall("sum", ref("l_quantity", DEC2), SUM2),
+        "sum_base_price": AggCall("sum", ref("l_extendedprice", DEC2), SUM2),
+        "sum_disc_price": AggCall("sum", ref("disc_price", DEC4), DEC4),
+        "sum_charge": AggCall("sum", ref("charge", DEC6), DEC6),
+        "avg_qty": AggCall("avg", ref("l_quantity", DEC2), SUM2),
+        "avg_price": AggCall("avg", ref("l_extendedprice", DEC2), SUM2),
+        "avg_disc": AggCall("avg", ref("l_discount", DEC2), SUM2),
+        "count_order": AggCall("count_star", None, T.BIGINT),
+    })
+    sort = N.Sort(agg, [N.Ordering("l_returnflag"), N.Ordering("l_linestatus")])
+    names = ["l_returnflag", "l_linestatus", "sum_qty", "sum_base_price",
+             "sum_disc_price", "sum_charge", "avg_qty", "avg_price",
+             "avg_disc", "count_order"]
+    plan = N.Output(sort, names, names)
+
+    got = execute_plan(engine, plan).to_pylist()
+    want = oracle.query(
+        "SELECT l_returnflag, l_linestatus, sum(l_quantity), "
+        "sum(l_extendedprice), sum(l_extendedprice * (1 - l_discount)), "
+        "sum(l_extendedprice * (1 - l_discount) * (1 + l_tax)), "
+        # engine matches the reference: avg(decimal(p,s)) rounds to scale s
+        "round(avg(l_quantity), 2), round(avg(l_extendedprice), 2), "
+        "round(avg(l_discount), 2), count(*) "
+        "FROM lineitem WHERE l_shipdate <= '1998-09-02' "
+        "GROUP BY l_returnflag, l_linestatus "
+        "ORDER BY l_returnflag, l_linestatus")
+    assert len(got) == len(want)
+    ok, msg = rows_equal(got, want, ordered=True)
+    assert ok, msg
+
+
+def test_hand_join(engine, oracle):
+    # select n_name, count(*) from customer join nation on c_nationkey =
+    # n_nationkey group by n_name order by n_name
+    cscan = _scan("customer", ["c_custkey", "c_nationkey"],
+                  [T.BIGINT, T.BIGINT])
+    nscan = _scan("nation", ["n_nationkey", "n_name"], [T.BIGINT, T.VARCHAR])
+    join = N.Join(cscan, nscan, N.JoinType.INNER,
+                  [("c_nationkey", "n_nationkey")])
+    agg = N.Aggregate(join, ["n_name"],
+                      {"cnt": AggCall("count_star", None, T.BIGINT)})
+    sort = N.Sort(agg, [N.Ordering("n_name")])
+    plan = N.Output(sort, ["n_name", "cnt"], ["n_name", "cnt"])
+    got = execute_plan(engine, plan).to_pylist()
+    want = oracle.query(
+        "SELECT n_name, count(*) FROM customer JOIN nation "
+        "ON c_nationkey = n_nationkey GROUP BY n_name ORDER BY n_name")
+    ok, msg = rows_equal(got, want, ordered=True)
+    assert ok, msg
+
+
+def test_hand_semijoin_and_topn(engine, oracle):
+    # orders whose orderkey appears in filtered lineitem; top 5 by totalprice
+    oscan = _scan("orders", ["o_orderkey", "o_totalprice"], [T.BIGINT, DEC2])
+    lscan = _scan("lineitem", ["l_orderkey", "l_quantity"], [T.BIGINT, DEC2])
+    lfilt = N.Filter(lscan, ir.Call(T.BOOLEAN, "gt", (
+        ref("l_quantity", DEC2), ir.Literal(DEC2, 4900))))
+    semi = N.SemiJoin(oscan, lfilt, "o_orderkey", "l_orderkey", "has_big")
+    filt = N.Filter(semi, ref("has_big", T.BOOLEAN))
+    topn = N.TopN(filt, 5, [N.Ordering("o_totalprice", ascending=False),
+                            N.Ordering("o_orderkey")])
+    plan = N.Output(topn, ["o_orderkey", "o_totalprice"],
+                    ["o_orderkey", "o_totalprice"])
+    got = execute_plan(engine, plan).to_pylist()
+    want = oracle.query(
+        "SELECT o_orderkey, o_totalprice FROM orders WHERE o_orderkey IN "
+        "(SELECT l_orderkey FROM lineitem WHERE l_quantity > 49) "
+        "ORDER BY o_totalprice DESC, o_orderkey LIMIT 5")
+    ok, msg = rows_equal(got, want, ordered=True)
+    assert ok, msg
