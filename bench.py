@@ -1,0 +1,98 @@
+"""Benchmark entry: TPC-H Q1 throughput on the local accelerator.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+The metric is lineitem rows/sec through the full Q1 kernel
+(scan→filter→project→group-aggregate→sort), steady-state (arrays resident
+on device, compiled once) — the analog of the reference's
+HandTpchQuery1 in-process benchmark
+(testing/trino-benchmark/.../HandTpchQuery1.java, BenchmarkSuite).
+
+``vs_baseline`` compares against a single-threaded vectorized NumPy
+implementation of the same query measured on this host — the stand-in for
+BASELINE.json config 1 ("CPU Java-equivalent operators"), since the
+reference repo publishes no absolute numbers (BASELINE.md).
+
+Env knobs: PRESTO_TPU_BENCH_SF (default 1.0), PRESTO_TPU_BENCH_REPS (5).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def numpy_q1_baseline(arrays: dict[str, np.ndarray], cutoff: int) -> float:
+    """Single-pass vectorized NumPy Q1; returns wall seconds."""
+    t0 = time.perf_counter()
+    mask = arrays["l_shipdate"] <= cutoff
+    rf = arrays["l_returnflag"][mask]
+    ls = arrays["l_linestatus"][mask]
+    qty = arrays["l_quantity"][mask]
+    price = arrays["l_extendedprice"][mask]
+    disc = arrays["l_discount"][mask]
+    tax = arrays["l_tax"][mask]
+    disc_price = price * (100 - disc)
+    charge = disc_price * (100 + tax)
+    gid = rf.astype(np.int64) * 64 + ls.astype(np.int64)
+    uniq, inv = np.unique(gid, return_inverse=True)
+    k = len(uniq)
+    for col in (qty, price, disc, disc_price, charge):
+        np.bincount(inv, weights=col.astype(np.float64), minlength=k)
+    np.bincount(inv, minlength=k)
+    return time.perf_counter() - t0
+
+
+def main() -> None:
+    sf = float(os.environ.get("PRESTO_TPU_BENCH_SF", "1.0"))
+    reps = int(os.environ.get("PRESTO_TPU_BENCH_REPS", "5"))
+
+    import jax
+
+    from presto_tpu import Engine
+    from presto_tpu.benchmarks import q1_plan
+    from presto_tpu.benchmarks.handq import _days
+    from presto_tpu.connectors.tpch import TpchConnector
+    from presto_tpu.exec.executor import collect_scans, make_traced
+
+    engine = Engine()
+    engine.register_catalog("tpch", TpchConnector(scale=sf))
+    plan = q1_plan()
+    scan_inputs = collect_scans(plan, engine)
+    nrows = scan_inputs[0].nrows
+
+    traced_fn, flat_arrays, _meta = make_traced(scan_inputs, plan, {})
+    device_args = [jax.device_put(a) for a in flat_arrays]
+    compiled = jax.jit(traced_fn)
+    jax.block_until_ready(compiled(*device_args))  # compile + warmup
+
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        jax.block_until_ready(compiled(*device_args))
+        times.append(time.perf_counter() - t0)
+    best = min(times)
+    rows_per_sec = nrows / best
+
+    # single-thread NumPy baseline (config-1 stand-in)
+    li = {sym: np.asarray(a) for sym, a in
+          zip(scan_inputs[0].arrays, flat_arrays)}
+    base_times = [numpy_q1_baseline(li, _days("1998-09-02"))
+                  for _ in range(3)]
+    base_rows_per_sec = nrows / min(base_times)
+
+    print(json.dumps({
+        "metric": f"tpch_q1_sf{sf:g}_rows_per_sec",
+        "value": round(rows_per_sec),
+        "unit": "rows/s",
+        "vs_baseline": round(rows_per_sec / base_rows_per_sec, 3),
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,1 @@
+from presto_tpu.benchmarks.handq import q1_plan, q6_plan  # noqa: F401

@@ -140,6 +140,18 @@ class PlanInterpreter:
         self._note_ok(node, ok)
         return out
 
+    def _r_crossjoin(self, node: N.CrossJoin) -> DTable:
+        left = self.run(node.left)
+        right = self.run(node.right)
+        if not node.scalar:
+            raise NotImplementedError(
+                "general (non-scalar) cross join not supported yet")
+        return OP.apply_cross_scalar(left, right)
+
+    def _r_union(self, node: N.Union) -> DTable:
+        parts = [self.run(s) for s in node.inputs]
+        return OP.apply_union(parts, node)
+
     def _r_sort(self, node: N.Sort) -> DTable:
         return OP.apply_sort(self.run(node.source), node.orderings)
 
@@ -166,37 +178,47 @@ class PlanInterpreter:
         return DTable({s: src.cols[s] for s in node.symbols}, src.live, src.n)
 
 
+def make_traced(scan_inputs: list[ScanInput], plan: N.PlanNode,
+                capacities: dict[int, int]):
+    """Build (traced_fn, flat_example_args, meta). ``traced_fn`` is a pure
+    jittable function from flat scan arrays to
+    (result columns, live mask, ok flags); ``meta`` is populated at trace
+    time with output schema and hash-capacity bookkeeping."""
+    flat_arrays = [
+        scan.arrays[sym] for scan in scan_inputs for sym in scan.arrays]
+    meta: dict[str, object] = {}
+
+    def traced_fn(*args):
+        it = iter(args)
+        scans = {}
+        for scan in scan_inputs:
+            traced = {sym: next(it) for sym in scan.arrays}
+            scans[id(scan.node)] = (scan, traced)
+        interp = PlanInterpreter(scans, capacities)
+        out = interp.run(plan)
+        meta["out"] = [
+            (sym, v.dtype, v.dictionary, v.valid is not None)
+            for sym, v in out.cols.items()]
+        meta["ok_nodes"] = interp.ok_nodes
+        meta["used_capacity"] = interp.used_capacity
+        res = []
+        for sym, v in out.cols.items():
+            res.append(v.data)
+            res.append(v.valid if v.valid is not None
+                       else jnp.ones((out.n,), dtype=bool))
+        return tuple(res), out.live_mask(), tuple(interp.ok_flags)
+
+    return traced_fn, flat_arrays, meta
+
+
 def execute_plan(engine, plan: N.PlanNode) -> Table:
     """Compile + run a logical plan on the local device."""
     scan_inputs = collect_scans(plan, engine)
     capacities: dict[int, int] = {}
 
     for _attempt in range(8):
-        flat_arrays = [
-            scan.arrays[sym] for scan in scan_inputs for sym in scan.arrays]
-
-        meta: dict[str, tuple] = {}
-
-        def traced_fn(*args):
-            it = iter(args)
-            scans = {}
-            for scan in scan_inputs:
-                traced = {sym: next(it) for sym in scan.arrays}
-                scans[id(scan.node)] = (scan, traced)
-            interp = PlanInterpreter(scans, capacities)
-            out = interp.run(plan)
-            meta["out"] = [
-                (sym, v.dtype, v.dictionary, v.valid is not None)
-                for sym, v in out.cols.items()]
-            meta["ok_nodes"] = interp.ok_nodes
-            meta["used_capacity"] = interp.used_capacity
-            res = []
-            for sym, v in out.cols.items():
-                res.append(v.data)
-                res.append(v.valid if v.valid is not None
-                           else jnp.ones((out.n,), dtype=bool))
-            return tuple(res), out.live_mask(), tuple(interp.ok_flags)
-
+        traced_fn, flat_arrays, meta = make_traced(
+            scan_inputs, plan, capacities)
         compiled = jax.jit(traced_fn)
         res, live, oks = compiled(*flat_arrays)
         if all(bool(o) for o in oks):

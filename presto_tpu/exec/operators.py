@@ -212,16 +212,79 @@ def apply_join(left: DTable, right: DTable, node: N.Join,
 
 def apply_semijoin(dt: DTable, filt: DTable, node: N.SemiJoin,
                    capacity: int) -> tuple:
-    build_live = _and_key_valid(filt, [node.filter_key], filt.live_mask())
-    probe_live = _and_key_valid(dt, [node.source_key], dt.live_mask())
-    fh = _row_hash(filt, [node.filter_key])
+    build_live = _and_key_valid(filt, node.filter_keys, filt.live_mask())
+    probe_live = _and_key_valid(dt, node.source_keys, dt.live_mask())
+    fh = _row_hash(filt, node.filter_keys)
     table, table_row, ok = H.build_join_table(fh, build_live, capacity)
-    sh = _row_hash(dt, [node.source_key])
+    sh = _row_hash(dt, node.source_keys)
     _, found, probe_ok = H.probe_join_table(table, table_row, sh, probe_live)
     ok = ok & probe_ok
     out = dict(dt.cols)
     out[node.output] = Val(T.BOOLEAN, found, None)
     return DTable(out, dt.live, dt.n), ok
+
+
+def apply_cross_scalar(left: DTable, right: DTable) -> DTable:
+    """Cross join against a single-row relation (uncorrelated scalar
+    subquery; reference EnforceSingleRowNode + JoinNode w/o criteria):
+    broadcast the scalar row's columns over the probe side."""
+    rlive = right.live_mask()
+    # index of the single live row (0 if none; validity handles empties)
+    idx = jnp.argmax(rlive.astype(jnp.int32))
+    any_live = jnp.any(rlive)
+    out = dict(left.cols)
+    for sym, v in right.cols.items():
+        data = jnp.broadcast_to(v.data[idx], (left.n,))
+        rv = any_live if v.valid is None else (any_live & v.valid[idx])
+        valid = jnp.broadcast_to(rv, (left.n,))
+        out[sym] = Val(v.dtype, data, valid, v.dictionary)
+    return DTable(out, left.live, left.n)
+
+
+def _unify_string_vals(vals: list[Val]) -> list[Val]:
+    """Remap string Vals onto one shared sorted union dictionary."""
+    dicts = [v.dictionary for v in vals]
+    if all(d is dicts[0] for d in dicts):
+        return vals
+    union = np.unique(np.concatenate([d.astype("U") for d in dicts]))
+    uobj = union.astype(object)
+    out = []
+    for v in vals:
+        remap = jnp.asarray(
+            np.searchsorted(union, v.dictionary.astype("U"))
+            .astype(np.int32))
+        out.append(Val(v.dtype, remap[v.data], v.valid, uobj))
+    return out
+
+
+def apply_union(parts: list[DTable], node: N.Union) -> DTable:
+    """UNION ALL: concatenate columns (static total capacity = sum of
+    input capacities), remapping each input's symbols per node.mappings
+    and merging string dictionaries (reference plan/UnionNode.java)."""
+    n = sum(p.n for p in parts)
+    out: dict[str, Val] = {}
+    for sym in node.symbols:
+        dtype = node.types[sym]
+        vals = []
+        for p, mapping in zip(parts, node.mappings):
+            v = p.cols[mapping[sym]]
+            vals.append(v if v.is_string else cast_val(v, dtype))
+        if isinstance(dtype, T.VarcharType):
+            vals = _unify_string_vals(vals)
+        data = jnp.concatenate([
+            jnp.broadcast_to(v.data, (p.n,))
+            for v, p in zip(vals, parts)])
+        if any(v.valid is not None for v in vals):
+            valid = jnp.concatenate([
+                v.valid if v.valid is not None
+                else jnp.ones((p.n,), dtype=bool)
+                for v, p in zip(vals, parts)])
+        else:
+            valid = None
+        out[sym] = Val(dtype, data, valid,
+                       vals[0].dictionary if vals[0].is_string else None)
+    live = jnp.concatenate([p.live_mask() for p in parts])
+    return DTable(out, live, n)
 
 
 def _sort_perm(dt: DTable, orderings: list[N.Ordering]):

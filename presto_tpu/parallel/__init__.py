@@ -1,0 +1,10 @@
+"""Distributed execution: device meshes, collective exchanges, sharded
+fragment runner.
+
+The TPU-native replacement of the reference's HTTP data plane
+(core/trino-main/src/main/java/io/trino/execution/buffer/OutputBuffer.java,
+operator/ExchangeClient.java:56): inside a slice, repartitioning rides ICI
+via `jax.lax.all_to_all` / `psum` under `shard_map`; partial->final
+aggregation is a local fold + hash repartition + merge, the analog of
+PushPartialAggregationThroughExchange.
+"""

@@ -1,0 +1,89 @@
+"""Collective exchange kernels used inside shard_map fragments.
+
+Each exchange mirrors one of the reference's distribution modes
+(sql/planner/SystemPartitioningHandle.java:58-66, data plane
+execution/buffer/PagesSerde.java + operator/ExchangeClient.java):
+
+- FIXED_HASH repartition  -> bucket rows by hash into fixed-capacity
+  per-destination buffers + `lax.all_to_all`  (the ICI analog of
+  PartitionedOutputOperator.partitionPage, PartitionedOutputOperator.java:417)
+- FIXED_BROADCAST         -> `lax.all_gather`
+- SINGLE / gather         -> `lax.all_gather` then masked to one shard
+- partial-aggregate tree  -> `lax.psum` of state columns
+
+Because ICI collectives need static shapes, repartition uses the
+two-phase contract flagged in SURVEY.md §7: rows are scattered into a
+[num_parts, capacity] buffer with a validity mask; overflow is reported
+to the host, which retries with a larger capacity (same protocol as the
+hash-table kernels in ops/hash.py).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def bucket_by_partition(cols: dict, live, part_id, num_parts: int,
+                        capacity: int):
+    """Scatter rows into per-destination fixed-size buckets.
+
+    cols: name -> array[N]; live: bool[N]; part_id: int32[N] in
+    [0, num_parts). Returns (bucketed cols name -> [num_parts, capacity],
+    valid [num_parts, capacity], ok scalar bool).
+    """
+    n = part_id.shape[0]
+    onehot = (part_id[:, None] == jnp.arange(num_parts, dtype=part_id.dtype)
+              [None, :]) & live[:, None]
+    rank = jnp.cumsum(onehot.astype(jnp.int32), axis=0) - 1  # [N, P]
+    myrank = jnp.take_along_axis(
+        rank, jnp.clip(part_id, 0, num_parts - 1)[:, None], 1)[:, 0]
+    ok = jnp.all(jnp.where(live, myrank < capacity, True))
+    flat_dest = jnp.where(
+        live & (myrank < capacity),
+        jnp.clip(part_id, 0, num_parts - 1) * capacity + myrank,
+        num_parts * capacity)  # out-of-range -> dropped
+    out = {}
+    for name, a in cols.items():
+        buf = jnp.zeros((num_parts * capacity,), dtype=a.dtype)
+        buf = buf.at[flat_dest].set(a, mode="drop")
+        out[name] = buf.reshape(num_parts, capacity)
+    valid = jnp.zeros((num_parts * capacity,), dtype=bool)
+    valid = valid.at[flat_dest].set(live, mode="drop")
+    return out, valid.reshape(num_parts, capacity), ok
+
+
+def all_to_all_exchange(bucketed: dict, valid, axis_name: str):
+    """Exchange [num_parts, capacity] buckets so shard p receives every
+    shard's bucket p. Returns (cols name -> [num_parts*capacity], valid)."""
+    out = {}
+    for name, a in bucketed.items():
+        ex = jax.lax.all_to_all(a, axis_name, split_axis=0, concat_axis=0)
+        out[name] = ex.reshape(-1)
+    v = jax.lax.all_to_all(valid, axis_name, split_axis=0, concat_axis=0)
+    return out, v.reshape(-1)
+
+
+def repartition(cols: dict, live, part_id, num_parts: int, capacity: int,
+                axis_name: str):
+    """hash-repartition rows across the mesh axis: bucket + all_to_all.
+
+    Returns (cols [num_parts*capacity], valid, ok). ok=False on any
+    bucket overflow (host retries with doubled capacity)."""
+    bucketed, bvalid, ok = bucket_by_partition(
+        cols, live, part_id, num_parts, capacity)
+    ex, valid = all_to_all_exchange(bucketed, bvalid, axis_name)
+    ok = jax.lax.pmin(ok.astype(jnp.int32), axis_name) > 0
+    return ex, valid, ok
+
+
+def broadcast_gather(cols: dict, live, axis_name: str):
+    """FIXED_BROADCAST / gather: replicate every shard's rows to all
+    shards (build sides of broadcast joins; SINGLE-stage inputs).
+    Returns (cols [num_shards*N], valid)."""
+    out = {}
+    for name, a in cols.items():
+        g = jax.lax.all_gather(a, axis_name)  # [S, N, ...]
+        out[name] = g.reshape((-1,) + a.shape[1:])
+    v = jax.lax.all_gather(live, axis_name)
+    return out, v.reshape(-1)

@@ -183,14 +183,24 @@ class Join(PlanNode):
 @dataclasses.dataclass
 class SemiJoin(PlanNode):
     """source rows tested for membership in filter_source keys
-    (plan/SemiJoinNode.java); adds boolean output symbol."""
+    (plan/SemiJoinNode.java, multi-key form for decorrelated EXISTS);
+    adds boolean output symbol."""
 
     source: PlanNode = None  # type: ignore[assignment]
     filter_source: PlanNode = None  # type: ignore[assignment]
-    source_key: str = ""
-    filter_key: str = ""
+    source_keys: list[str] = dataclasses.field(default_factory=list)
+    filter_keys: list[str] = dataclasses.field(default_factory=list)
     output: str = ""
     negated: bool = False  # NOT IN / NOT EXISTS handled at planner level
+
+    # single-key compatibility accessors
+    @property
+    def source_key(self) -> str:
+        return self.source_keys[0]
+
+    @property
+    def filter_key(self) -> str:
+        return self.filter_keys[0]
 
     def sources(self):
         return [self.source, self.filter_source]
@@ -201,6 +211,49 @@ class SemiJoin(PlanNode):
 
     def output_types(self):
         return {**self.source.output_types(), self.output: T.BOOLEAN}
+
+
+@dataclasses.dataclass
+class CrossJoin(PlanNode):
+    """Cartesian product. The executor supports the scalar case (right
+    side is a single-row relation, e.g. an uncorrelated scalar subquery —
+    reference plan/JoinNode with empty criteria + EnforceSingleRowNode);
+    the general case expands to left_n * right_n rows."""
+
+    left: PlanNode = None  # type: ignore[assignment]
+    right: PlanNode = None  # type: ignore[assignment]
+    scalar: bool = True  # right side guaranteed single row
+
+    def sources(self):
+        return [self.left, self.right]
+
+    @property
+    def output_symbols(self):
+        return self.left.output_symbols + self.right.output_symbols
+
+    def output_types(self):
+        return {**self.left.output_types(), **self.right.output_types()}
+
+
+@dataclasses.dataclass
+class Union(PlanNode):
+    """UNION ALL concatenation (plan/UnionNode.java). ``mappings`` maps
+    each output symbol to the corresponding input symbol per source."""
+
+    inputs: list[PlanNode] = dataclasses.field(default_factory=list)
+    symbols: list[str] = dataclasses.field(default_factory=list)
+    types: dict[str, T.DataType] = dataclasses.field(default_factory=dict)
+    mappings: list[dict[str, str]] = dataclasses.field(default_factory=list)
+
+    def sources(self):
+        return list(self.inputs)
+
+    @property
+    def output_symbols(self):
+        return list(self.symbols)
+
+    def output_types(self):
+        return dict(self.types)
 
 
 @dataclasses.dataclass(frozen=True)

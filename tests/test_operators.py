@@ -151,7 +151,8 @@ def test_hand_semijoin_and_topn(engine, oracle):
     lscan = _scan("lineitem", ["l_orderkey", "l_quantity"], [T.BIGINT, DEC2])
     lfilt = N.Filter(lscan, ir.Call(T.BOOLEAN, "gt", (
         ref("l_quantity", DEC2), ir.Literal(DEC2, 4900))))
-    semi = N.SemiJoin(oscan, lfilt, "o_orderkey", "l_orderkey", "has_big")
+    semi = N.SemiJoin(oscan, lfilt, ["o_orderkey"], ["l_orderkey"],
+                      "has_big")
     filt = N.Filter(semi, ref("has_big", T.BOOLEAN))
     topn = N.TopN(filt, 5, [N.Ordering("o_totalprice", ascending=False),
                             N.Ordering("o_orderkey")])
