@@ -67,9 +67,9 @@ def q1_plan(catalog: str = "tpch") -> N.PlanNode:
         "sum_base_price": AggCall("sum", _ref("l_extendedprice", DEC2), SUM2),
         "sum_disc_price": AggCall("sum", _ref("disc_price", DEC4), DEC4),
         "sum_charge": AggCall("sum", _ref("charge", DEC6), DEC6),
-        "avg_qty": AggCall("avg", _ref("l_quantity", DEC2), SUM2),
-        "avg_price": AggCall("avg", _ref("l_extendedprice", DEC2), SUM2),
-        "avg_disc": AggCall("avg", _ref("l_discount", DEC2), SUM2),
+        "avg_qty": AggCall("avg", _ref("l_quantity", DEC2), T.DOUBLE),
+        "avg_price": AggCall("avg", _ref("l_extendedprice", DEC2), T.DOUBLE),
+        "avg_disc": AggCall("avg", _ref("l_discount", DEC2), T.DOUBLE),
         "count_order": AggCall("count_star", None, T.BIGINT),
     })
     sort = N.Sort(agg, [N.Ordering("l_returnflag"),
@@ -84,8 +84,7 @@ Q1_SQL_SQLITE = (
     "SELECT l_returnflag, l_linestatus, sum(l_quantity), "
     "sum(l_extendedprice), sum(l_extendedprice * (1 - l_discount)), "
     "sum(l_extendedprice * (1 - l_discount) * (1 + l_tax)), "
-    "round(avg(l_quantity), 2), round(avg(l_extendedprice), 2), "
-    "round(avg(l_discount), 2), count(*) "
+    "avg(l_quantity), avg(l_extendedprice), avg(l_discount), count(*) "
     "FROM lineitem WHERE l_shipdate <= '1998-09-02' "
     "GROUP BY l_returnflag, l_linestatus "
     "ORDER BY l_returnflag, l_linestatus")

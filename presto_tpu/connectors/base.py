@@ -39,3 +39,14 @@ class Connector:
 
     def stats(self, name: str) -> TableStats:
         raise NotImplementedError
+
+    def row_count_estimate(self, name: str) -> int:
+        """Cheap row-count estimate for join ordering (must not force data
+        generation; analog of spi ConnectorMetadata.getTableStatistics)."""
+        return self.stats(name).row_count
+
+    def unique_keys(self, name: str) -> list[tuple[str, ...]]:
+        """Column sets known unique (primary keys). Lets the planner pick
+        the single-match hash-join fast path (reference JoinNode's
+        maySkipOutputDuplicates analog)."""
+        return []

@@ -381,6 +381,31 @@ class TpchConnector(Connector):
             self._tables[name] = Table.from_numpy(SCHEMAS[name], self._raw(name))
         return self._tables[name]
 
+    _BASE_ROWS = {
+        "region": 5, "nation": 25, "supplier": 10_000, "part": 200_000,
+        "partsupp": 800_000, "customer": 150_000, "orders": 1_500_000,
+        "lineitem": 6_000_000,
+    }
+    _UNIQUE_KEYS = {
+        "region": [("r_regionkey",)],
+        "nation": [("n_nationkey",)],
+        "supplier": [("s_suppkey",)],
+        "part": [("p_partkey",)],
+        "partsupp": [("ps_partkey", "ps_suppkey")],
+        "customer": [("c_custkey",)],
+        "orders": [("o_orderkey",)],
+        "lineitem": [("l_orderkey", "l_linenumber")],
+    }
+
+    def row_count_estimate(self, name: str) -> int:
+        base = self._BASE_ROWS[name]
+        if name in ("region", "nation"):
+            return base
+        return max(1, int(base * self.scale))
+
+    def unique_keys(self, name: str) -> list[tuple[str, ...]]:
+        return list(self._UNIQUE_KEYS.get(name, []))
+
     def stats(self, name: str) -> TableStats:
         raw = self._raw(name)
         nrows = len(next(iter(raw.values())))

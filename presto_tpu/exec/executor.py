@@ -64,25 +64,29 @@ class PlanInterpreter:
     """Walks the plan during trace, building the XLA computation."""
 
     def __init__(self, scans: dict[int, tuple[ScanInput, dict]],
-                 capacities: dict[int, int]):
+                 capacities: dict[tuple, int]):
         self.scans = scans  # id(node) -> (ScanInput, traced arrays)
-        self.capacities = capacities  # id(node) -> forced capacity
+        self.capacities = capacities  # (id(node), kind) -> forced capacity
         self.ok_flags: list = []
-        self.ok_nodes: list[int] = []
-        self.used_capacity: dict[int, int] = {}
+        self.ok_keys: list[tuple] = []
+        self.used_capacity: dict[tuple, int] = {}
 
     def run(self, node: N.PlanNode) -> DTable:
         m = getattr(self, "_r_" + type(node).__name__.lower())
         return m(node)
 
-    def _capacity(self, node, default: int) -> int:
-        cap = self.capacities.get(id(node), default)
-        self.used_capacity[id(node)] = cap
+    def _capacity(self, node, default: int, kind: str = "table") -> int:
+        cap = self.capacities.get((id(node), kind))
+        if cap is None:
+            hint = (getattr(node, "capacity", None) if kind == "table"
+                    else getattr(node, "output_capacity", None))
+            cap = hint or default
+        self.used_capacity[(id(node), kind)] = cap
         return cap
 
-    def _note_ok(self, node, ok):
+    def _note_ok(self, node, ok, kind: str = "table"):
         self.ok_flags.append(ok)
-        self.ok_nodes.append(id(node))
+        self.ok_keys.append((id(node), kind))
 
     def _r_tablescan(self, node: N.TableScan) -> DTable:
         scan, traced = self.scans[id(node)]
@@ -128,8 +132,16 @@ class PlanInterpreter:
         left = self.run(node.left)
         right = self.run(node.right)
         cap = self._capacity(node, next_pow2(2 * right.n))
-        out, ok = OP.apply_join(left, right, node, cap)
-        self._note_ok(node, ok)
+        if node.build_unique:
+            out, ok = OP.apply_join(left, right, node, cap)
+            self._note_ok(node, ok)
+            return out
+        out_cap = self._capacity(node, next_pow2(2 * (left.n + right.n)),
+                                 "out")
+        out, t_ok, o_ok = OP.apply_expand_join(left, right, node, cap,
+                                               out_cap)
+        self._note_ok(node, t_ok)
+        self._note_ok(node, o_ok, "out")
         return out
 
     def _r_semijoin(self, node: N.SemiJoin) -> DTable:
@@ -159,7 +171,8 @@ class PlanInterpreter:
         return OP.apply_topn(self.run(node.source), node.count, node.orderings)
 
     def _r_limit(self, node: N.Limit) -> DTable:
-        return OP.apply_limit(self.run(node.source), node.count)
+        return OP.apply_limit(self.run(node.source), node.count,
+                              node.offset)
 
     def _r_distinct(self, node: N.Distinct) -> DTable:
         src = self.run(node.source)
@@ -199,7 +212,7 @@ def make_traced(scan_inputs: list[ScanInput], plan: N.PlanNode,
         meta["out"] = [
             (sym, v.dtype, v.dictionary, v.valid is not None)
             for sym, v in out.cols.items()]
-        meta["ok_nodes"] = interp.ok_nodes
+        meta["ok_keys"] = interp.ok_keys
         meta["used_capacity"] = interp.used_capacity
         res = []
         for sym, v in out.cols.items():
@@ -214,20 +227,21 @@ def make_traced(scan_inputs: list[ScanInput], plan: N.PlanNode,
 def execute_plan(engine, plan: N.PlanNode) -> Table:
     """Compile + run a logical plan on the local device."""
     scan_inputs = collect_scans(plan, engine)
-    capacities: dict[int, int] = {}
+    capacities: dict[tuple, int] = {}
 
-    for _attempt in range(8):
+    for _attempt in range(10):
         traced_fn, flat_arrays, meta = make_traced(
             scan_inputs, plan, capacities)
         compiled = jax.jit(traced_fn)
         res, live, oks = compiled(*flat_arrays)
         if all(bool(o) for o in oks):
             break
-        # a hash table overflowed: double that node's capacity and recompile
-        # (host-side analog of the reference's rehash)
-        for nid, okv in zip(meta["ok_nodes"], oks):
+        # a hash table (or expand-join output) overflowed: double that
+        # node's capacity and recompile (host-side analog of the
+        # reference's rehash, MultiChannelGroupByHash.java:140)
+        for key, okv in zip(meta["ok_keys"], oks):
             if not bool(okv):
-                capacities[nid] = 2 * meta["used_capacity"][nid]
+                capacities[key] = 2 * meta["used_capacity"][key]
     else:
         raise RuntimeError("hash table capacity retry limit exceeded")
 

@@ -119,7 +119,8 @@ def apply_aggregate(dt: DTable, node: N.Aggregate, capacity: int) -> tuple:
             if val_state is not None:
                 out_dictionary = val_state.dictionary
             states = A.merge(call.fn, states, safe_slots, capacity, live)
-            arg_type = None
+            sum_state = dt.cols.get(f"{sym}$sum")
+            arg_type = sum_state.dtype if sum_state is not None else None
         else:
             if call.arg is not None:
                 av = c.compile(call.arg)
@@ -137,7 +138,7 @@ def apply_aggregate(dt: DTable, node: N.Aggregate, capacity: int) -> tuple:
         if node.step == N.AggStep.PARTIAL:
             for f, arr in states.items():
                 out[f"{sym}${f}"] = Val(
-                    T.BIGINT if f == "count" else call.dtype, arr, None,
+                    A.state_type(call, f), arr, None,
                     _arg_dictionary(c, call.arg) if f == "val" and call.arg
                     is not None else None)
         else:
@@ -198,7 +199,9 @@ def apply_join(left: DTable, right: DTable, node: N.Join,
     if node.join_type == N.JoinType.INNER:
         live = probe_live & found
     elif node.join_type == N.JoinType.LEFT:
-        live = probe_live
+        # probe rows with NULL keys survive a LEFT join (they match
+        # nothing): use the full live mask, not the key-valid one
+        live = left.live_mask()
         # un-matched rows: right columns become NULL
         for sym in right.cols:
             v = out[sym]
@@ -208,6 +211,58 @@ def apply_join(left: DTable, right: DTable, node: N.Join,
     else:
         raise NotImplementedError(f"join type {node.join_type}")
     return DTable(out, live, left.n), ok
+
+
+def apply_expand_join(left: DTable, right: DTable, node: N.Join,
+                      capacity: int, out_capacity: int) -> tuple:
+    """Expanding (many-to-many) hash join: every (probe, build) match
+    becomes one output row (reference LookupJoinOperator + PositionLinks
+    chains, operator/join/JoinProbe.java). Output has static capacity
+    ``out_capacity``; overflow reported for host retry.
+
+    Returns (DTable [out_capacity], table_ok, out_ok)."""
+    lkeys = [lk for lk, _ in node.criteria]
+    rkeys = [rk for _, rk in node.criteria]
+    build_live = _and_key_valid(right, rkeys, right.live_mask())
+    probe_live = _and_key_valid(left, lkeys, left.live_mask())
+    left_join = node.join_type == N.JoinType.LEFT
+    if left_join:
+        # left-join preserves probe rows with NULL keys (they just match
+        # nothing); only the probe lookup masks them out
+        probe_rows_live = left.live_mask()
+    else:
+        probe_rows_live = probe_live
+
+    rh = _row_hash(right, rkeys)
+    table, counts, offsets, build_order, t_ok = H.build_join_multimap(
+        rh, build_live, capacity)
+    ph = _row_hash(left, lkeys)
+    slot, found, p_ok = H.probe_join_slot(table, ph, probe_live)
+    probe_idx, build_row, out_live, o_ok = H.expand_matches(
+        counts, offsets, build_order, slot, found & probe_live,
+        probe_rows_live, out_capacity, left_join)
+
+    out: dict[str, Val] = {}
+    for sym, v in left.cols.items():
+        data = v.data[probe_idx]
+        valid = None if v.valid is None else v.valid[probe_idx]
+        out[sym] = Val(v.dtype, data, valid, v.dictionary)
+    matched = build_row >= 0
+    gather = jnp.clip(build_row, 0, right.n - 1)
+    for sym, v in right.cols.items():
+        data = v.data[gather]
+        valid = matched if v.valid is None else (matched & v.valid[gather])
+        out[sym] = Val(v.dtype, data, valid, v.dictionary)
+
+    if node.filter is not None:
+        if left_join:
+            raise NotImplementedError(
+                "residual filter on expanding LEFT join")
+        fv = ExprCompiler(out).compile(node.filter)
+        f_ok = fv.data if fv.valid is None else (fv.data & fv.valid)
+        out_live = out_live & f_ok
+
+    return DTable(out, out_live, out_capacity), t_ok & p_ok, o_ok
 
 
 def apply_semijoin(dt: DTable, filt: DTable, node: N.SemiJoin,
@@ -342,9 +397,10 @@ def apply_topn(dt: DTable, count: int, orderings: list[N.Ordering]) -> DTable:
     return DTable(out.cols, live, dt.n)
 
 
-def apply_limit(dt: DTable, count: int) -> DTable:
+def apply_limit(dt: DTable, count: int, offset: int = 0) -> DTable:
     live = dt.live_mask()
-    keep = jnp.cumsum(live.astype(jnp.int64)) <= count
+    pos = jnp.cumsum(live.astype(jnp.int64))
+    keep = (pos > offset) & (pos <= offset + count)
     return DTable(dt.cols, live & keep, dt.n)
 
 

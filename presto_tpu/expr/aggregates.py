@@ -49,13 +49,32 @@ def output_type(fn: str, arg_type: T.DataType | None) -> T.DataType:
             return T.DOUBLE
         return T.BIGINT
     if fn == "avg":
-        if isinstance(arg_type, T.DecimalType):
-            # reference: avg(decimal(p,s)) -> decimal(p,s)
-            return T.DecimalType(18, arg_type.scale)
+        # DOUBLE regardless of input: matches the reference engine's
+        # behavior on its tpch catalog (whose numeric columns are DOUBLE,
+        # plugin/trino-tpch TpchMetadata) and keeps full precision
         return T.DOUBLE
     if fn in ("min", "max", "arbitrary"):
         return arg_type
     raise NotImplementedError(f"aggregate {fn}")
+
+
+def state_type(call: "AggCall", field: str) -> T.DataType:
+    """Type of one partial-state column (the wire schema of partial
+    aggregation states shipped through exchanges)."""
+    if field == "count":
+        return T.BIGINT
+    if field == "sum":
+        if call.fn == "avg":
+            at = call.arg.dtype if call.arg is not None else T.BIGINT
+            if isinstance(at, T.DecimalType):
+                return T.DecimalType(18, at.scale)
+            if isinstance(at, T.DoubleType):
+                return T.DOUBLE
+            return T.BIGINT
+        return call.dtype
+    if field == "val":
+        return call.arg.dtype if call.arg is not None else call.dtype
+    raise NotImplementedError(field)
 
 
 # state column suffixes per function (partial aggregation schema)
@@ -145,12 +164,10 @@ def finalize(fn: str, states: dict, out_type: T.DataType,
     if fn == "avg":
         s, c = states["sum"], states["count"]
         safe = jnp.maximum(c, 1)
-        if isinstance(out_type, T.DecimalType):
-            # integer rounding half up, reference AverageAggregations semantics
-            half = safe // 2
-            q = jnp.where(s >= 0, (s + half) // safe, -((-s + half) // safe))
-            return q, c > 0
-        return s.astype(jnp.float64) / safe.astype(jnp.float64), c > 0
+        sf = s.astype(jnp.float64)
+        if isinstance(arg_type, T.DecimalType):
+            sf = sf / arg_type.unscale_factor
+        return sf / safe.astype(jnp.float64), c > 0
     if fn in ("min", "max", "arbitrary"):
         return states["val"], states["count"] > 0
     raise NotImplementedError(fn)

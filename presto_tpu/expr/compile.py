@@ -341,8 +341,12 @@ def _div(e, args):
             (jnp.abs(num) + jnp.abs(den) // 2) // jnp.abs(den),
             -((jnp.abs(num) + jnp.abs(den) // 2) // jnp.abs(den)))
         return Val(e.dtype, q, and_valid(valid, b.data != 0))
+    # SQL integer division truncates toward zero (floor differs on
+    # negatives)
     safe = jnp.where(b.data == 0, 1, b.data)
-    return Val(e.dtype, a.data // safe, and_valid(valid, b.data != 0))
+    q = jnp.abs(a.data) // jnp.abs(safe)
+    q = jnp.where((a.data >= 0) == (safe >= 0), q, -q)
+    return Val(e.dtype, q, and_valid(valid, b.data != 0))
 
 
 @scalar("modulus")
@@ -511,6 +515,39 @@ def _civil_from_days(days):
     return y, m, d
 
 
+def _days_from_civil(y, m, d):
+    """Inverse of _civil_from_days (Hinnant's days_from_civil)."""
+    y = y - (m <= 2)
+    era = jnp.floor_divide(y, 400)
+    yoe = y - era * 400
+    mp = m + jnp.where(m > 2, -3, 9)
+    doy = (153 * mp + 2) // 5 + d - 1
+    doe = yoe * 365 + yoe // 4 - yoe // 100 + doy
+    return era * 146097 + doe - 719468
+
+
+@scalar("add_months")
+def _add_months(e, args):
+    """date + N months [+ D days] with day-of-month clamping (reference
+    DateTimeFunctions.addFieldValueDate semantics)."""
+    a, months = args[0], args[1]
+    days = args[2] if len(args) > 2 else None
+    y, m, d = _civil_from_days(a.data)
+    total = (y * 12 + (m - 1)) + months.data
+    ny = jnp.floor_divide(total, 12)
+    nm = total - ny * 12 + 1
+    # clamp day to target month length
+    month_days = jnp.asarray(
+        [31, 28, 31, 30, 31, 30, 31, 31, 30, 31, 30, 31])[nm - 1]
+    leap = ((ny % 4 == 0) & (ny % 100 != 0)) | (ny % 400 == 0)
+    month_days = jnp.where((nm == 2) & leap, 29, month_days)
+    nd = jnp.minimum(d, month_days)
+    out = _days_from_civil(ny, nm, nd)
+    if days is not None:
+        out = out + days.data
+    return Val(e.dtype, out.astype(jnp.int32), a.valid)
+
+
 @scalar("year")
 def _year(e, args):
     (a,) = args
@@ -537,10 +574,13 @@ def _day(e, args):
 
 @scalar("substring")
 def _substring(e, args):
-    col, start = args[0], args[1]
-    length = args[2] if len(args) > 2 else None
-    s0 = int(np.asarray(start.data))  # literal-only start (SQL 1-based)
-    ln = None if length is None else int(np.asarray(length.data))
+    col = args[0]
+    # start/length must be literals: read them from the IR, not traced
+    # values (string ops run host-side over the dictionary)
+    if not all(isinstance(a, ir.Literal) for a in e.args[1:]):
+        raise NotImplementedError("substring with non-literal start/length")
+    s0 = int(e.args[1].value)  # SQL 1-based
+    ln = int(e.args[2].value) if len(e.args) > 2 else None
 
     def f(d):
         if ln is None:
@@ -603,7 +643,11 @@ def _abs(e, args):
 @scalar("round")
 def _round(e, args):
     a = args[0]
-    digits = int(np.asarray(args[1].data)) if len(args) > 1 else 0
+    digits = 0
+    if len(e.args) > 1:
+        if not isinstance(e.args[1], ir.Literal):
+            raise NotImplementedError("round with non-literal digits")
+        digits = int(e.args[1].value)
     if isinstance(a.dtype, T.DecimalType):
         drop = a.dtype.scale - digits
         if drop <= 0:

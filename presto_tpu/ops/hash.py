@@ -89,10 +89,13 @@ def hash_string_column(codes, dictionary: np.ndarray, valid=None):
 
 
 def combine_hashes(hashes: list):
-    """Combine per-column hashes into one row hash."""
+    """Combine per-column hashes into one row hash. Order-dependent: the
+    accumulator is multiplied by an odd constant before xoring the next
+    column, so (a, b) and (b, a) key tuples don't collide (plain xor is
+    commutative)."""
     out = hashes[0]
     for h in hashes[1:]:
-        out = _splitmix64(out ^ h)
+        out = _splitmix64(out * jnp.uint64(0x100000001B3) ^ h)
     # keep the EMPTY sentinel unreachable
     return jnp.where(out == _EMPTY, out - jnp.uint64(1), out)
 
@@ -183,6 +186,99 @@ def probe_join_table(table_hash, table_row, row_hash, live,
         cond, body,
         (slot, found, active, build_row, jnp.asarray(0, jnp.int32)))
     return build_row, found, ~jnp.any(active)
+
+
+def build_join_multimap(row_hash, live, capacity: int, max_rounds: int = 64):
+    """Build-side of an expanding (many-to-many) hash join.
+
+    The analog of the reference's PagesHash + PositionLinks chains
+    (operator/join/PagesHash.java:35, JoinHash.java:28): instead of linked
+    row chains, build rows are bucketed contiguously — ``build_order``
+    lists build row indices grouped by slot, ``offsets[slot]`` is the
+    group start and ``counts[slot]`` the group size.
+
+    Returns (table_hash [capacity], counts [capacity], offsets [capacity],
+    build_order [n], ok).
+    """
+    n = row_hash.shape[0]
+    slot, table, ok = group_by_slots(row_hash, live, capacity, max_rounds)
+    eff = jnp.where(live, slot, capacity)
+    counts_ext = jax.ops.segment_sum(
+        jnp.ones((n,), jnp.int32), eff, num_segments=capacity + 1)
+    counts = counts_ext[:capacity]
+    offsets = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32),
+         jnp.cumsum(counts)[:-1].astype(jnp.int32)])
+    build_order = jnp.argsort(eff, stable=True).astype(jnp.int32)
+    return table, counts, offsets, build_order, ok
+
+
+def probe_join_slot(table_hash, row_hash, live, max_probes: int = 256):
+    """Find each probe row's matching table slot (linear probe until hash
+    hit or empty). Returns (slot int32 [N] (-1 = none), found bool [N],
+    ok)."""
+    capacity = table_hash.shape[0]
+    cap = jnp.uint64(capacity)
+    slot = (row_hash % cap).astype(jnp.int32)
+    found = jnp.zeros(row_hash.shape, dtype=bool)
+    out_slot = jnp.full(row_hash.shape, -1, dtype=jnp.int32)
+    active = live
+
+    def cond(state):
+        _, _, active, _, probes = state
+        return jnp.any(active) & (probes < max_probes)
+
+    def body(state):
+        slot, found, active, out_slot, probes = state
+        at = table_hash[slot]
+        hit = active & (at == row_hash)
+        empty = at == _EMPTY
+        out_slot = jnp.where(hit, slot, out_slot)
+        found = found | hit
+        active = active & ~hit & ~empty
+        slot = jnp.where(active, (slot + 1) % capacity, slot)
+        return slot, found, active, out_slot, probes + 1
+
+    _, found, active, out_slot, _ = jax.lax.while_loop(
+        cond, body,
+        (slot, found, active, out_slot, jnp.asarray(0, jnp.int32)))
+    return out_slot, found, ~jnp.any(active)
+
+
+def expand_matches(counts, offsets, build_order, probe_slot, probe_found,
+                   probe_live, out_capacity: int, left_join: bool):
+    """Expand probe rows into one output row per (probe, build) match.
+
+    For output position k: binary-search the probe row whose match range
+    covers k, then index its slot's bucket. Every step is a gather —
+    XLA/TPU friendly; no data-dependent shapes.
+
+    Returns (probe_idx int32 [out_capacity], build_row int32 [out_capacity]
+    (-1 = unmatched left row), out_live bool [out_capacity], ok).
+    """
+    safe_slot = jnp.clip(probe_slot, 0, counts.shape[0] - 1)
+    matches = jnp.where(probe_found & probe_live, counts[safe_slot], 0)
+    if left_join:
+        per_probe = jnp.where(probe_live,
+                              jnp.maximum(matches, 1), 0)
+    else:
+        per_probe = matches
+    prefix = jnp.concatenate(
+        [jnp.zeros((1,), per_probe.dtype), jnp.cumsum(per_probe)[:-1]])
+    total = prefix[-1] + per_probe[-1]
+    ok = total <= out_capacity
+    k = jnp.arange(out_capacity, dtype=prefix.dtype)
+    probe_idx = (jnp.searchsorted(prefix, k, side="right") - 1
+                 ).astype(jnp.int32)
+    safe_probe = jnp.clip(probe_idx, 0, per_probe.shape[0] - 1)
+    j = (k - prefix[safe_probe]).astype(jnp.int32)
+    p_slot = jnp.clip(probe_slot[safe_probe], 0, counts.shape[0] - 1)
+    matched = probe_found[safe_probe] & (j < counts[p_slot])
+    build_pos = jnp.clip(offsets[p_slot] + j, 0,
+                         build_order.shape[0] - 1)
+    build_row = jnp.where(matched, build_order[build_pos], -1)
+    out_live = k < total
+    return safe_probe, build_row, out_live, ok
 
 
 def next_pow2(x: int) -> int:

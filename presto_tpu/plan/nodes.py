@@ -112,6 +112,9 @@ class Aggregate(PlanNode):
     group_keys: list[str] = dataclasses.field(default_factory=list)
     aggs: dict[str, AggCall] = dataclasses.field(default_factory=dict)
     step: AggStep = AggStep.SINGLE
+    # planner hash-table capacity hint (None = executor default); the
+    # executor doubles + recompiles on kernel-reported overflow
+    capacity: int | None = None
 
     def sources(self):
         return [self.source]
@@ -134,13 +137,7 @@ class Aggregate(PlanNode):
         for s, call in self.aggs.items():
             if self.step == AggStep.PARTIAL:
                 for f in A.state_fields(call.fn):
-                    if f == "count":
-                        out[f"{s}${f}"] = T.BIGINT
-                    elif f in ("sum", "val"):
-                        out[f"{s}${f}"] = (
-                            call.dtype if call.fn != "avg" else
-                            (call.dtype if isinstance(call.dtype, T.DecimalType)
-                             else T.DOUBLE))
+                    out[f"{s}${f}"] = A.state_type(call, f)
             else:
                 out[s] = call.dtype
         return out
@@ -165,9 +162,13 @@ class Join(PlanNode):
     join_type: JoinType = JoinType.INNER
     criteria: list[tuple[str, str]] = dataclasses.field(default_factory=list)
     filter: Optional[ir.Expr] = None
-    # planner hint: probe-side rows match at most one build row (FK->PK)
+    # planner hint: probe-side rows match at most one build row (FK->PK,
+    # criteria cover a unique key of the build side)
     build_unique: bool = True
     distribution: str = "broadcast"  # broadcast | partitioned
+    capacity: int | None = None
+    # static output-row capacity for the expanding (many-to-many) path
+    output_capacity: int | None = None
 
     def sources(self):
         return [self.left, self.right]
@@ -192,6 +193,7 @@ class SemiJoin(PlanNode):
     filter_keys: list[str] = dataclasses.field(default_factory=list)
     output: str = ""
     negated: bool = False  # NOT IN / NOT EXISTS handled at planner level
+    capacity: int | None = None
 
     # single-key compatibility accessors
     @property
@@ -300,6 +302,7 @@ class TopN(PlanNode):
 class Limit(PlanNode):
     source: PlanNode = None  # type: ignore[assignment]
     count: int = 0
+    offset: int = 0
 
     def sources(self):
         return [self.source]
@@ -317,6 +320,7 @@ class Distinct(PlanNode):
     """SELECT DISTINCT — group-by on all columns, no aggregates."""
 
     source: PlanNode = None  # type: ignore[assignment]
+    capacity: int | None = None
 
     def sources(self):
         return [self.source]

@@ -1,0 +1,176 @@
+"""Logical plan optimizer passes.
+
+The reference runs ~90 optimizer passes (sql/planner/PlanOptimizers.java)
+over an iterative rule engine. The load-bearing rewrites for this engine's
+plans happen partly at plan time (join-graph ordering, predicate
+placement, decorrelation — see plan/planner.py); the passes here run on
+the finished plan:
+
+- prune_columns: projection pushdown all the way into table scans
+  (reference PruneUnreferencedOutputs + PushProjectionIntoTableScan) —
+  critical on TPU since every scanned column is an HBM-resident array.
+- inline_trivial_projects: collapse identity Project nodes
+  (reference RemoveRedundantIdentityProjections).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from presto_tpu.expr import ir
+from presto_tpu.plan import nodes as N
+
+
+def optimize(plan: N.PlanNode, engine) -> N.PlanNode:
+    plan = prune_columns(plan)
+    plan = inline_trivial_projects(plan)
+    return plan
+
+
+# ---------------------------------------------------------------------------
+
+
+def _expr_refs(*exprs) -> set[str]:
+    out: set[str] = set()
+    for e in exprs:
+        if e is not None:
+            out |= ir.referenced_columns([e])
+    return out
+
+
+def prune_columns(node: N.PlanNode,
+                  needed: set[str] | None = None) -> N.PlanNode:
+    """Rebuild the plan keeping only symbols consumed above each node."""
+    if isinstance(node, N.Output):
+        src = prune_columns(node.source, set(node.symbols))
+        return N.Output(src, node.names, node.symbols)
+
+    assert needed is not None
+
+    if isinstance(node, N.TableScan):
+        assigns = {s: c for s, c in node.assignments.items() if s in needed}
+        if not assigns:  # keep one column to preserve cardinality
+            first = next(iter(node.assignments))
+            assigns = {first: node.assignments[first]}
+        types = {s: node.types[s] for s in assigns}
+        return N.TableScan(node.catalog, node.table, assigns, types)
+
+    if isinstance(node, N.Values):
+        keep_idx = [i for i, s in enumerate(node.symbols)
+                    if s in needed] or [0]
+        symbols = [node.symbols[i] for i in keep_idx]
+        types = {s: node.types[s] for s in symbols}
+        rows = [[row[i] for i in keep_idx] for row in node.rows]
+        return N.Values(symbols, types, rows)
+
+    if isinstance(node, N.Filter):
+        src = prune_columns(node.source,
+                            needed | _expr_refs(node.predicate))
+        return N.Filter(src, node.predicate)
+
+    if isinstance(node, N.Project):
+        assigns = {s: e for s, e in node.assignments.items() if s in needed}
+        if not assigns:
+            first = next(iter(node.assignments))
+            assigns = {first: node.assignments[first]}
+        src = prune_columns(node.source, _expr_refs(*assigns.values()))
+        return N.Project(src, assigns)
+
+    if isinstance(node, N.Aggregate):
+        aggs = {s: c for s, c in node.aggs.items()
+                if node.step == N.AggStep.PARTIAL or s in needed}
+        child = set(node.group_keys) | _expr_refs(
+            *[c.arg for c in aggs.values() if c.arg is not None])
+        if node.step == N.AggStep.FINAL:
+            from presto_tpu.expr import aggregates as AGG
+            for s, c in aggs.items():
+                child |= {f"{s}${f}" for f in AGG.state_fields(c.fn)}
+        src = prune_columns(node.source, child)
+        return dataclasses.replace(node, source=src, aggs=aggs)
+
+    if isinstance(node, N.Join):
+        crit_l = {a for a, _ in node.criteria}
+        crit_r = {b for _, b in node.criteria}
+        refs = _expr_refs(node.filter)
+        lsyms = set(node.left.output_types())
+        left = prune_columns(node.left,
+                             (needed | crit_l | refs) & lsyms | crit_l)
+        rsyms = set(node.right.output_types())
+        right = prune_columns(node.right,
+                              (needed | crit_r | refs) & rsyms | crit_r)
+        return dataclasses.replace(node, left=left, right=right)
+
+    if isinstance(node, N.SemiJoin):
+        src = prune_columns(node.source,
+                            needed | set(node.source_keys))
+        flt = prune_columns(node.filter_source, set(node.filter_keys))
+        return dataclasses.replace(node, source=src, filter_source=flt)
+
+    if isinstance(node, N.CrossJoin):
+        lsyms = set(node.left.output_types())
+        rsyms = set(node.right.output_types())
+        left = prune_columns(node.left, needed & lsyms)
+        right = prune_columns(node.right, needed & rsyms)
+        return dataclasses.replace(node, left=left, right=right)
+
+    if isinstance(node, (N.Sort, N.TopN)):
+        child = needed | {o.symbol for o in node.orderings}
+        src = prune_columns(node.source, child)
+        return dataclasses.replace(node, source=src)
+
+    if isinstance(node, N.Limit):
+        return dataclasses.replace(
+            node, source=prune_columns(node.source, needed))
+
+    if isinstance(node, N.Distinct):
+        # distinct semantics depend on every input column
+        src = prune_columns(node.source,
+                            set(node.source.output_types()))
+        return dataclasses.replace(node, source=src)
+
+    if isinstance(node, N.Union):
+        keep = [s for s in node.symbols if s in needed] or node.symbols[:1]
+        inputs = []
+        mappings = []
+        for inp, m in zip(node.inputs, node.mappings):
+            sub_needed = {m[s] for s in keep}
+            inputs.append(prune_columns(inp, sub_needed))
+            mappings.append({s: m[s] for s in keep})
+        return N.Union(inputs, keep, {s: node.types[s] for s in keep},
+                       mappings)
+
+    if isinstance(node, N.Exchange):
+        src = prune_columns(node.source,
+                            needed | set(node.partition_keys))
+        return dataclasses.replace(node, source=src)
+
+    raise NotImplementedError(f"prune_columns: {type(node).__name__}")
+
+
+def inline_trivial_projects(node: N.PlanNode) -> N.PlanNode:
+    """Remove Project nodes that are identity mappings."""
+    rebuilt = node
+    kids = node.sources()
+    if kids:
+        new_kids = [inline_trivial_projects(k) for k in kids]
+        if isinstance(node, N.Output):
+            rebuilt = dataclasses.replace(node, source=new_kids[0])
+        elif isinstance(node, (N.Filter, N.Project, N.Aggregate, N.Sort,
+                               N.TopN, N.Limit, N.Distinct, N.Exchange)):
+            rebuilt = dataclasses.replace(node, source=new_kids[0])
+        elif isinstance(node, (N.Join, N.CrossJoin)):
+            rebuilt = dataclasses.replace(node, left=new_kids[0],
+                                          right=new_kids[1])
+        elif isinstance(node, N.SemiJoin):
+            rebuilt = dataclasses.replace(node, source=new_kids[0],
+                                          filter_source=new_kids[1])
+        elif isinstance(node, N.Union):
+            rebuilt = dataclasses.replace(node, inputs=new_kids)
+    if isinstance(rebuilt, N.Project):
+        src_syms = rebuilt.source.output_symbols
+        identity = all(
+            isinstance(e, ir.ColumnRef) and e.name == s
+            for s, e in rebuilt.assignments.items())
+        if identity and list(rebuilt.assignments) == list(src_syms):
+            return rebuilt.source
+    return rebuilt

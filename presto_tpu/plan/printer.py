@@ -1,0 +1,73 @@
+"""Plan rendering for EXPLAIN.
+
+Analog of the reference's sql/planner/planprinter/PlanPrinter.java text
+output (indented operator tree with per-node details).
+"""
+
+from __future__ import annotations
+
+from presto_tpu.plan import nodes as N
+
+
+def format_plan(node: N.PlanNode, indent: int = 0) -> str:
+    pad = " " * (4 * indent)
+    line = pad + _describe(node)
+    parts = [line]
+    for s in node.sources():
+        parts.append(format_plan(s, indent + 1))
+    return "\n".join(parts)
+
+
+def _describe(node: N.PlanNode) -> str:
+    t = type(node).__name__
+    if isinstance(node, N.TableScan):
+        cols = ", ".join(f"{s}:={c}" for s, c in node.assignments.items())
+        return f"TableScan[{node.catalog}.{node.table}] => [{cols}]"
+    if isinstance(node, N.Values):
+        return f"Values[{len(node.rows)} rows] => {node.symbols}"
+    if isinstance(node, N.Filter):
+        return f"Filter[{node.predicate}]"
+    if isinstance(node, N.Project):
+        items = ", ".join(f"{s} := {e}"
+                          for s, e in node.assignments.items())
+        return f"Project[{items}]"
+    if isinstance(node, N.Aggregate):
+        aggs = ", ".join(f"{s} := {c}" for s, c in node.aggs.items())
+        return (f"Aggregate[{node.step.value}]"
+                f"(keys={node.group_keys}, cap={node.capacity}) [{aggs}]")
+    if isinstance(node, N.Join):
+        crit = ", ".join(f"{a} = {b}" for a, b in node.criteria)
+        extra = f", filter={node.filter}" if node.filter is not None else ""
+        uniq = "unique" if node.build_unique else "expanding"
+        return (f"Join[{node.join_type.value}, {uniq}, "
+                f"{node.distribution}]({crit}{extra})")
+    if isinstance(node, N.SemiJoin):
+        keys = ", ".join(f"{a} = {b}" for a, b in
+                         zip(node.source_keys, node.filter_keys))
+        neg = "anti " if node.negated else ""
+        return f"SemiJoin[{neg}{keys}] => {node.output}"
+    if isinstance(node, N.CrossJoin):
+        return f"CrossJoin[{'scalar' if node.scalar else 'expanding'}]"
+    if isinstance(node, N.Sort):
+        return f"Sort[{_orderings(node.orderings)}]"
+    if isinstance(node, N.TopN):
+        return f"TopN[{node.count}; {_orderings(node.orderings)}]"
+    if isinstance(node, N.Limit):
+        off = f" offset {node.offset}" if node.offset else ""
+        return f"Limit[{node.count}{off}]"
+    if isinstance(node, N.Distinct):
+        return f"Distinct[cap={node.capacity}]"
+    if isinstance(node, N.Union):
+        return f"Union[{len(node.inputs)} inputs] => {node.symbols}"
+    if isinstance(node, N.Exchange):
+        return f"Exchange[{node.kind.value}]({node.partition_keys})"
+    if isinstance(node, N.Output):
+        cols = ", ".join(f"{n}:={s}"
+                         for n, s in zip(node.names, node.symbols))
+        return f"Output[{cols}]"
+    return t
+
+
+def _orderings(orderings) -> str:
+    return ", ".join(
+        f"{o.symbol} {'asc' if o.ascending else 'desc'}" for o in orderings)

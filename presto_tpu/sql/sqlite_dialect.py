@@ -1,0 +1,236 @@
+"""AST -> sqlite SQL rendering for the correctness oracle.
+
+The analog of the reference's H2 oracle flow
+(testing/trino-testing/.../H2QueryRunner.java:90): every engine query is
+re-rendered in the oracle's dialect so results can be cross-checked.
+Differences handled: DATE literals become ISO strings (dates are stored
+as TEXT in the oracle, lexicographic order == date order), interval
+arithmetic uses sqlite's date() modifiers, EXTRACT becomes strftime.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from presto_tpu.sql import ast as A
+
+
+def dataclasses_replace_spec(spec: A.QuerySpec, items) -> A.QuerySpec:
+    return dataclasses.replace(spec, select_items=items)
+
+
+def to_sqlite(node) -> str:
+    if isinstance(node, A.QueryStatement):
+        return _query(node.query)
+    if isinstance(node, A.Query):
+        return _query(node)
+    raise NotImplementedError(f"to_sqlite: {type(node).__name__}")
+
+
+def _query(q: A.Query) -> str:
+    parts = []
+    if q.with_queries:
+        ws = []
+        for w in q.with_queries:
+            cols = f" ({', '.join(w.column_aliases)})" if w.column_aliases \
+                else ""
+            ws.append(f"{w.name}{cols} AS ({_query(w.query)})")
+        parts.append("WITH " + ", ".join(ws))
+    parts.append(_body(q.body))
+    if q.order_by:
+        parts.append("ORDER BY " + ", ".join(
+            _sort_item(s) for s in q.order_by))
+    if q.limit is not None:
+        parts.append(f"LIMIT {q.limit}")
+    if q.offset:
+        parts.append(f"OFFSET {q.offset}")
+    return " ".join(parts)
+
+
+def _body(body: A.Relation) -> str:
+    if isinstance(body, A.QuerySpec):
+        return _spec(body)
+    if isinstance(body, A.SetOperation):
+        op = body.op.upper() + ("" if body.distinct else " ALL")
+        return f"{_body(body.left)} {op} {_body(body.right)}"
+    if isinstance(body, A.SubqueryRelation):
+        return f"({_query(body.query)})"
+    raise NotImplementedError(type(body).__name__)
+
+
+def _spec(s: A.QuerySpec) -> str:
+    items = ", ".join(
+        (_expr(i.expression)
+         + (f" AS {i.alias}" if i.alias else ""))
+        for i in s.select_items)
+    out = "SELECT " + ("DISTINCT " if s.distinct else "") + items
+    if s.from_relation is not None:
+        out += " FROM " + _rel(s.from_relation)
+    if s.where is not None:
+        out += " WHERE " + _expr(s.where)
+    if s.group_by:
+        gs = []
+        for g in s.group_by:
+            if g.kind != "simple":
+                raise NotImplementedError("grouping sets in oracle")
+            gs.append(_expr(g.expressions[0]))
+        out += " GROUP BY " + ", ".join(gs)
+    if s.having is not None:
+        out += " HAVING " + _expr(s.having)
+    return out
+
+
+def _rel(r: A.Relation) -> str:
+    if isinstance(r, A.TableRef):
+        return r.parts[-1]
+    if isinstance(r, A.AliasedRelation):
+        if r.column_aliases and isinstance(r.relation, A.SubqueryRelation):
+            # sqlite lacks AS alias(col, ...): inject aliases into the
+            # subquery's select items instead
+            q = r.relation.query
+            if isinstance(q.body, A.QuerySpec) and not q.with_queries:
+                items = tuple(
+                    A.SelectItem(i.expression,
+                                 r.column_aliases[idx]
+                                 if idx < len(r.column_aliases)
+                                 else i.alias)
+                    for idx, i in enumerate(q.body.select_items))
+                body = dataclasses_replace_spec(q.body, items)
+                q = A.Query(body, q.with_queries, q.order_by, q.limit,
+                            q.offset)
+                return f"({_query(q)}) AS {r.alias}"
+        cols = f" ({', '.join(r.column_aliases)})" if r.column_aliases \
+            else ""
+        return f"{_rel(r.relation)} AS {r.alias}{cols}"
+    if isinstance(r, A.SubqueryRelation):
+        return f"({_query(r.query)})"
+    if isinstance(r, A.JoinRelation):
+        if r.join_type == "implicit":
+            return f"{_rel(r.left)}, {_rel(r.right)}"
+        if r.join_type == "cross":
+            return f"{_rel(r.left)} CROSS JOIN {_rel(r.right)}"
+        jt = {"inner": "JOIN", "left": "LEFT JOIN",
+              "right": "RIGHT JOIN", "full": "FULL JOIN"}[r.join_type]
+        out = f"{_rel(r.left)} {jt} {_rel(r.right)}"
+        if r.on is not None:
+            out += f" ON {_expr(r.on)}"
+        elif r.using:
+            out += f" USING ({', '.join(r.using)})"
+        return out
+    if isinstance(r, A.ValuesRelation):
+        rows = ", ".join(
+            "(" + ", ".join(_expr(e) for e in row) + ")"
+            for row in r.rows)
+        return f"(VALUES {rows})"
+    raise NotImplementedError(type(r).__name__)
+
+
+def _sort_item(s: A.SortItem) -> str:
+    out = _expr(s.expression)
+    out += " ASC" if s.ascending else " DESC"
+    if s.nulls_first is True:
+        out += " NULLS FIRST"
+    elif s.nulls_first is False:
+        out += " NULLS LAST"
+    return out
+
+
+_UNIT_SQLITE = {"year": "years", "month": "months", "day": "days",
+                "week": "days"}
+
+
+def _expr(e: A.Expression) -> str:
+    if isinstance(e, A.Identifier):
+        return e.name
+    if isinstance(e, A.Dereference):
+        return ".".join(e.parts)
+    if isinstance(e, A.NumericLiteral):
+        return e.text
+    if isinstance(e, A.StringLiteral):
+        v = e.value.replace("'", "''")
+        return f"'{v}'"
+    if isinstance(e, A.BooleanLiteral):
+        return "1" if e.value else "0"
+    if isinstance(e, A.NullLiteral):
+        return "NULL"
+    if isinstance(e, A.TypedLiteral):
+        if e.type_name in ("date", "timestamp"):
+            return f"'{e.value[:10]}'"
+        return e.value
+    if isinstance(e, A.BinaryOp):
+        # date +- interval -> sqlite date() modifier
+        for a, b, sign in ((e.left, e.right, ""), (e.right, e.left, "")):
+            if isinstance(b, A.IntervalLiteral) and e.op in ("+", "-"):
+                n = int(b.value) * (7 if b.unit == "week" else 1)
+                if b.negative:
+                    n = -n
+                if e.op == "-":
+                    n = -n
+                unit = _UNIT_SQLITE[b.unit]
+                return f"date({_expr(a)}, '{n:+d} {unit}')"
+        return f"({_expr(e.left)} {e.op} {_expr(e.right)})"
+    if isinstance(e, A.UnaryOp):
+        return f"({e.op}{_expr(e.operand)})"
+    if isinstance(e, A.LogicalOp):
+        return "(" + f" {e.op.upper()} ".join(
+            _expr(t) for t in e.terms) + ")"
+    if isinstance(e, A.NotOp):
+        return f"(NOT {_expr(e.operand)})"
+    if isinstance(e, A.IsNullPredicate):
+        n = " NOT" if e.negated else ""
+        return f"({_expr(e.operand)} IS{n} NULL)"
+    if isinstance(e, A.BetweenPredicate):
+        n = "NOT " if e.negated else ""
+        return (f"({_expr(e.operand)} {n}BETWEEN {_expr(e.low)} "
+                f"AND {_expr(e.high)})")
+    if isinstance(e, A.InListPredicate):
+        n = "NOT " if e.negated else ""
+        vals = ", ".join(_expr(v) for v in e.values)
+        return f"({_expr(e.operand)} {n}IN ({vals}))"
+    if isinstance(e, A.InSubquery):
+        n = "NOT " if e.negated else ""
+        return f"({_expr(e.operand)} {n}IN ({_query(e.query)}))"
+    if isinstance(e, A.ExistsPredicate):
+        n = "NOT " if e.negated else ""
+        return f"({n}EXISTS ({_query(e.query)}))"
+    if isinstance(e, A.ScalarSubquery):
+        return f"({_query(e.query)})"
+    if isinstance(e, A.LikePredicate):
+        n = "NOT " if e.negated else ""
+        out = f"({_expr(e.operand)} {n}LIKE {_expr(e.pattern)}"
+        if e.escape is not None:
+            out += f" ESCAPE {_expr(e.escape)}"
+        return out + ")"
+    if isinstance(e, A.FunctionCall):
+        if e.is_star:
+            return f"{e.name}(*)"
+        d = "DISTINCT " if e.distinct else ""
+        args = ", ".join(_expr(a) for a in e.args)
+        name = {"substring": "substr", "arbitrary": "max"}.get(
+            e.name, e.name)
+        return f"{name}({d}{args})"
+    if isinstance(e, A.CastExpression):
+        t = e.type_name.lower()
+        if t.startswith("decimal") or t in ("double", "real", "float"):
+            st = "REAL"
+        elif t.startswith(("varchar", "char")):
+            st = "TEXT"
+        elif t == "date":
+            st = "TEXT"
+        else:
+            st = "INTEGER"
+        return f"CAST({_expr(e.operand)} AS {st})"
+    if isinstance(e, A.CaseExpression):
+        parts = ["CASE"]
+        for c, r in e.whens:
+            parts.append(f"WHEN {_expr(c)} THEN {_expr(r)}")
+        if e.default is not None:
+            parts.append(f"ELSE {_expr(e.default)}")
+        parts.append("END")
+        return "(" + " ".join(parts) + ")"
+    if isinstance(e, A.Extract):
+        fmt = {"year": "%Y", "month": "%m", "day": "%d"}[e.field]
+        return f"CAST(strftime('{fmt}', {_expr(e.operand)}) AS INTEGER)"
+    if isinstance(e, A.Star):
+        return f"{e.qualifier}.*" if e.qualifier else "*"
+    raise NotImplementedError(f"to_sqlite expr: {type(e).__name__}")

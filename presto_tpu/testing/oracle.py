@@ -79,15 +79,30 @@ def normalize_value(v):
     return v
 
 
-def values_equal(a, b, rel: float = 1e-6, absol: float = 1e-6) -> bool:
+def values_equal(a, b, rel: float = 1e-6, absol: float = 1e-9) -> bool:
+    """Tolerant float compare. Beyond rel/abs closeness, accepts the
+    engine value being the *decimal rounding* of the oracle value: the
+    engine computes decimal(p,s) arithmetic exactly (rounding to scale s,
+    reference DecimalOperators semantics) while the oracle's REAL keeps
+    full precision — so 698.47 matches 698.4685714 via the scale-2 check
+    without loosening every other comparison."""
     a, b = normalize_value(a), normalize_value(b)
     if a is None or b is None:
         return a is None and b is None
     if isinstance(a, float) or isinstance(b, float):
         try:
-            return math.isclose(float(a), float(b), rel_tol=rel, abs_tol=absol)
+            fa, fb = float(a), float(b)
         except (TypeError, ValueError):
             return False
+        if math.isclose(fa, fb, rel_tol=rel, abs_tol=absol):
+            return True
+        # engine value at some decimal scale k == oracle rounded to k?
+        for k in range(0, 7):
+            f = 10.0 ** k
+            if abs(fa * f - round(fa * f)) < 1e-6:
+                return math.isclose(fa, round(fb * f) / f,
+                                    rel_tol=rel, abs_tol=absol)
+        return False
     return a == b
 
 

@@ -1,0 +1,16 @@
+"""Full TPC-H suite (tiny scale) through the SQL frontend, cross-checked
+against the sqlite oracle — the engine-level analog of the reference's
+TpchQueryRunner + H2 assertQuery flow
+(testing/trino-tests/.../tpch/TpchQueryRunner.java,
+AbstractTestQueryFramework.assertQuery)."""
+
+import pytest
+
+from presto_tpu.testing.oracle import assert_query
+
+from tpch_queries import QUERIES
+
+
+@pytest.mark.parametrize("qname", sorted(QUERIES))
+def test_tpch_query(qname, engine, oracle):
+    assert_query(engine, oracle, QUERIES[qname])
