@@ -1,0 +1,60 @@
+"""Client library: submit SQL over the REST protocol, follow nextUri.
+
+Analog of the reference's trino-client StatementClientV1
+(client/trino-client/.../StatementClientV1.java:61,323-335): POST the
+statement, then advance() along nextUri until the server stops returning
+one, accumulating data pages.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import urllib.request
+
+
+class QueryFailed(Exception):
+    pass
+
+
+class Client:
+    def __init__(self, base_url: str, user: str = "presto"):
+        self.base_url = base_url.rstrip("/")
+        self.user = user
+
+    def _request(self, method: str, url: str, body: bytes | None = None):
+        req = urllib.request.Request(url, data=body, method=method)
+        req.add_header("X-Trino-User", self.user)
+        with urllib.request.urlopen(req, timeout=300) as resp:
+            return json.loads(resp.read() or b"{}")
+
+    def execute(self, sql: str, poll_interval: float = 0.02):
+        """Run SQL; returns (columns, rows). Blocks until FINISHED."""
+        out = self._request("POST", f"{self.base_url}/v1/statement",
+                            sql.encode())
+        columns = None
+        rows: list[list] = []
+        while True:
+            if "error" in out and out["error"]:
+                raise QueryFailed(out["error"].get("message", "failed"))
+            if out.get("columns"):
+                columns = out["columns"]
+            rows.extend(out.get("data", []))
+            next_uri = out.get("nextUri")
+            if next_uri is None:
+                return columns or [], rows
+            state = out.get("stats", {}).get("state")
+            if state in ("QUEUED", "RUNNING"):
+                time.sleep(poll_interval)
+            out = self._request("GET", next_uri)
+
+    def cancel(self, query_id: str) -> None:
+        self._request(
+            "DELETE",
+            f"{self.base_url}/v1/statement/executing/{query_id}/0")
+
+    def server_info(self) -> dict:
+        return self._request("GET", f"{self.base_url}/v1/info")
+
+    def queries(self) -> list[dict]:
+        return self._request("GET", f"{self.base_url}/v1/query")

@@ -1,7 +1,8 @@
 """In-memory table connector.
 
 Analog of the reference's plugin/trino-memory (MemoryPagesStore): tables
-created/inserted at runtime, stored as host numpy columns.
+created/inserted at runtime, stored as host numpy columns plus optional
+validity masks (NULL support matches spi Block.isNull).
 """
 
 from __future__ import annotations
@@ -11,7 +12,7 @@ from typing import Mapping
 import numpy as np
 
 from presto_tpu import types as T
-from presto_tpu.block import Table
+from presto_tpu.block import Column, Table, column_from_numpy
 from presto_tpu.connectors.base import Connector, TableStats
 
 
@@ -21,10 +22,12 @@ class MemoryConnector(Connector):
     def __init__(self) -> None:
         self._schemas: dict[str, dict[str, T.DataType]] = {}
         self._data: dict[str, dict[str, np.ndarray]] = {}
+        self._valid: dict[str, dict[str, np.ndarray | None]] = {}
 
     def create_table(
         self, name: str, schema: Mapping[str, T.DataType],
         data: Mapping[str, np.ndarray] | None = None,
+        valid: Mapping[str, np.ndarray | None] | None = None,
     ) -> None:
         self._schemas[name] = dict(schema)
         if data is None:
@@ -34,15 +37,30 @@ class MemoryConnector(Connector):
         self._data[name] = {c: np.asarray(v, dtype=object if isinstance(
             self._schemas[name][c], T.VarcharType) else None)
             for c, v in data.items()}
+        self._valid[name] = {c: (None if valid is None else valid.get(c))
+                             for c in schema}
 
-    def insert(self, name: str, data: Mapping[str, np.ndarray]) -> None:
-        for c in self._schemas[name]:
+    def insert(self, name: str, data: Mapping[str, np.ndarray],
+               valid: Mapping[str, np.ndarray | None] | None = None) -> None:
+        for i, c in enumerate(self._schemas[name]):
+            new = np.asarray(data[c])
+            old_n = len(self._data[name][c])
             self._data[name][c] = np.concatenate(
-                [self._data[name][c], np.asarray(data[c])])
+                [self._data[name][c], new])
+            new_valid = None if valid is None else valid.get(c)
+            old_valid = self._valid[name].get(c)
+            if new_valid is not None or old_valid is not None:
+                if old_valid is None:
+                    old_valid = np.ones(old_n, dtype=bool)
+                if new_valid is None:
+                    new_valid = np.ones(len(new), dtype=bool)
+                self._valid[name][c] = np.concatenate(
+                    [old_valid, new_valid])
 
     def drop_table(self, name: str) -> None:
         self._schemas.pop(name, None)
         self._data.pop(name, None)
+        self._valid.pop(name, None)
 
     def table_names(self) -> list[str]:
         return list(self._schemas)
@@ -51,7 +69,15 @@ class MemoryConnector(Connector):
         return self._schemas[name]
 
     def table(self, name: str) -> Table:
-        return Table.from_numpy(self._schemas[name], self._data[name])
+        schema = self._schemas[name]
+        cols: dict[str, Column] = {}
+        n = 0
+        for c, dtype in schema.items():
+            col = column_from_numpy(dtype, self._data[name][c],
+                                    self._valid[name].get(c))
+            cols[c] = col
+            n = len(col)
+        return Table(cols, n)
 
     def stats(self, name: str) -> TableStats:
         n = len(next(iter(self._data[name].values()))) if self._data[name] else 0

@@ -2,16 +2,22 @@
 
 Analog of the reference's LocalQueryRunner
 (core/trino-main/src/main/java/io/trino/testing/LocalQueryRunner.java:227):
-parse -> analyze -> logical plan -> optimize -> fragment -> compile jitted
-kernels -> execute, all in one process. The distributed path executes
-fragments under shard_map over a jax Mesh instead of HTTP remote tasks.
+parse -> analyze -> logical plan -> optimize -> compile jitted kernels ->
+execute, all in one process. Statement dispatch mirrors the reference's
+split between data queries (SqlQueryExecution) and DDL/session statements
+(execution/*Task.java executors, sql/rewrite/ShowQueriesRewrite.java).
+The distributed path executes plans under shard_map over a jax Mesh
+instead of HTTP remote tasks.
 """
 
 from __future__ import annotations
 
-from presto_tpu.block import Table
+import numpy as np
+
+from presto_tpu import types as T
+from presto_tpu.block import Table, _decode_column
 from presto_tpu.connectors.base import Connector
-from presto_tpu.session import Session
+from presto_tpu.session import SYSTEM_SESSION_PROPERTIES, Session
 
 
 class Engine:
@@ -24,15 +30,26 @@ class Engine:
 
     # -- SQL entry points ---------------------------------------------------
 
-    def execute(self, sql: str) -> list[tuple]:
-        """Run SQL, return result rows as Python tuples."""
-        result = self.execute_table(sql)
-        return result.to_pylist()
+    def execute(self, sql: str, mesh=None) -> list[tuple]:
+        """Run SQL, return result rows as Python tuples. With ``mesh``
+        (a jax.sharding.Mesh) query plans execute data-parallel over
+        every device — scans row-sharded, exchanges as ICI collectives."""
+        from presto_tpu.sql import ast as A
+        from presto_tpu.sql.parser import parse_statement
 
-    def execute_table(self, sql: str) -> Table:
-        from presto_tpu.exec.executor import execute_plan
-        plan, _ = self.plan_sql(sql)
-        return execute_plan(self, plan)
+        stmt = parse_statement(sql)
+        if isinstance(stmt, A.QueryStatement):
+            return self._execute_query(stmt.query, mesh).to_pylist()
+        return self._execute_statement(stmt, mesh)
+
+    def execute_table(self, sql: str, mesh=None) -> Table:
+        from presto_tpu.sql import ast as A
+        from presto_tpu.sql.parser import parse_statement
+
+        stmt = parse_statement(sql)
+        if not isinstance(stmt, A.QueryStatement):
+            raise ValueError("execute_table expects a SELECT query")
+        return self._execute_query(stmt.query, mesh)
 
     def plan_sql(self, sql: str):
         from presto_tpu.sql.parser import parse_statement
@@ -50,3 +67,148 @@ class Engine:
         from presto_tpu.plan.printer import format_plan
         plan, _ = self.plan_sql(sql)
         return format_plan(plan)
+
+    # -- internals ----------------------------------------------------------
+
+    def _plan_query(self, query):
+        from presto_tpu.plan.optimizer import optimize
+        from presto_tpu.plan.planner import LogicalPlanner
+        from presto_tpu.sql import ast as A
+
+        planner = LogicalPlanner(self, None)
+        plan = planner.plan(A.QueryStatement(query))
+        return optimize(plan, self)
+
+    def _execute_query(self, query, mesh=None) -> Table:
+        plan = self._plan_query(query)
+        if mesh is not None:
+            from presto_tpu.parallel.executor import (
+                execute_plan_distributed)
+            return execute_plan_distributed(self, plan, mesh)
+        from presto_tpu.exec.executor import execute_plan
+        return execute_plan(self, plan)
+
+    def _execute_statement(self, stmt, mesh=None) -> list[tuple]:
+        from presto_tpu.plan.printer import format_plan
+        from presto_tpu.sql import ast as A
+
+        if isinstance(stmt, A.ExplainStatement):
+            if stmt.analyze:
+                from presto_tpu.exec.profile import explain_analyze
+                inner = stmt.statement
+                if not isinstance(inner, A.QueryStatement):
+                    raise ValueError("EXPLAIN ANALYZE expects a query")
+                plan = self._plan_query(inner.query)
+                return [(explain_analyze(self, plan),)]
+            inner = stmt.statement
+            if isinstance(inner, A.QueryStatement):
+                plan = self._plan_query(inner.query)
+                return [(format_plan(plan),)]
+            raise ValueError("EXPLAIN of non-query statements unsupported")
+
+        if isinstance(stmt, A.ShowCatalogs):
+            return [(name,) for name in sorted(self.catalogs)]
+
+        if isinstance(stmt, A.ShowTables):
+            catalog = stmt.catalog or self.session.catalog
+            conn = self._connector(catalog)
+            return [(t,) for t in sorted(conn.table_names())]
+
+        if isinstance(stmt, A.ShowColumns):
+            catalog, table = self._resolve_table(stmt.table)
+            conn = self._connector(catalog)
+            schema = conn.table_schema(table)
+            return [(c, str(t)) for c, t in schema.items()]
+
+        if isinstance(stmt, A.ShowSession):
+            rows = []
+            for name, (default, typ, desc) in sorted(
+                    SYSTEM_SESSION_PROPERTIES.items()):
+                rows.append((name, str(self.session.get(name)),
+                             str(default), typ.__name__, desc))
+            return rows
+
+        if isinstance(stmt, A.SetSession):
+            value = _literal_value(stmt.value)
+            self.session.set(stmt.name, value)
+            return []
+
+        if isinstance(stmt, A.CreateTableAs):
+            catalog, table = self._resolve_table(stmt.table)
+            conn = self._connector(catalog)
+            result = self._execute_query(stmt.query, mesh)
+            schema, data, valid = _table_to_host(result)
+            conn.create_table(table, schema, data, valid)
+            return [(len(next(iter(data.values()), [])),)]
+
+        if isinstance(stmt, A.InsertStatement):
+            catalog, table = self._resolve_table(stmt.table)
+            conn = self._connector(catalog)
+            result = self._execute_query(stmt.query, mesh)
+            schema, data, valid = _table_to_host(result)
+            target = conn.table_schema(table)
+            names = stmt.columns or list(target)
+            renamed = {t: d for t, d in zip(names, data.values())}
+            revalid = {t: v for t, v in zip(names, valid.values())}
+            conn.insert(table, renamed, revalid)
+            return [(len(next(iter(data.values()), [])),)]
+
+        if isinstance(stmt, A.DropTable):
+            catalog, table = self._resolve_table(stmt.table)
+            conn = self._connector(catalog)
+            if table not in conn.table_names():
+                if stmt.if_exists:
+                    return []
+                raise ValueError(f"table {table} does not exist")
+            conn.drop_table(table)
+            return []
+
+        raise NotImplementedError(
+            f"statement {type(stmt).__name__} not supported")
+
+    def _connector(self, catalog: str) -> Connector:
+        conn = self.catalogs.get(catalog)
+        if conn is None:
+            raise ValueError(f"catalog '{catalog}' does not exist")
+        return conn
+
+    def _resolve_table(self, parts: tuple[str, ...]) -> tuple[str, str]:
+        if len(parts) == 1:
+            return self.session.catalog, parts[0]
+        return parts[0], parts[-1]
+
+
+def _literal_value(e):
+    from presto_tpu.sql import ast as A
+
+    if isinstance(e, A.StringLiteral):
+        return e.value
+    if isinstance(e, A.NumericLiteral):
+        return float(e.text) if "." in e.text else int(e.text)
+    if isinstance(e, A.BooleanLiteral):
+        return e.value
+    if isinstance(e, A.Identifier):
+        return e.name
+    raise ValueError("SET SESSION value must be a literal")
+
+
+def _table_to_host(table: Table):
+    """Result Table -> (schema, host column arrays, validity masks) for
+    connector writes. VARCHAR decodes to strings; other types keep their
+    physical values (decimals stay scaled, matching column_from_numpy's
+    contract)."""
+    schema: dict[str, T.DataType] = {}
+    data: dict[str, np.ndarray] = {}
+    valid: dict[str, np.ndarray | None] = {}
+    mask = (np.ones(table.nrows, dtype=bool) if table.mask is None
+            else np.asarray(table.mask))
+    for name, col in table.columns.items():
+        schema[name] = col.dtype
+        raw = np.asarray(col.data)[mask]
+        if isinstance(col.dtype, T.VarcharType):
+            data[name] = _decode_column(col.dtype, raw, col.dictionary)
+        else:
+            data[name] = raw
+        valid[name] = (None if col.valid is None
+                       else np.asarray(col.valid)[mask])
+    return schema, data, valid

@@ -50,6 +50,9 @@ def collect_scans(plan: N.PlanNode, engine) -> list[ScanInput]:
             for sym, colname in node.assignments.items():
                 col = tbl.columns[colname]
                 arrays[sym] = np.asarray(col.data)
+                if col.valid is not None:
+                    # NULL masks ship as sibling arrays (spi Block.isNull)
+                    arrays[f"{sym}$valid"] = np.asarray(col.valid)
                 dicts[sym] = col.dictionary
                 types[sym] = col.dtype
             out.append(ScanInput(node, arrays, dicts, types, tbl.nrows))
@@ -92,7 +95,8 @@ class PlanInterpreter:
         scan, traced = self.scans[id(node)]
         cols = {}
         for sym in node.assignments:
-            cols[sym] = Val(scan.types[sym], traced[sym], None,
+            cols[sym] = Val(scan.types[sym], traced[sym],
+                            traced.get(f"{sym}$valid"),
                             scan.dictionaries[sym])
         return DTable(cols, None, scan.nrows)
 
@@ -163,6 +167,9 @@ class PlanInterpreter:
     def _r_union(self, node: N.Union) -> DTable:
         parts = [self.run(s) for s in node.inputs]
         return OP.apply_union(parts, node)
+
+    def _r_window(self, node: N.Window) -> DTable:
+        return OP.apply_window(self.run(node.source), node)
 
     def _r_sort(self, node: N.Sort) -> DTable:
         return OP.apply_sort(self.run(node.source), node.orderings)
@@ -255,4 +262,14 @@ def execute_plan(engine, plan: N.PlanNode) -> Table:
         cols[sym] = Column(dtype, data,
                            valid if has_valid or not valid.all() else None,
                            dictionary)
-    return Table(cols, len(live_np), live_np)
+    return Table(_rename_outputs(plan, cols), len(live_np), live_np)
+
+
+def _rename_outputs(plan: N.PlanNode,
+                    cols: dict[str, Column]) -> dict[str, Column]:
+    """Key result columns by their declared output names (the symbols are
+    internal; CTAS/INSERT and clients need the SQL names)."""
+    if isinstance(plan, N.Output):
+        return {name: cols[sym]
+                for name, sym in zip(plan.names, plan.symbols)}
+    return cols

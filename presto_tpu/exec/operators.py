@@ -60,7 +60,10 @@ def apply_project(dt: DTable, assignments: dict[str, ir.Expr]) -> DTable:
         data = v.data
         if getattr(data, "ndim", 1) == 0:  # broadcast scalar literal
             data = jnp.broadcast_to(data, (dt.n,))
-            v = Val(v.dtype, data, v.valid, v.dictionary)
+            valid = v.valid
+            if valid is not None and getattr(valid, "ndim", 1) == 0:
+                valid = jnp.broadcast_to(valid, (dt.n,))
+            v = Val(v.dtype, data, valid, v.dictionary)
         out[sym] = v
     return DTable(out, dt.live, dt.n)
 
@@ -402,6 +405,192 @@ def apply_limit(dt: DTable, count: int, offset: int = 0) -> DTable:
     pos = jnp.cumsum(live.astype(jnp.int64))
     keep = (pos > offset) & (pos <= offset + count)
     return DTable(dt.cols, live & keep, dt.n)
+
+
+def _keys_equal_prev(vals: list[Val], sorted_perm) -> object:
+    """bool[n]: row i's key tuple equals row i-1's (in sorted order).
+    Exact value comparison (not hashes). Row 0 is always False."""
+    n = sorted_perm.shape[0]
+    eq = jnp.ones((n,), dtype=bool)
+    for v in vals:
+        d = v.data[sorted_perm]
+        same = jnp.concatenate(
+            [jnp.zeros((1,), bool), d[1:] == d[:-1]])
+        if v.valid is not None:
+            vv = v.valid[sorted_perm]
+            both_null = jnp.concatenate(
+                [jnp.zeros((1,), bool), ~vv[1:] & ~vv[:-1]])
+            same_valid = jnp.concatenate(
+                [jnp.zeros((1,), bool), vv[1:] == vv[:-1]])
+            same = (same | both_null) & same_valid
+        eq = eq & same
+    if not vals:
+        return jnp.ones((n,), dtype=bool).at[0].set(False)
+    return eq.at[0].set(False)
+
+
+def apply_window(dt: DTable, node: N.Window) -> DTable:
+    """Window functions: sort by (partition, order) keys, compute ranks /
+    running & full-partition aggregates with scans over the sorted
+    layout, scatter results back to the original row order.
+
+    TPU-native reformulation of the reference's WindowOperator +
+    PagesIndex (operator/WindowOperator.java:70, PagesIndex.java:79):
+    where the reference walks partitions row-by-row, every function here
+    is a vectorised prefix-scan/segment reduction over the sorted array.
+    """
+    n = dt.n
+    live = dt.live_mask()
+    part_orderings = [N.Ordering(s) for s in node.partition_by]
+    perm = _sort_perm(dt, part_orderings + list(node.orderings))
+    inv = jnp.zeros((n,), jnp.int32).at[perm].set(
+        jnp.arange(n, dtype=jnp.int32))
+
+    pvals = [dt.cols[s] for s in node.partition_by]
+    ovals = [dt.cols[o.symbol] for o in node.orderings]
+    slive = live[perm]
+    same_part = _keys_equal_prev(pvals, perm) & slive \
+        & jnp.concatenate([jnp.zeros((1,), bool), slive[:-1]])
+    same_peer = same_part & _keys_equal_prev(pvals + ovals, perm)
+
+    idx = jnp.arange(n, dtype=jnp.int64)
+    # index of this row's partition start / peer-group start: running max
+    # over boundary markers
+    part_start = jax.lax.associative_scan(
+        jnp.maximum, jnp.where(same_part, jnp.int64(-1), idx))
+    peer_start = jax.lax.associative_scan(
+        jnp.maximum, jnp.where(same_peer, jnp.int64(-1), idx))
+
+    out = dict(dt.cols)
+    c = ExprCompiler({s: Val(v.dtype, v.data[perm],
+                             None if v.valid is None else v.valid[perm],
+                             v.dictionary)
+                      for s, v in dt.cols.items()})
+
+    for sym, call in node.functions.items():
+        data, valid, dictionary = _window_fn(
+            call, c, idx, part_start, peer_start, same_part, slive, n)
+        # scatter back to original order
+        data = data[inv]
+        valid = None if valid is None else valid[inv]
+        out[sym] = Val(call.dtype, data, valid, dictionary)
+    return DTable(out, dt.live, n)
+
+
+def _window_fn(call: N.WindowCall, c: ExprCompiler, idx, part_start,
+               peer_start, same_part, slive, n):
+    fn = call.fn
+    if fn == "row_number":
+        return (idx - part_start + 1), None, None
+    if fn == "rank":
+        return (peer_start - part_start + 1), None, None
+    if fn == "dense_rank":
+        new_peer = ~jnp.concatenate(
+            [jnp.zeros((1,), bool), peer_start[1:] == peer_start[:-1]])
+        peer_ord = jnp.cumsum(new_peer.astype(jnp.int64))
+        at_start = peer_ord[jnp.clip(part_start, 0, n - 1)]
+        return peer_ord - at_start + 1, None, None
+    if fn in ("lag", "lead"):
+        v = c.compile(call.args[0])
+        offset = 1
+        if len(call.args) > 1:
+            offset = int(call.args[1].value)  # planner enforces literal
+        shift = -offset if fn == "lag" else offset
+        src = jnp.clip(idx + shift, 0, n - 1).astype(jnp.int32)
+        in_part = (part_start[src] == part_start) & \
+            (src == idx + shift)
+        data = v.data[src]
+        valid = in_part if v.valid is None else (in_part & v.valid[src])
+        return data, valid, v.dictionary
+    if fn == "first_value":
+        v = c.compile(call.args[0])
+        src = jnp.clip(part_start, 0, n - 1).astype(jnp.int32)
+        data = v.data[src]
+        valid = None if v.valid is None else v.valid[src]
+        return data, valid, v.dictionary
+    if fn in ("sum", "count", "avg", "min", "max"):
+        if call.args:
+            v = c.compile(call.args[0])
+            w = slive if v.valid is None else (slive & v.valid)
+            vals = v.data
+        else:
+            v = None
+            w = slive
+            vals = jnp.ones((n,), jnp.int64)
+        framed = call.frame != "full_partition"
+        restart = ~same_part  # new partition begins (row 0 included)
+        if fn == "count":
+            vals = jnp.ones((n,), jnp.int64)
+        if jnp.issubdtype(vals.dtype, jnp.integer):
+            vals = vals.astype(jnp.int64)
+        if call.frame == "rows_unbounded_current":
+            # ROWS frame: ends exactly at the current row (peers excluded)
+            frame_at = jnp.clip(idx, 0, n - 1)
+        elif framed:
+            # RANGE default includes the whole peer group — the running
+            # value is the segmented scan taken at the END of this row's
+            # peer group
+            is_last_of_peer = jnp.concatenate(
+                [peer_start[1:] != peer_start[:-1],
+                 jnp.ones((1,), bool)])
+            peer_end = jax.lax.associative_scan(
+                jnp.minimum,
+                jnp.where(is_last_of_peer, idx, jnp.int64(n)),
+                reverse=True)
+            frame_at = jnp.clip(peer_end, 0, n - 1)
+        else:
+            frame_at = None
+
+        def run_scan(masked, op):
+            scanned = _segmented_scan(masked, restart, op)
+            if frame_at is not None:
+                return scanned[frame_at]
+            # full partition: value at partition's last row
+            is_last_of_part = jnp.concatenate(
+                [part_start[1:] != part_start[:-1],
+                 jnp.ones((1,), bool)])
+            last = jax.lax.associative_scan(
+                jnp.minimum,
+                jnp.where(is_last_of_part, idx, jnp.int64(n)),
+                reverse=True)
+            return scanned[jnp.clip(last, 0, n - 1)]
+
+        cnt = run_scan(w.astype(jnp.int64), jnp.add)
+        if fn == "count":
+            return cnt, None, None
+        if fn in ("sum", "avg"):
+            masked = jnp.where(w, vals, jnp.zeros((), vals.dtype))
+            total = run_scan(masked, jnp.add)
+            if fn == "avg":
+                sf = total.astype(jnp.float64)
+                if v is not None and isinstance(v.dtype, T.DecimalType):
+                    sf = sf / v.dtype.unscale_factor
+                return sf / jnp.maximum(cnt, 1), cnt > 0, None
+            return total, cnt > 0, None
+        if fn == "max":
+            sentinel = jnp.asarray(
+                jnp.iinfo(vals.dtype).min if jnp.issubdtype(
+                    vals.dtype, jnp.integer) else -jnp.inf, vals.dtype)
+            run = run_scan(jnp.where(w, vals, sentinel), jnp.maximum)
+        else:
+            sentinel = jnp.asarray(
+                jnp.iinfo(vals.dtype).max if jnp.issubdtype(
+                    vals.dtype, jnp.integer) else jnp.inf, vals.dtype)
+            run = run_scan(jnp.where(w, vals, sentinel), jnp.minimum)
+        return run, cnt > 0, (v.dictionary if v is not None else None)
+    raise NotImplementedError(f"window function {fn}")
+
+
+def _segmented_scan(vals, restart, op):
+    """Inclusive scan that restarts wherever ``restart`` is True."""
+
+    def combine(a, b):
+        av, af = a
+        bv, bf = b
+        return jnp.where(bf, bv, op(av, bv)), af | bf
+
+    out, _ = jax.lax.associative_scan(combine, (vals, restart))
+    return out
 
 
 def apply_distinct(dt: DTable, capacity: int) -> tuple:

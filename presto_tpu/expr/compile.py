@@ -145,7 +145,10 @@ class ExprCompiler:
     def _c_literal(self, e: ir.Literal) -> Val:
         if e.value is None:
             zero = np.zeros((), dtype=e.dtype.physical_dtype)
-            return Val(e.dtype, jnp.asarray(zero), jnp.asarray(False))
+            dictionary = (np.array([""], dtype=object)
+                          if isinstance(e.dtype, T.VarcharType) else None)
+            return Val(e.dtype, jnp.asarray(zero), jnp.asarray(False),
+                       dictionary)
         if isinstance(e.dtype, T.VarcharType):
             return Val(e.dtype, jnp.asarray(np.int32(0)), None,
                        np.array([e.value], dtype=object))

@@ -1,0 +1,353 @@
+"""Distributed plan execution: one shard_map program over a device mesh.
+
+The TPU-native replacement for the reference's distributed execution
+stack (fragmenter sql/planner/PlanFragmenter.java:108 + scheduler
+execution/scheduler/SqlQueryScheduler.java + HTTP exchange
+operator/ExchangeClient.java). Where the reference cuts the plan into
+fragments shipped to workers and streams pages over HTTP, here the WHOLE
+plan — scans through output — is traced into a single jitted shard_map
+computation over the mesh, and every distribution boundary lowers to an
+ICI collective:
+
+| reference exchange (SystemPartitioningHandle.java:58-66) | here |
+|---|---|
+| SOURCE distribution (splits)        | rows block-sharded over mesh axis |
+| partial->final aggregation          | local fold -> all_gather of state
+|                                       columns -> local merge (psum tree) |
+| FIXED_BROADCAST (join build sides)  | lax.all_gather of build shard |
+| FIXED_HASH repartition              | bucket + lax.all_to_all
+|                                       (exchange.repartition)            |
+| GATHER / SINGLE (sort, limit, out)  | lax.all_gather -> replicated      |
+
+Every operator in between runs unchanged on its local shard (the same
+kernels as exec/operators.py) — data parallelism over rows is the
+engine's analog of DP; hash repartition is its TP/EP.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from presto_tpu import types as T
+from presto_tpu.block import Column, Table
+from presto_tpu.exec import operators as OP
+from presto_tpu.exec.executor import ScanInput, collect_scans
+from presto_tpu.exec.operators import DTable
+from presto_tpu.expr.compile import Val
+from presto_tpu.ops import hash as H
+from presto_tpu.ops.hash import next_pow2
+from presto_tpu.plan import nodes as N
+
+AXIS = "d"
+
+SHARDED = "sharded"
+REPLICATED = "replicated"
+
+
+@dataclasses.dataclass
+class DistTable:
+    dt: DTable
+    dist: str  # SHARDED (rows split over AXIS) | REPLICATED
+
+
+def _gather(dt: DTable, nshards: int) -> DTable:
+    """GATHER exchange: all_gather every column -> replicated full table."""
+    cols = {}
+    for sym, v in dt.cols.items():
+        g = jax.lax.all_gather(v.data, AXIS)
+        data = g.reshape((-1,) + v.data.shape[1:])
+        valid = None
+        if v.valid is not None:
+            valid = jax.lax.all_gather(v.valid, AXIS).reshape(-1)
+        cols[sym] = Val(v.dtype, data, valid, v.dictionary)
+    live = jax.lax.all_gather(dt.live_mask(), AXIS).reshape(-1)
+    return DTable(cols, live, dt.n * nshards)
+
+
+class ShardedInterpreter:
+    """Trace-time walk of the plan producing a sharded computation.
+
+    Mirrors exec/executor.PlanInterpreter, with a distribution tag per
+    intermediate and collectives at distribution boundaries."""
+
+    def __init__(self, scans, capacities, nshards: int):
+        self.scans = scans
+        self.capacities = capacities
+        self.nshards = nshards
+        self.ok_flags: list = []
+        self.ok_keys: list[tuple] = []
+        self.used_capacity: dict[tuple, int] = {}
+
+    # -- plumbing shared with the local interpreter -------------------------
+
+    def _capacity(self, node, default: int, kind: str = "table") -> int:
+        cap = self.capacities.get((id(node), kind))
+        if cap is None:
+            hint = (getattr(node, "capacity", None) if kind == "table"
+                    else getattr(node, "output_capacity", None))
+            cap = hint or default
+        self.used_capacity[(id(node), kind)] = cap
+        return cap
+
+    def _note_ok(self, node, ok, kind: str = "table"):
+        # reduce over the mesh so every shard's overflow is reported
+        self.ok_flags.append(
+            jax.lax.pmin(ok.astype(jnp.int32), AXIS) > 0)
+        self.ok_keys.append((id(node), kind))
+
+    def run(self, node: N.PlanNode) -> DistTable:
+        m = getattr(self, "_r_" + type(node).__name__.lower())
+        return m(node)
+
+    def replicated(self, node: N.PlanNode) -> DTable:
+        out = self.run(node)
+        if out.dist == REPLICATED:
+            return out.dt
+        return _gather(out.dt, self.nshards)
+
+    # -- leaves -------------------------------------------------------------
+
+    def _r_tablescan(self, node: N.TableScan) -> DistTable:
+        scan, traced = self.scans[id(node)]
+        cols = {}
+        for sym in node.assignments:
+            cols[sym] = Val(scan.types[sym], traced[sym],
+                            traced.get(f"{sym}$valid"),
+                            scan.dictionaries[sym])
+        # traced arrays are the local shard; live mask from row padding
+        local_n = next(iter(traced.values())).shape[0]
+        live = traced["__live__"]
+        return DistTable(DTable(cols, live, local_n), SHARDED)
+
+    def _r_values(self, node: N.Values) -> DistTable:
+        from presto_tpu.exec.executor import PlanInterpreter
+        dt = PlanInterpreter({}, {})._r_values(node)
+        return DistTable(dt, REPLICATED)
+
+    # -- elementwise: keep distribution -------------------------------------
+
+    def _r_filter(self, node: N.Filter) -> DistTable:
+        src = self.run(node.source)
+        return DistTable(OP.apply_filter(src.dt, node.predicate), src.dist)
+
+    def _r_project(self, node: N.Project) -> DistTable:
+        src = self.run(node.source)
+        return DistTable(OP.apply_project(src.dt, node.assignments),
+                         src.dist)
+
+    # -- aggregation: partial local, merge replicated -----------------------
+
+    def _r_aggregate(self, node: N.Aggregate) -> DistTable:
+        src = self.run(node.source)
+        if src.dist == REPLICATED:
+            cap = (1 if not node.group_keys else
+                   self._capacity(node, next_pow2(2 * src.dt.n)))
+            out, ok = OP.apply_aggregate(src.dt, node, cap)
+            if node.group_keys:
+                self._note_ok(node, ok)
+            return DistTable(out, REPLICATED)
+        # partial -> gather states -> final merge (PushPartialAggregation
+        # ThroughExchange; psum-tree analog)
+        cap = (1 if not node.group_keys else
+               self._capacity(node, next_pow2(2 * src.dt.n)))
+        partial_node = dataclasses.replace(node, step=N.AggStep.PARTIAL)
+        final_node = dataclasses.replace(node, step=N.AggStep.FINAL)
+        if node.step == N.AggStep.SINGLE:
+            pass
+        elif node.step == N.AggStep.PARTIAL:
+            partial_node = node
+            final_node = None
+        partial, ok1 = OP.apply_aggregate(src.dt, partial_node, cap)
+        if node.group_keys:
+            self._note_ok(node, ok1)
+        gathered = _gather(partial, self.nshards)
+        if final_node is None:
+            return DistTable(gathered, REPLICATED)
+        fcap = (1 if not node.group_keys else
+                self._capacity(node, next_pow2(2 * cap), "final"))
+        out, ok2 = OP.apply_aggregate(gathered, final_node, fcap)
+        if node.group_keys:
+            self._note_ok(node, ok2, "final")
+        return DistTable(out, REPLICATED)
+
+    # -- joins: broadcast build side ----------------------------------------
+
+    def _r_join(self, node: N.Join) -> DistTable:
+        left = self.run(node.left)
+        build = self.replicated(node.right)  # FIXED_BROADCAST
+        cap = self._capacity(node, next_pow2(2 * build.n))
+        if node.build_unique:
+            out, ok = OP.apply_join(left.dt, build, node, cap)
+            self._note_ok(node, ok)
+            return DistTable(out, left.dist)
+        out_cap = self._capacity(
+            node, next_pow2(2 * (left.dt.n + build.n)), "out")
+        out, t_ok, o_ok = OP.apply_expand_join(left.dt, build, node, cap,
+                                               out_cap)
+        self._note_ok(node, t_ok)
+        self._note_ok(node, o_ok, "out")
+        return DistTable(out, left.dist)
+
+    def _r_semijoin(self, node: N.SemiJoin) -> DistTable:
+        src = self.run(node.source)
+        filt = self.replicated(node.filter_source)
+        cap = self._capacity(node, next_pow2(2 * filt.n))
+        out, ok = OP.apply_semijoin(src.dt, filt, node, cap)
+        self._note_ok(node, ok)
+        return DistTable(out, src.dist)
+
+    def _r_crossjoin(self, node: N.CrossJoin) -> DistTable:
+        left = self.run(node.left)
+        right = self.replicated(node.right)
+        if not node.scalar:
+            raise NotImplementedError("general cross join")
+        return DistTable(OP.apply_cross_scalar(left.dt, right), left.dist)
+
+    # -- replicated-only operators ------------------------------------------
+
+    def _r_distinct(self, node: N.Distinct) -> DistTable:
+        src = self.run(node.source)
+        cap = self._capacity(node, next_pow2(2 * src.dt.n))
+        if src.dist == SHARDED:
+            # local pre-distinct shrinks the exchange, then final distinct
+            local, ok1 = OP.apply_distinct(src.dt, cap)
+            self._note_ok(node, ok1)
+            gathered = _gather(local, self.nshards)
+            fcap = self._capacity(node, next_pow2(2 * cap), "final")
+            out, ok2 = OP.apply_distinct(gathered, fcap)
+            self._note_ok(node, ok2, "final")
+            return DistTable(out, REPLICATED)
+        out, ok = OP.apply_distinct(src.dt, cap)
+        self._note_ok(node, ok)
+        return DistTable(out, REPLICATED)
+
+    def _r_window(self, node: N.Window) -> DistTable:
+        # window partitions would repartition cleanly by partition key
+        # (all_to_all); v1 gathers — windows sit above heavy reductions
+        # in TPC-DS plans so the gathered input is small
+        dt = self.replicated(node.source)
+        return DistTable(OP.apply_window(dt, node), REPLICATED)
+
+    def _r_sort(self, node: N.Sort) -> DistTable:
+        dt = self.replicated(node.source)
+        return DistTable(OP.apply_sort(dt, node.orderings), REPLICATED)
+
+    def _r_topn(self, node: N.TopN) -> DistTable:
+        dt = self.replicated(node.source)
+        return DistTable(OP.apply_topn(dt, node.count, node.orderings),
+                         REPLICATED)
+
+    def _r_limit(self, node: N.Limit) -> DistTable:
+        dt = self.replicated(node.source)
+        return DistTable(OP.apply_limit(dt, node.count, node.offset),
+                         REPLICATED)
+
+    def _r_union(self, node: N.Union) -> DistTable:
+        parts = [self.run(s) for s in node.inputs]
+        if all(p.dist == SHARDED for p in parts):
+            out = OP.apply_union([p.dt for p in parts], node)
+            return DistTable(out, SHARDED)
+        dts = [p.dt if p.dist == REPLICATED
+               else _gather(p.dt, self.nshards) for p in parts]
+        return DistTable(OP.apply_union(dts, node), REPLICATED)
+
+    def _r_exchange(self, node: N.Exchange) -> DistTable:
+        src = self.run(node.source)
+        if node.kind == N.ExchangeType.GATHER and src.dist == SHARDED:
+            return DistTable(_gather(src.dt, self.nshards), REPLICATED)
+        return src
+
+    def _r_output(self, node: N.Output) -> DistTable:
+        src = self.run(node.source)
+        dt = (src.dt if src.dist == REPLICATED
+              else _gather(src.dt, self.nshards))
+        return DistTable(
+            DTable({s: dt.cols[s] for s in node.symbols}, dt.live, dt.n),
+            REPLICATED)
+
+
+def _shard_scan_arrays(scan: ScanInput, nshards: int):
+    """Pad rows to a multiple of nshards; returns arrays + live mask."""
+    n = scan.nrows
+    per = -(-max(n, 1) // nshards)
+    total = per * nshards
+    out = {}
+    for sym, a in scan.arrays.items():
+        out[sym] = np.pad(a, [(0, total - n)] + [(0, 0)] * (a.ndim - 1))
+    out["__live__"] = np.arange(total) < n
+    return out
+
+
+def execute_plan_distributed(engine, plan: N.PlanNode,
+                             mesh: Mesh) -> Table:
+    """Compile + run a logical plan over every device in ``mesh``."""
+    nshards = mesh.devices.size
+    scan_inputs = collect_scans(plan, engine)
+    capacities: dict[tuple, int] = {}
+
+    sharded_arrays = [
+        _shard_scan_arrays(scan, nshards) for scan in scan_inputs]
+    flat_names = [(i, sym) for i, arrs in enumerate(sharded_arrays)
+                  for sym in arrs]
+    flat_arrays = [sharded_arrays[i][sym] for i, sym in flat_names]
+
+    for _attempt in range(10):
+        meta: dict[str, object] = {}
+
+        def traced_fn(*args):
+            it = iter(args)
+            scans = {}
+            per_scan: dict[int, dict] = {}
+            for (i, sym), a in zip(flat_names, it):
+                per_scan.setdefault(i, {})[sym] = a
+            for i, scan in enumerate(scan_inputs):
+                scans[id(scan.node)] = (scan, per_scan[i])
+            interp = ShardedInterpreter(scans, capacities, nshards)
+            out = interp.run(plan).dt
+            meta["out"] = [
+                (sym, v.dtype, v.dictionary, v.valid is not None)
+                for sym, v in out.cols.items()]
+            meta["ok_keys"] = interp.ok_keys
+            meta["used_capacity"] = interp.used_capacity
+            res = []
+            for sym, v in out.cols.items():
+                res.append(v.data)
+                res.append(v.valid if v.valid is not None
+                           else jnp.ones((out.n,), dtype=bool))
+            return tuple(res), out.live_mask(), tuple(interp.ok_flags)
+
+        n_out = None  # resolved after trace
+        sharded = jax.shard_map(
+            traced_fn, mesh=mesh,
+            in_specs=tuple(P(AXIS) for _ in flat_arrays),
+            out_specs=(P(), P(), P()),
+            check_vma=False)
+        compiled = jax.jit(sharded)
+        with mesh:
+            res, live, oks = compiled(*flat_arrays)
+        del n_out
+        if all(bool(np.asarray(o)) for o in oks):
+            break
+        for key, okv in zip(meta["ok_keys"], oks):
+            if not bool(np.asarray(okv)):
+                capacities[key] = 2 * meta["used_capacity"][key]
+    else:
+        raise RuntimeError("hash table capacity retry limit exceeded")
+
+    live_np = np.asarray(live)
+    cols: dict[str, Column] = {}
+    i = 0
+    for sym, dtype, dictionary, has_valid in meta["out"]:
+        data = np.asarray(res[i])
+        valid = np.asarray(res[i + 1])
+        i += 2
+        cols[sym] = Column(dtype, data,
+                           valid if has_valid or not valid.all() else None,
+                           dictionary)
+    from presto_tpu.exec.executor import _rename_outputs
+    return Table(_rename_outputs(plan, cols), len(live_np), live_np)

@@ -333,6 +333,47 @@ class Distinct(PlanNode):
         return self.source.output_types()
 
 
+@dataclasses.dataclass(frozen=True)
+class WindowCall:
+    """One planned window function: fn over (args) with the node's
+    partition/order; frame semantics follow SQL defaults (RANGE UNBOUNDED
+    PRECEDING..CURRENT ROW with ORDER BY, full partition without)."""
+
+    fn: str  # rank|dense_rank|row_number|ntile|lag|lead|first_value|
+    #          sum|count|avg|min|max
+    args: tuple[ir.Expr, ...]
+    dtype: T.DataType
+    # frame: None = SQL default; "rows_unbounded_current" supported
+    frame: Optional[str] = None
+
+
+@dataclasses.dataclass
+class Window(PlanNode):
+    """Window functions over sorted partitions (plan/WindowNode.java,
+    operator/WindowOperator.java:70). All functions on one node share
+    partition_by + orderings (the planner splits differing specs into
+    separate nodes)."""
+
+    source: PlanNode = None  # type: ignore[assignment]
+    partition_by: list[str] = dataclasses.field(default_factory=list)
+    orderings: list["Ordering"] = dataclasses.field(default_factory=list)
+    functions: dict[str, WindowCall] = dataclasses.field(
+        default_factory=dict)  # output symbol -> call
+
+    def sources(self):
+        return [self.source]
+
+    @property
+    def output_symbols(self):
+        return self.source.output_symbols + list(self.functions)
+
+    def output_types(self):
+        out = self.source.output_types()
+        for s, c in self.functions.items():
+            out[s] = c.dtype
+        return out
+
+
 class ExchangeType(enum.Enum):
     GATHER = "gather"  # all shards -> one
     REPARTITION = "repartition"  # hash all_to_all

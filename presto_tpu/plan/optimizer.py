@@ -113,6 +113,15 @@ def prune_columns(node: N.PlanNode,
         right = prune_columns(node.right, needed & rsyms)
         return dataclasses.replace(node, left=left, right=right)
 
+    if isinstance(node, N.Window):
+        funcs = {s: c for s, c in node.functions.items() if s in needed}
+        child = (needed - set(funcs)) | set(node.partition_by) \
+            | {o.symbol for o in node.orderings} \
+            | _expr_refs(*[a for c in funcs.values() for a in c.args])
+        child &= set(node.source.output_types())
+        src = prune_columns(node.source, child)
+        return dataclasses.replace(node, source=src, functions=funcs)
+
     if isinstance(node, (N.Sort, N.TopN)):
         child = needed | {o.symbol for o in node.orderings}
         src = prune_columns(node.source, child)
@@ -156,7 +165,8 @@ def inline_trivial_projects(node: N.PlanNode) -> N.PlanNode:
         if isinstance(node, N.Output):
             rebuilt = dataclasses.replace(node, source=new_kids[0])
         elif isinstance(node, (N.Filter, N.Project, N.Aggregate, N.Sort,
-                               N.TopN, N.Limit, N.Distinct, N.Exchange)):
+                               N.TopN, N.Limit, N.Distinct, N.Exchange,
+                               N.Window)):
             rebuilt = dataclasses.replace(node, source=new_kids[0])
         elif isinstance(node, (N.Join, N.CrossJoin)):
             rebuilt = dataclasses.replace(node, left=new_kids[0],

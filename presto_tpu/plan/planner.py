@@ -454,15 +454,16 @@ def factor_or(e: A.Expression) -> A.Expression:
         "and", tuple(common) + (A.LogicalOp("or", tuple(residuals)),))
 
 
-def find_agg_calls(e: A.Expression | None) -> list[A.FunctionCall]:
+def _collect_calls(e: A.Expression | None, pred) -> list[A.FunctionCall]:
+    """Collect FunctionCall nodes matching ``pred`` without descending
+    into matches (their arguments belong to the inner evaluation)."""
     out: list[A.FunctionCall] = []
 
     def walk(x):
-        if isinstance(x, A.FunctionCall):
-            if x.name in AGG_FUNCTIONS and x.window is None:
-                if x not in out:
-                    out.append(x)
-                return  # don't descend into agg args
+        if isinstance(x, A.FunctionCall) and pred(x):
+            if x not in out:
+                out.append(x)
+            return
         for f in dataclasses.fields(x) if dataclasses.is_dataclass(x) else ():
             v = getattr(x, f.name)
             if isinstance(v, A.Expression):
@@ -478,6 +479,19 @@ def find_agg_calls(e: A.Expression | None) -> list[A.FunctionCall]:
     if e is not None:
         walk(e)
     return out
+
+
+def find_agg_calls(e: A.Expression | None) -> list[A.FunctionCall]:
+    return _collect_calls(
+        e, lambda x: x.name in AGG_FUNCTIONS and x.window is None)
+
+
+WINDOW_FNS = {"rank", "dense_rank", "row_number", "lag", "lead",
+              "first_value", "sum", "count", "avg", "min", "max"}
+
+
+def find_window_calls(e: A.Expression | None) -> list[A.FunctionCall]:
+    return _collect_calls(e, lambda x: x.window is not None)
 
 
 def find_subquery_nodes(e: A.Expression) -> list[A.Expression]:
@@ -937,6 +951,15 @@ class LogicalPlanner:
             for c in split_conjuncts(spec.having):
                 self._apply_conjunct(qs, c, ctx, ctes, group_map)
 
+        # ---- window functions (evaluate after aggregation/having) ----
+        window_calls: list[A.FunctionCall] = []
+        for e in select_exprs + order_exprs:
+            for w in find_window_calls(e):
+                if w not in window_calls:
+                    window_calls.append(w)
+        if window_calls:
+            self._plan_windows(qs, window_calls, ctx, ctes, group_map)
+
         # ---- SELECT projections ----
         assignments: dict[str, ir.Expr] = {}
         fields: list[Field] = []
@@ -1238,16 +1261,61 @@ class LogicalPlanner:
     # -- aggregation --------------------------------------------------------
 
     def _resolve_group_by(self, spec: A.QuerySpec) -> list[A.Expression]:
+        """Plain grouping expressions (ordinals resolved). Multi-set
+        grouping (ROLLUP/CUBE/GROUPING SETS) resolves via
+        _resolve_grouping_sets."""
         out = []
         for g in spec.group_by:
-            if g.kind != "simple":
-                raise SemanticError(
-                    f"GROUP BY {g.kind.upper()} not supported yet")
-            e = g.expressions[0]
-            if isinstance(e, A.NumericLiteral):
-                idx = int(e.text) - 1
-                e = spec.select_items[idx].expression
-            out.append(e)
+            for e in (g.expressions if g.kind != "sets"
+                      else [x for s in g.expressions for x in s]):
+                e = self._resolve_ordinal(e, spec)
+                if e not in out:
+                    out.append(e)
+        return out
+
+    def _resolve_ordinal(self, e: A.Expression,
+                         spec: A.QuerySpec) -> A.Expression:
+        if isinstance(e, A.NumericLiteral):
+            return spec.select_items[int(e.text) - 1].expression
+        return e
+
+    def _resolve_grouping_sets(
+            self, spec: A.QuerySpec) -> list[list[A.Expression]] | None:
+        """None for plain GROUP BY; else the expanded list of grouping
+        sets (reference sql/analyzer computes the cross product of
+        element-wise sets the same way, StatementAnalyzer.analyzeGroupBy)."""
+        import itertools
+        if all(g.kind == "simple" for g in spec.group_by):
+            return None
+        per_element: list[list[list[A.Expression]]] = []
+        for g in spec.group_by:
+            exprs = [self._resolve_ordinal(e, spec)
+                     for e in (g.expressions if g.kind != "sets" else [])]
+            if g.kind == "simple":
+                per_element.append([exprs])
+            elif g.kind == "rollup":
+                per_element.append(
+                    [exprs[:k] for k in range(len(exprs), -1, -1)])
+            elif g.kind == "cube":
+                sets = []
+                for mask in range(1 << len(exprs)):
+                    sets.append([e for i, e in enumerate(exprs)
+                                 if mask >> i & 1])
+                per_element.append(sets)
+            else:  # explicit GROUPING SETS
+                sets = []
+                for s in g.expressions:
+                    sets.append([self._resolve_ordinal(e, spec)
+                                 for e in s])
+                per_element.append(sets)
+        out: list[list[A.Expression]] = []
+        for combo in itertools.product(*per_element):
+            merged: list[A.Expression] = []
+            for part in combo:
+                for e in part:
+                    if e not in merged:
+                        merged.append(e)
+            out.append(merged)
         return out
 
     def _plan_aggregation(self, qs: QState, spec: A.QuerySpec,
@@ -1259,10 +1327,12 @@ class LogicalPlanner:
         planner = ExprPlanner(pre_ctx)
 
         group_syms: list[str] = []
+        ast_to_sym: dict[A.Expression, str] = {}
         for e in group_exprs:
             g_ir = planner.plan(e)
             sym = qs.add_projection(g_ir, _expr_name(e), self)
             group_map[g_ir] = sym
+            ast_to_sym[e] = sym
             group_syms.append(sym)
 
         # decorrelation: correlation symbols join the grouping keys
@@ -1290,6 +1360,15 @@ class LogicalPlanner:
             sym = self.symbols.fresh(fn)
             aggs[sym] = AggCall(fn, arg_ir, out_t, call.distinct)
             agg_syms[call] = (sym, out_t)
+
+        gsets = self._resolve_grouping_sets(spec)
+        if gsets is not None:
+            if distinct_calls:
+                raise SemanticError(
+                    "DISTINCT aggregates with grouping sets unsupported")
+            self._plan_grouping_sets(qs, gsets, ast_to_sym, group_syms,
+                                     aggs)
+            return ExprCtx(qs.scope, self, outer, agg_syms=agg_syms)
 
         if distinct_calls:
             if len(agg_calls) != len(distinct_calls) or len(
@@ -1333,10 +1412,134 @@ class LogicalPlanner:
         qs.unique = [frozenset(group_syms)] if group_syms else []
         return ExprCtx(qs.scope, self, outer, agg_syms=agg_syms)
 
+    def _plan_grouping_sets(self, qs: QState,
+                            gsets: list[list[A.Expression]],
+                            ast_to_sym: dict[A.Expression, str],
+                            group_syms: list[str],
+                            aggs: dict[str, AggCall]) -> None:
+        """ROLLUP/CUBE/GROUPING SETS as a UNION ALL of one aggregation
+        per set, with ungrouped keys projected as typed NULLs (reference
+        AggregationNode carries groupingSets natively,
+        plan/AggregationNode.java; the union form is its expansion)."""
+        source = qs.node
+        types = source.output_types()
+        branches: list[N.PlanNode] = []
+        mappings: list[dict[str, str]] = []
+        out_syms = list(group_syms) + list(aggs)
+        for s in gsets:
+            keys_b = [ast_to_sym[e] for e in s]
+            # keep decorrelation keys grouped in every branch
+            for sym in group_syms:
+                if sym not in ast_to_sym.values() and sym not in keys_b:
+                    keys_b.append(sym)
+            agg_node = N.Aggregate(
+                source, keys_b, dict(aggs), N.AggStep.SINGLE,
+                capacity=self._group_capacity(qs.est, keys_b))
+            atypes = agg_node.output_types()
+            assigns: dict[str, ir.Expr] = {}
+            for sym in group_syms:
+                if sym in keys_b:
+                    assigns[sym] = ir.ColumnRef(atypes[sym], sym)
+                else:
+                    assigns[sym] = ir.Literal(types[sym], None)
+            for a in aggs:
+                assigns[a] = ir.ColumnRef(atypes[a], a)
+            branches.append(N.Project(agg_node, assigns))
+            mappings.append({sym: sym for sym in out_syms})
+        utypes = {s: (types[s] if s in group_syms
+                      else branches[0].output_types()[s])
+                  for s in out_syms}
+        union = N.Union(branches, out_syms, utypes, mappings)
+        fields = []
+        by_symbol = {f.symbol: f for f in qs.scope.fields}
+        for s in out_syms:
+            base = by_symbol.get(s)
+            fields.append(Field(base.name if base else None,
+                                base.qualifier if base else None, s,
+                                utypes[s]))
+        qs.node = union
+        qs.scope = Scope(fields)
+        qs.est = sum(b.sources()[0].capacity or qs.est
+                     for b in branches)
+        qs.unique = []
+
     def _group_capacity(self, est_rows: int, group_syms: list[str]) -> int:
         if not group_syms:
             return 1
         return _next_pow2(2 * max(1024, min(est_rows, 1 << 21)))
+
+    def _plan_windows(self, qs: QState,
+                      calls: list[A.FunctionCall], ctx: ExprCtx,
+                      ctes, group_map: dict[ir.Expr, str]) -> None:
+        """Plan window functions: calls sharing a (partition, order) spec
+        land on one Window node (reference WindowNode merging in
+        LogicalPlanner/QueryPlanner.planWindowFunctions)."""
+        by_spec: dict[tuple, list[A.FunctionCall]] = {}
+        for call in calls:
+            spec_key = (call.window.partition_by, call.window.order_by,
+                        call.window.frame)
+            by_spec.setdefault(spec_key, []).append(call)
+        for (_, _, frame_ast), group in by_spec.items():
+            w = group[0].window
+            part_syms = []
+            for pe in w.partition_by:
+                p_ir = self._plan_scalar_expr(qs, pe, ctx, ctes, group_map)
+                part_syms.append(qs.add_projection(p_ir, "wpart", self))
+            orderings = []
+            for item in w.order_by:
+                o_ir = self._plan_scalar_expr(qs, item.expression, ctx,
+                                              ctes, group_map)
+                sym = qs.add_projection(o_ir, "worder", self)
+                orderings.append(N.Ordering(sym, item.ascending,
+                                            item.nulls_first))
+            frame = None
+            if not w.order_by:
+                if frame_ast is not None:
+                    raise SemanticError(
+                        "window frame requires ORDER BY")
+                frame = "full_partition"
+            elif frame_ast is not None:
+                supported = (frame_ast.start_type == "unbounded_preceding"
+                             and frame_ast.end_type in ("current", None)
+                             and frame_ast.unit in ("rows", "range"))
+                if not supported:
+                    raise SemanticError(
+                        "only [ROWS|RANGE] UNBOUNDED PRECEDING..CURRENT "
+                        "ROW window frames are supported")
+                if frame_ast.unit == "rows":
+                    # ROWS excludes later peers; RANGE (the default)
+                    # includes the whole peer group
+                    frame = "rows_unbounded_current"
+            functions: dict[str, N.WindowCall] = {}
+            for call in group:
+                fn = call.name
+                if fn not in WINDOW_FNS:
+                    raise SemanticError(f"unknown window function {fn}")
+                if call.distinct:
+                    raise SemanticError(
+                        "DISTINCT window aggregates are not supported")
+                args = tuple(
+                    self._plan_scalar_expr(qs, a, ctx, ctes, group_map)
+                    for a in call.args)
+                if fn in ("lag", "lead") and len(args) > 1 \
+                        and not isinstance(args[1], ir.Literal):
+                    raise SemanticError(
+                        f"{fn} offset must be a literal")
+                if fn in ("rank", "dense_rank", "row_number", "count"):
+                    dtype: T.DataType = T.BIGINT
+                elif fn == "sum":
+                    dtype = AGG.output_type("sum", args[0].dtype)
+                elif fn == "avg":
+                    dtype = T.DOUBLE
+                else:
+                    dtype = args[0].dtype
+                sym = self.symbols.fresh(fn)
+                functions[sym] = N.WindowCall(fn, args, dtype, frame)
+                ctx.subquery_syms[call] = ir.ColumnRef(dtype, sym)
+            qs.node = N.Window(qs.node, part_syms, orderings, functions)
+            qs.scope = Scope(qs.scope.fields + [
+                Field(None, None, s, c.dtype)
+                for s, c in functions.items()])
 
     # -- scalar expressions with embedded subqueries ------------------------
 

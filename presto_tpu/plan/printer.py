@@ -9,12 +9,15 @@ from __future__ import annotations
 from presto_tpu.plan import nodes as N
 
 
-def format_plan(node: N.PlanNode, indent: int = 0) -> str:
+def format_plan(node: N.PlanNode, indent: int = 0,
+                annotations: dict[int, str] | None = None) -> str:
     pad = " " * (4 * indent)
     line = pad + _describe(node)
+    if annotations and id(node) in annotations:
+        line += f"  [{annotations[id(node)]}]"
     parts = [line]
     for s in node.sources():
-        parts.append(format_plan(s, indent + 1))
+        parts.append(format_plan(s, indent + 1, annotations))
     return "\n".join(parts)
 
 
@@ -48,6 +51,10 @@ def _describe(node: N.PlanNode) -> str:
         return f"SemiJoin[{neg}{keys}] => {node.output}"
     if isinstance(node, N.CrossJoin):
         return f"CrossJoin[{'scalar' if node.scalar else 'expanding'}]"
+    if isinstance(node, N.Window):
+        fns = ", ".join(f"{s} := {c.fn}" for s, c in node.functions.items())
+        return (f"Window[partition={node.partition_by}, "
+                f"order={_orderings(node.orderings)}] [{fns}]")
     if isinstance(node, N.Sort):
         return f"Sort[{_orderings(node.orderings)}]"
     if isinstance(node, N.TopN):

@@ -1,0 +1,284 @@
+"""HTTP coordinator: Trino-protocol query execution over the engine.
+
+Endpoints (reference file:line):
+- POST /v1/statement            submit SQL; returns QueryResults JSON with
+                                nextUri (QueuedStatementResource.java:176)
+- GET  /v1/statement/executing/{id}/{token}
+                                poll results; data paged with continuation
+                                tokens (ExecutingStatementResource.java)
+- DELETE /v1/statement/executing/{id}/{token}
+                                cancel (Query.java cancel)
+- GET  /v1/info                 server info (ServerInfoResource)
+- GET  /v1/status               node status (StatusResource.java)
+- GET  /v1/query                query list (QueryResource.java)
+
+Queries run on a thread pool (the dispatcher analog,
+dispatcher/DispatchManager.java:140); state machine QUEUED -> RUNNING ->
+FINISHED|FAILED|CANCELED mirrors execution/QueryState.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import threading
+import time
+import uuid
+from concurrent.futures import ThreadPoolExecutor
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+
+from presto_tpu import types as T
+
+PAGE_ROWS = 4096
+
+
+@dataclasses.dataclass
+class QueryInfo:
+    query_id: str
+    sql: str
+    user: str
+    state: str = "QUEUED"  # QUEUED|RUNNING|FINISHED|FAILED|CANCELED
+    error: str | None = None
+    columns: list[dict] | None = None
+    rows: list[list] | None = None
+    created: float = dataclasses.field(default_factory=time.monotonic)
+    started: float | None = None
+    finished: float | None = None
+    rows_sent: int = 0
+
+    def stats(self) -> dict:
+        wall = ((self.finished or time.monotonic())
+                - (self.started or self.created))
+        return {
+            "state": self.state,
+            "queued": self.state == "QUEUED",
+            "scheduled": self.state in ("RUNNING", "FINISHED"),
+            "elapsedTimeMillis": int(wall * 1000),
+            "processedRows": len(self.rows or []),
+        }
+
+
+def _json_value(v, dtype: T.DataType):
+    if v is None:
+        return None
+    if isinstance(dtype, T.DecimalType):
+        return f"{v:.{dtype.scale}f}"
+    if isinstance(dtype, T.DateType):
+        return str(v)
+    if isinstance(v, (np.integer,)):
+        return int(v)
+    if isinstance(v, (np.floating,)):
+        return float(v)
+    if isinstance(v, (np.bool_,)):
+        return bool(v)
+    if isinstance(v, np.str_):
+        return str(v)
+    if isinstance(v, np.datetime64):
+        return str(v)
+    return v
+
+
+class QueryManager:
+    """Dispatch + tracking (DispatchManager + QueryTracker analog)."""
+
+    def __init__(self, engine, max_concurrency: int = 4):
+        self.engine = engine
+        self.queries: dict[str, QueryInfo] = {}
+        self.pool = ThreadPoolExecutor(max_workers=max_concurrency)
+        self.lock = threading.Lock()
+
+    def submit(self, sql: str, user: str) -> QueryInfo:
+        qid = f"{time.strftime('%Y%m%d_%H%M%S')}_{uuid.uuid4().hex[:5]}"
+        q = QueryInfo(qid, sql, user)
+        with self.lock:
+            self.queries[qid] = q
+        self.pool.submit(self._run, q)
+        return q
+
+    def _run(self, q: QueryInfo) -> None:
+        if q.state == "CANCELED":
+            return
+        q.state = "RUNNING"
+        q.started = time.monotonic()
+        try:
+            table_or_rows = self.engine.execute(q.sql)
+            plan_cols = self._result_columns(q.sql, table_or_rows)
+            q.columns = plan_cols[0]
+            dtypes = plan_cols[1]
+            q.rows = [
+                [_json_value(v, t) for v, t in zip(row, dtypes)]
+                for row in table_or_rows]
+            if q.state != "CANCELED":
+                q.state = "FINISHED"
+        except Exception as e:  # noqa: BLE001 - surfaced to the client
+            q.error = f"{type(e).__name__}: {e}"
+            q.state = "FAILED"
+        finally:
+            q.finished = time.monotonic()
+
+    def _result_columns(self, sql: str, rows):
+        from presto_tpu.sql import ast as A
+        from presto_tpu.sql.parser import parse_statement
+        try:
+            stmt = parse_statement(sql)
+            if isinstance(stmt, A.QueryStatement):
+                plan, _ = self.engine.plan_sql(sql)
+                types = plan.output_types()
+                cols = [{"name": n, "type": str(types[s])}
+                        for n, s in zip(plan.names, plan.symbols)]
+                return cols, [types[s] for s in plan.symbols]
+        except Exception:  # noqa: BLE001
+            pass
+        width = len(rows[0]) if rows else 1
+        cols = [{"name": f"_col{i}", "type": "varchar"}
+                for i in range(width)]
+        return cols, [T.VARCHAR] * width
+
+    def get(self, qid: str) -> QueryInfo | None:
+        return self.queries.get(qid)
+
+    def cancel(self, qid: str) -> None:
+        q = self.queries.get(qid)
+        if q is not None and q.state in ("QUEUED", "RUNNING"):
+            q.state = "CANCELED"
+            q.finished = time.monotonic()
+
+
+class _Handler(BaseHTTPRequestHandler):
+    manager: QueryManager = None  # type: ignore[assignment]
+    server_start = time.time()
+
+    def log_message(self, fmt, *args):  # quiet
+        pass
+
+    # -- helpers ------------------------------------------------------------
+
+    def _send_json(self, obj, status: int = 200) -> None:
+        body = json.dumps(obj).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _base_uri(self) -> str:
+        host = self.headers.get("Host", "localhost")
+        return f"http://{host}"
+
+    def _query_results(self, q: QueryInfo, token: int) -> dict:
+        out: dict = {
+            "id": q.query_id,
+            "infoUri": f"{self._base_uri()}/v1/query/{q.query_id}",
+            "stats": q.stats(),
+        }
+        if q.state == "FAILED":
+            out["error"] = {"message": q.error,
+                            "errorName": "GENERIC_INTERNAL_ERROR"}
+            return out
+        if q.state in ("QUEUED", "RUNNING"):
+            out["nextUri"] = (f"{self._base_uri()}/v1/statement/executing/"
+                              f"{q.query_id}/{token}")
+            return out
+        if q.state == "FINISHED":
+            out["columns"] = q.columns
+            start = token * PAGE_ROWS
+            chunk = (q.rows or [])[start:start + PAGE_ROWS]
+            if chunk:
+                out["data"] = chunk
+            if start + PAGE_ROWS < len(q.rows or []):
+                out["nextUri"] = (
+                    f"{self._base_uri()}/v1/statement/executing/"
+                    f"{q.query_id}/{token + 1}")
+        return out
+
+    # -- routes -------------------------------------------------------------
+
+    def do_POST(self):  # noqa: N802
+        if self.path == "/v1/statement":
+            length = int(self.headers.get("Content-Length", 0))
+            sql = self.rfile.read(length).decode()
+            user = self.headers.get("X-Trino-User",
+                                    self.headers.get("X-Presto-User",
+                                                     "anonymous"))
+            q = self.manager.submit(sql, user)
+            self._send_json(self._query_results(q, 0))
+            return
+        self._send_json({"error": "not found"}, 404)
+
+    def do_GET(self):  # noqa: N802
+        parts = self.path.strip("/").split("/")
+        if self.path == "/v1/info":
+            self._send_json({
+                "nodeVersion": {"version": "presto-tpu-0.1"},
+                "environment": "tpu",
+                "coordinator": True,
+                "starting": False,
+                "uptime": f"{time.time() - self.server_start:.0f}s",
+            })
+            return
+        if self.path == "/v1/status":
+            self._send_json({
+                "nodeId": "coordinator",
+                "state": "active",
+                "coordinator": True,
+                "uptime": f"{time.time() - self.server_start:.0f}s",
+            })
+            return
+        if self.path == "/v1/query":
+            self._send_json([
+                {"queryId": q.query_id, "state": q.state,
+                 "query": q.sql, "user": q.user}
+                for q in self.manager.queries.values()])
+            return
+        if len(parts) == 3 and parts[:2] == ["v1", "query"]:
+            q = self.manager.get(parts[2])
+            if q is None:
+                self._send_json({"error": "unknown query"}, 404)
+                return
+            self._send_json({
+                "queryId": q.query_id, "state": q.state, "query": q.sql,
+                "user": q.user, "stats": q.stats(),
+                "error": q.error})
+            return
+        if len(parts) == 5 and parts[:3] == ["v1", "statement",
+                                             "executing"]:
+            q = self.manager.get(parts[3])
+            if q is None:
+                self._send_json({"error": "unknown query"}, 404)
+                return
+            self._send_json(self._query_results(q, int(parts[4])))
+            return
+        self._send_json({"error": "not found"}, 404)
+
+    def do_DELETE(self):  # noqa: N802
+        parts = self.path.strip("/").split("/")
+        if len(parts) >= 4 and parts[:3] == ["v1", "statement",
+                                             "executing"]:
+            self.manager.cancel(parts[3])
+            self.send_response(204)
+            self.end_headers()
+            return
+        self._send_json({"error": "not found"}, 404)
+
+
+class CoordinatorServer:
+    """Threaded HTTP coordinator over an Engine (Server.java:75 analog)."""
+
+    def __init__(self, engine, host: str = "127.0.0.1", port: int = 0):
+        handler = type("BoundHandler", (_Handler,), {
+            "manager": QueryManager(engine)})
+        self.httpd = ThreadingHTTPServer((host, port), handler)
+        self.port = self.httpd.server_address[1]
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> "CoordinatorServer":
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()

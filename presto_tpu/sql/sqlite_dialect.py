@@ -128,10 +128,13 @@ def _rel(r: A.Relation) -> str:
 def _sort_item(s: A.SortItem) -> str:
     out = _expr(s.expression)
     out += " ASC" if s.ascending else " DESC"
-    if s.nulls_first is True:
-        out += " NULLS FIRST"
-    elif s.nulls_first is False:
-        out += " NULLS LAST"
+    nulls_first = s.nulls_first
+    if nulls_first is None:
+        # engine default matches the reference: NULLS LAST in ASC,
+        # NULLS FIRST in DESC; sqlite defaults the opposite way, so
+        # always render explicitly
+        nulls_first = not s.ascending
+    out += " NULLS FIRST" if nulls_first else " NULLS LAST"
     return out
 
 
@@ -202,13 +205,43 @@ def _expr(e: A.Expression) -> str:
             out += f" ESCAPE {_expr(e.escape)}"
         return out + ")"
     if isinstance(e, A.FunctionCall):
-        if e.is_star:
-            return f"{e.name}(*)"
         d = "DISTINCT " if e.distinct else ""
-        args = ", ".join(_expr(a) for a in e.args)
+        args = "*" if e.is_star else ", ".join(_expr(a) for a in e.args)
         name = {"substring": "substr", "arbitrary": "max"}.get(
             e.name, e.name)
-        return f"{name}({d}{args})"
+        out = f"{name}({d}{args})"
+        if e.window is not None:
+            w = e.window
+            parts = []
+            if w.partition_by:
+                parts.append("PARTITION BY " + ", ".join(
+                    _expr(p) for p in w.partition_by))
+            if w.order_by:
+                parts.append("ORDER BY " + ", ".join(
+                    _sort_item(s) for s in w.order_by))
+            if w.frame is not None:
+                unit = w.frame.unit.upper()
+
+                def bound(btype, bvalue):
+                    fixed = {
+                        "unbounded_preceding": "UNBOUNDED PRECEDING",
+                        "unbounded_following": "UNBOUNDED FOLLOWING",
+                        "current": "CURRENT ROW",
+                        "preceding": f"{_expr(bvalue)} PRECEDING"
+                        if bvalue is not None else "PRECEDING",
+                        "following": f"{_expr(bvalue)} FOLLOWING"
+                        if bvalue is not None else "FOLLOWING",
+                    }
+                    return fixed[btype]
+
+                s = bound(w.frame.start_type, w.frame.start_value)
+                if w.frame.end_type is not None:
+                    t = bound(w.frame.end_type, w.frame.end_value)
+                    parts.append(f"{unit} BETWEEN {s} AND {t}")
+                else:
+                    parts.append(f"{unit} {s}")
+            out += f" OVER ({' '.join(parts)})"
+        return out
     if isinstance(e, A.CastExpression):
         t = e.type_name.lower()
         if t.startswith("decimal") or t in ("double", "real", "float"):

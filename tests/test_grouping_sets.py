@@ -1,0 +1,71 @@
+"""ROLLUP / CUBE / GROUPING SETS tests. sqlite has no native grouping
+sets, so the oracle runs the UNION ALL expansion by hand (the same
+expansion the planner performs — reference plan/AggregationNode
+groupingSets)."""
+
+from presto_tpu.testing.oracle import rows_equal
+
+
+def _check(engine, oracle, sql, oracle_sql):
+    got = engine.execute(sql)
+    want = oracle.query(oracle_sql)
+    ok, msg = rows_equal(got, want, ordered=True)
+    assert ok, msg
+
+
+def test_rollup(engine, oracle):
+    _check(engine, oracle, """
+        select n_regionkey, n_name, count(*) as c from nation
+        group by rollup(n_regionkey, n_name)
+        order by n_regionkey, n_name, c""", """
+        select * from (
+          select n_regionkey, n_name, count(*) from nation
+            group by n_regionkey, n_name
+          union all select n_regionkey, null, count(*) from nation
+            group by n_regionkey
+          union all select null, null, count(*) from nation)
+        order by 1 nulls last, 2 nulls last, 3""")
+
+
+def test_grouping_sets(engine, oracle):
+    _check(engine, oracle, """
+        select n_regionkey, count(*) from nation
+        group by grouping sets ((n_regionkey), ())
+        order by n_regionkey""", """
+        select * from (
+          select n_regionkey, count(*) from nation group by n_regionkey
+          union all select null, count(*) from nation)
+        order by 1 nulls last""")
+
+
+def test_cube_with_aggs(engine, oracle):
+    _check(engine, oracle, """
+        select n_regionkey, r_name, count(*), sum(n_nationkey)
+        from nation, region where n_regionkey = r_regionkey
+        group by cube(n_regionkey, r_name) order by 1, 2, 3""", """
+        select * from (
+          select n_regionkey, r_name, count(*), sum(n_nationkey)
+            from nation, region where n_regionkey = r_regionkey
+            group by n_regionkey, r_name
+          union all select n_regionkey, null, count(*), sum(n_nationkey)
+            from nation, region where n_regionkey = r_regionkey
+            group by n_regionkey
+          union all select null, r_name, count(*), sum(n_nationkey)
+            from nation, region where n_regionkey = r_regionkey
+            group by r_name
+          union all select null, null, count(*), sum(n_nationkey)
+            from nation, region where n_regionkey = r_regionkey)
+        order by 1 nulls last, 2 nulls last, 3""")
+
+
+def test_mixed_simple_and_rollup(engine, oracle):
+    _check(engine, oracle, """
+        select n_regionkey, n_name, count(*) from nation
+        group by n_regionkey, rollup(n_name)
+        order by 1, 2""", """
+        select * from (
+          select n_regionkey, n_name, count(*) from nation
+            group by n_regionkey, n_name
+          union all select n_regionkey, null, count(*) from nation
+            group by n_regionkey)
+        order by 1 nulls last, 2 nulls last""")

@@ -1,0 +1,94 @@
+"""Statement-layer tests: SHOW/SET SESSION/EXPLAIN/CTAS/INSERT/DROP —
+the analog of the reference's DDL task executors (execution/*Task.java)
+and SHOW rewrites (sql/rewrite/ShowQueriesRewrite.java)."""
+
+import pytest
+
+from presto_tpu import Engine
+from presto_tpu.connectors.memory import MemoryConnector
+from presto_tpu.connectors.tpch import TpchConnector
+
+
+@pytest.fixture()
+def eng(tpch_tiny):
+    e = Engine()
+    e.register_catalog("tpch", tpch_tiny)
+    e.register_catalog("memory", MemoryConnector())
+    return e
+
+
+def test_show_catalogs(eng):
+    assert eng.execute("show catalogs") == [("memory",), ("tpch",)]
+
+
+def test_show_tables(eng):
+    tables = [t for (t,) in eng.execute("show tables")]
+    assert "lineitem" in tables and "nation" in tables
+
+
+def test_show_columns(eng):
+    cols = dict(eng.execute("show columns from nation"))
+    assert cols["n_nationkey"] == "bigint"
+    assert cols["n_name"] == "varchar"
+
+
+def test_set_show_session(eng):
+    eng.execute("set session join_distribution_type = 'BROADCAST'")
+    rows = {r[0]: r[1] for r in eng.execute("show session")}
+    assert rows["join_distribution_type"] == "BROADCAST"
+
+
+def test_explain(eng):
+    (text,) = eng.execute("explain select count(*) from nation")[0]
+    assert "Aggregate" in text and "TableScan" in text
+
+
+def test_explain_analyze(eng):
+    (text,) = eng.execute(
+        "explain analyze select count(*) from nation "
+        "where n_regionkey = 1")[0]
+    assert "rows:" in text and "execute" in text
+
+
+def test_ctas_insert_drop(eng):
+    eng.execute("create table memory.top_nations as "
+                "select n_name, n_regionkey from nation "
+                "where n_regionkey < 2")
+    got = eng.execute("select count(*) from memory.top_nations")
+    assert got == [(10,)]
+    eng.execute("insert into memory.top_nations "
+                "select n_name, n_regionkey from nation "
+                "where n_regionkey = 2")
+    got = eng.execute(
+        "select n_regionkey, count(*) from memory.top_nations "
+        "group by n_regionkey order by n_regionkey")
+    assert got == [(0, 5), (1, 5), (2, 5)]
+    # join memory-catalog table against tpch catalog
+    got = eng.execute(
+        "select count(*) from memory.top_nations t, tpch.nation n "
+        "where t.n_name = n.n_name")
+    assert got == [(15,)]
+    eng.execute("drop table memory.top_nations")
+    assert ("top_nations",) not in eng.execute(
+        "show tables from memory")
+
+
+def test_ctas_decimal_roundtrip(eng):
+    eng.execute("create table memory.big_orders as "
+                "select o_orderkey, o_totalprice from orders "
+                "where o_totalprice > 300000")
+    a = eng.execute("select sum(o_totalprice) from memory.big_orders")
+    b = eng.execute("select sum(o_totalprice) from orders "
+                    "where o_totalprice > 300000")
+    assert a == b
+
+
+def test_ctas_preserves_nulls(eng):
+    eng.execute("create table memory.nullable as "
+                "select n_name, case when n_nationkey > 10 "
+                "then n_nationkey end as k from nation")
+    got = eng.execute("select count(*), count(k) from memory.nullable")
+    assert got == [(25, 14)]
+    got = eng.execute(
+        "select count(*) from memory.nullable where k is null")
+    assert got == [(11,)]

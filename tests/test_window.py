@@ -1,0 +1,85 @@
+"""Window function tests vs the sqlite oracle (sqlite >= 3.25 supports
+SQL window functions) — reference parity target: operator/WindowOperator
++ builtin window functions (rank/lag/lead/aggregates over frames)."""
+
+from presto_tpu.testing.oracle import assert_query
+
+
+def test_rank_dense_rank_row_number(engine, oracle):
+    assert_query(engine, oracle, """
+        select n_name, r_name,
+               rank() over (partition by n_regionkey order by n_name) as rk,
+               dense_rank() over (partition by n_regionkey
+                                  order by n_name) as drk,
+               row_number() over (partition by n_regionkey
+                                  order by n_name) as rn
+        from nation, region where n_regionkey = r_regionkey
+        order by r_name, rk, n_name""")
+
+
+def test_running_sum_and_count(engine, oracle):
+    assert_query(engine, oracle, """
+        select o_custkey, o_orderkey,
+               sum(o_totalprice) over (partition by o_custkey
+                                       order by o_orderkey) as running,
+               count(*) over (partition by o_custkey
+                              order by o_orderkey) as cnt
+        from orders where o_custkey < 50
+        order by o_custkey, o_orderkey""")
+
+
+def test_full_partition_agg(engine, oracle):
+    assert_query(engine, oracle, """
+        select o_orderkey, o_custkey,
+               sum(o_totalprice) over (partition by o_custkey) as tot,
+               max(o_totalprice) over (partition by o_custkey) as mx
+        from orders where o_custkey < 30
+        order by o_orderkey""")
+
+
+def test_lag_lead(engine, oracle):
+    assert_query(engine, oracle, """
+        select o_orderkey,
+               lag(o_orderkey) over (partition by o_custkey
+                                     order by o_orderkey) as prev_o,
+               lead(o_orderkey) over (partition by o_custkey
+                                      order by o_orderkey) as next_o
+        from orders where o_custkey < 40
+        order by o_orderkey""")
+
+
+def test_window_over_aggregation(engine, oracle):
+    assert_query(engine, oracle, """
+        select n_regionkey, count(*) as cnt,
+               rank() over (order by count(*) desc, n_regionkey) as rk
+        from nation group by n_regionkey
+        order by rk""")
+
+
+def test_running_min(engine, oracle):
+    assert_query(engine, oracle, """
+        select o_orderkey,
+               min(o_totalprice) over (partition by o_custkey
+                                       order by o_orderkey) as run_min
+        from orders where o_custkey < 40
+        order by o_orderkey""")
+
+
+def test_rows_frame_vs_range_default(engine, oracle):
+    # ROWS excludes later peers; RANGE (default) includes the peer group
+    assert_query(engine, oracle, """
+        select n_nationkey,
+               sum(n_nationkey) over (order by n_regionkey
+                 rows between unbounded preceding and current row) as r
+        from nation order by n_regionkey, n_nationkey, r""")
+
+
+def test_varchar_window_functions(engine, oracle):
+    assert_query(engine, oracle, """
+        select n_name,
+               first_value(n_name) over (partition by n_regionkey
+                                         order by n_name) as fv,
+               lag(n_name) over (partition by n_regionkey
+                                 order by n_name) as lg,
+               max(n_name) over (partition by n_regionkey) as mx
+        from nation order by n_name""")
