@@ -45,6 +45,14 @@ class Connector:
         generation; analog of spi ConnectorMetadata.getTableStatistics)."""
         return self.stats(name).row_count
 
+    def ndv_estimates(self, name: str) -> dict[str, int]:
+        """Cheap per-column distinct-value estimates used to size hash
+        tables at plan time (must not force data generation; analog of
+        the reference tpch connector's shipped column statistics,
+        plugin/trino-tpch src/main/resources column stats JSON). Missing
+        columns mean unknown."""
+        return {}
+
     def unique_keys(self, name: str) -> list[tuple[str, ...]]:
         """Column sets known unique (primary keys). Lets the planner pick
         the single-match hash-join fast path (reference JoinNode's

@@ -406,6 +406,42 @@ class TpchConnector(Connector):
     def unique_keys(self, name: str) -> list[tuple[str, ...]]:
         return list(self._UNIQUE_KEYS.get(name, []))
 
+    # Scale-free distinct-value counts from the TPC-H spec (the analog of
+    # the reference's shipped tpch column statistics,
+    # plugin/trino-tpch/src/main/resources/tpch/statistics).
+    _NDV_CONST = {
+        "lineitem": {"l_returnflag": 3, "l_linestatus": 2, "l_shipmode": 7,
+                     "l_shipinstruct": 4, "l_linenumber": 7,
+                     "l_quantity": 50, "l_discount": 11, "l_tax": 9},
+        "orders": {"o_orderstatus": 3, "o_orderpriority": 5,
+                   "o_orderdate": 2406},
+        "part": {"p_brand": 25, "p_mfgr": 5, "p_size": 50, "p_type": 150,
+                 "p_container": 40},
+        "customer": {"c_mktsegment": 5, "c_nationkey": 25},
+        "supplier": {"s_nationkey": 25},
+        "nation": {"n_nationkey": 25, "n_name": 25, "n_regionkey": 5},
+        "region": {"r_regionkey": 5, "r_name": 5},
+    }
+    # Key columns whose NDV scales with the referenced table's cardinality.
+    _NDV_KEY = {
+        "lineitem": {"l_orderkey": "orders", "l_partkey": "part",
+                     "l_suppkey": "supplier"},
+        "orders": {"o_orderkey": "orders", "o_custkey": "customer"},
+        "partsupp": {"ps_partkey": "part", "ps_suppkey": "supplier"},
+        "part": {"p_partkey": "part"},
+        "supplier": {"s_suppkey": "supplier"},
+        "customer": {"c_custkey": "customer"},
+        "nation": {},
+        "region": {},
+    }
+
+    def ndv_estimates(self, name: str) -> dict[str, int]:
+        out = dict(self._NDV_CONST.get(name, {}))
+        rows = self.row_count_estimate(name)
+        for col, ref in self._NDV_KEY.get(name, {}).items():
+            out[col] = min(self.row_count_estimate(ref), rows)
+        return {c: min(n, rows) for c, n in out.items()}
+
     def stats(self, name: str) -> TableStats:
         raw = self._raw(name)
         nrows = len(next(iter(raw.values())))

@@ -126,7 +126,9 @@ class PlanInterpreter:
         if not node.group_keys:
             cap = 1
         else:
-            cap = self._capacity(node, next_pow2(2 * src.n))
+            # bounded default: overflow-retry grows it if the real group
+            # count exceeds the guess (reference rehash analog)
+            cap = self._capacity(node, next_pow2(min(2 * src.n, 1 << 22)))
         out, ok = OP.apply_aggregate(src, node, cap)
         if node.group_keys:
             self._note_ok(node, ok)
@@ -183,7 +185,7 @@ class PlanInterpreter:
 
     def _r_distinct(self, node: N.Distinct) -> DTable:
         src = self.run(node.source)
-        cap = self._capacity(node, next_pow2(2 * src.n))
+        cap = self._capacity(node, next_pow2(min(2 * src.n, 1 << 22)))
         out, ok = OP.apply_distinct(src, cap)
         self._note_ok(node, ok)
         return out

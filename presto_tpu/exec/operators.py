@@ -79,12 +79,49 @@ def _row_hash(dt: DTable, keys: list[str]):
     return H.combine_hashes(hs)
 
 
+# Max code-product capacity for the direct dictionary-code group-by path.
+_DIRECT_GROUP_MAX = 1 << 16
+
+
+def _direct_group_ids(dt: DTable, keys: list[str]):
+    """Low-cardinality fast path: when every group key is a non-null
+    dictionary-encoded column with a small code product, the group id is
+    the mixed-radix code product — no hash table, no probe loop, no
+    overflow retry (the analog of MultiChannelGroupByHash's dictionary /
+    low-cardinality fast paths, MultiChannelGroupByHash.java:55).
+
+    Returns (gid int32 [n], capacity, sizes) or None if inapplicable."""
+    sizes = []
+    for k in keys:
+        v = dt.cols[k]
+        if not v.is_string or v.valid is not None or v.dictionary is None:
+            return None
+        sizes.append(max(len(v.dictionary), 1))
+    capacity = 1
+    for s in sizes:
+        capacity *= s
+        if capacity > _DIRECT_GROUP_MAX:
+            return None
+    gid = jnp.zeros((dt.n,), dtype=jnp.int32)
+    for k, size in zip(keys, sizes):
+        code = jnp.clip(dt.cols[k].data.astype(jnp.int32), 0, size - 1)
+        gid = gid * size + code
+    return gid, capacity, sizes
+
+
 def apply_aggregate(dt: DTable, node: N.Aggregate, capacity: int) -> tuple:
     """Returns (DTable of [capacity] rows, ok flag)."""
     live = dt.live_mask()
     c = _compiler(dt)
+    direct = _direct_group_ids(dt, node.group_keys) \
+        if node.group_keys else None
 
-    if node.group_keys:
+    if direct is not None:
+        slots, capacity, sizes = direct
+        occupancy = jax.ops.segment_sum(
+            live.astype(jnp.int32), slots, num_segments=capacity) > 0
+        ok = jnp.asarray(True)
+    elif node.group_keys:
         rh = _row_hash(dt, node.group_keys)
         slots, table, ok = H.group_by_slots(rh, live, capacity)
         occupancy = table != jnp.uint64(0xFFFFFFFFFFFFFFFF)
@@ -97,20 +134,24 @@ def apply_aggregate(dt: DTable, node: N.Aggregate, capacity: int) -> tuple:
     safe_slots = slots  # masked rows fold with weight 0, slot harmless
     out: dict[str, Val] = {}
 
-    for k in node.group_keys:
-        v = dt.cols[k]
-        # scatter key values: all contributors share the slot & value, so a
-        # plain set-scatter is deterministic
-        data = jnp.zeros((capacity,), dtype=v.data.dtype)
-        data = data.at[jnp.where(live, safe_slots, capacity)].set(
-            v.data, mode="drop")
-        if v.valid is not None:
-            valid = jnp.zeros((capacity,), dtype=bool)
-            valid = valid.at[jnp.where(live, safe_slots, capacity)].set(
-                v.valid, mode="drop")
-        else:
-            valid = None
-        out[k] = Val(v.dtype, data, valid, v.dictionary)
+    if direct is not None:
+        out.update(_decode_direct_keys(dt, node.group_keys, sizes,
+                                       capacity))
+    else:
+        for k in node.group_keys:
+            v = dt.cols[k]
+            # scatter key values: all contributors share the slot & value,
+            # so a plain set-scatter is deterministic
+            data = jnp.zeros((capacity,), dtype=v.data.dtype)
+            data = data.at[jnp.where(live, safe_slots, capacity)].set(
+                v.data, mode="drop")
+            if v.valid is not None:
+                valid = jnp.zeros((capacity,), dtype=bool)
+                valid = valid.at[jnp.where(live, safe_slots, capacity)].set(
+                    v.valid, mode="drop")
+            else:
+                valid = None
+            out[k] = Val(v.dtype, data, valid, v.dictionary)
 
     is_final = node.step == N.AggStep.FINAL
     for sym, call in node.aggs.items():
@@ -153,6 +194,23 @@ def apply_aggregate(dt: DTable, node: N.Aggregate, capacity: int) -> tuple:
     return DTable(out, occupancy, capacity), ok
 
 
+def _decode_direct_keys(dt: DTable, keys: list[str], sizes: list[int],
+                        capacity: int) -> dict[str, Val]:
+    """Key columns of the direct group-by path, decoded arithmetically
+    from the slot index (inverse of the mixed-radix code product)."""
+    gid_range = jnp.arange(capacity, dtype=jnp.int32)
+    rev: list = []
+    for k, size in zip(reversed(keys), reversed(sizes)):
+        rev.append((k, gid_range % size))
+        gid_range = gid_range // size
+    out: dict[str, Val] = {}
+    for k, codes in reversed(rev):
+        v = dt.cols[k]
+        out[k] = Val(v.dtype, codes.astype(v.data.dtype), None,
+                     v.dictionary)
+    return out
+
+
 def _arg_dictionary(c: ExprCompiler, arg: ir.Expr):
     """min/max over a string column keep its dictionary."""
     if isinstance(arg, ir.ColumnRef):
@@ -189,9 +247,17 @@ def apply_join(left: DTable, right: DTable, node: N.Join,
 
     gather = jnp.clip(build_row, 0, right.n - 1)
     out = dict(left.cols)
+    inner = node.join_type == N.JoinType.INNER
     for sym, v in right.cols.items():
         data = v.data[gather]
-        valid = found if v.valid is None else (found & v.valid[gather])
+        if inner:
+            # unmatched rows die via the live mask below, so the found
+            # mask is redundant as per-column validity — omitting it
+            # keeps build-side dictionary keys eligible for the direct
+            # group-by fast path downstream
+            valid = None if v.valid is None else v.valid[gather]
+        else:
+            valid = found if v.valid is None else (found & v.valid[gather])
         out[sym] = Val(v.dtype, data, valid, v.dictionary)
 
     if node.filter is not None:
@@ -254,7 +320,13 @@ def apply_expand_join(left: DTable, right: DTable, node: N.Join,
     gather = jnp.clip(build_row, 0, right.n - 1)
     for sym, v in right.cols.items():
         data = v.data[gather]
-        valid = matched if v.valid is None else (matched & v.valid[gather])
+        if left_join:
+            valid = matched if v.valid is None \
+                else (matched & v.valid[gather])
+        else:
+            # inner expansion emits matched rows only: matched is
+            # redundant with out_live (see apply_join)
+            valid = None if v.valid is None else v.valid[gather]
         out[sym] = Val(v.dtype, data, valid, v.dictionary)
 
     if node.filter is not None:
@@ -595,6 +667,13 @@ def _segmented_scan(vals, restart, op):
 
 def apply_distinct(dt: DTable, capacity: int) -> tuple:
     live = dt.live_mask()
+    direct = _direct_group_ids(dt, list(dt.cols))
+    if direct is not None:
+        slots, capacity, sizes = direct
+        occupancy = jax.ops.segment_sum(
+            live.astype(jnp.int32), slots, num_segments=capacity) > 0
+        out = _decode_direct_keys(dt, list(dt.cols), sizes, capacity)
+        return DTable(out, occupancy, capacity), jnp.asarray(True)
     rh = _row_hash(dt, list(dt.cols))
     slots, table, ok = H.group_by_slots(rh, live, capacity)
     occupancy = table != jnp.uint64(0xFFFFFFFFFFFFFFFF)

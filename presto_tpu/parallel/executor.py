@@ -146,7 +146,8 @@ class ShardedInterpreter:
         src = self.run(node.source)
         if src.dist == REPLICATED:
             cap = (1 if not node.group_keys else
-                   self._capacity(node, next_pow2(2 * src.dt.n)))
+                   self._capacity(node,
+                                  next_pow2(min(2 * src.dt.n, 1 << 22))))
             out, ok = OP.apply_aggregate(src.dt, node, cap)
             if node.group_keys:
                 self._note_ok(node, ok)
@@ -154,7 +155,7 @@ class ShardedInterpreter:
         # partial -> gather states -> final merge (PushPartialAggregation
         # ThroughExchange; psum-tree analog)
         cap = (1 if not node.group_keys else
-               self._capacity(node, next_pow2(2 * src.dt.n)))
+               self._capacity(node, next_pow2(min(2 * src.dt.n, 1 << 22))))
         partial_node = dataclasses.replace(node, step=N.AggStep.PARTIAL)
         final_node = dataclasses.replace(node, step=N.AggStep.FINAL)
         if node.step == N.AggStep.SINGLE:
@@ -212,7 +213,7 @@ class ShardedInterpreter:
 
     def _r_distinct(self, node: N.Distinct) -> DistTable:
         src = self.run(node.source)
-        cap = self._capacity(node, next_pow2(2 * src.dt.n))
+        cap = self._capacity(node, next_pow2(min(2 * src.dt.n, 1 << 22)))
         if src.dist == SHARDED:
             # local pre-distinct shrinks the exchange, then final distinct
             local, ok1 = OP.apply_distinct(src.dt, cap)

@@ -608,6 +608,10 @@ class LogicalPlanner:
         self.engine = engine
         self.analysis = analysis
         self.symbols = SymbolAllocator()
+        # symbol -> distinct-value estimate from connector stats; symbols
+        # are globally unique per planner, so one map serves the whole
+        # plan (analog of the reference's SymbolStatsEstimate in cost/)
+        self.ndv: dict[str, int] = {}
 
     # -- entry --------------------------------------------------------------
 
@@ -797,6 +801,9 @@ class LogicalPlanner:
         unique = [frozenset(colsyms[c] for c in key)
                   for key in conn.unique_keys(table)]
         est = conn.row_count_estimate(table)
+        for col, nd in conn.ndv_estimates(table).items():
+            if col in colsyms:
+                self.ndv[colsyms[col]] = nd
         return RelationPlan(node, Scope(fields), est, unique)
 
     def plan_values(self, rel: A.ValuesRelation) -> RelationPlan:
@@ -1464,9 +1471,20 @@ class LogicalPlanner:
         qs.unique = []
 
     def _group_capacity(self, est_rows: int, group_syms: list[str]) -> int:
+        """Hash-table capacity for a group-by: 2x the NDV-product estimate
+        when connector stats cover every key (reference
+        MultiChannelGroupByHash.java:74 expectedGroups), else a bounded
+        row-driven default — either way the executor doubles + recompiles
+        on kernel-reported overflow, so undersizing is safe."""
         if not group_syms:
             return 1
-        return _next_pow2(2 * max(1024, min(est_rows, 1 << 21)))
+        prod = 1
+        for s in group_syms:
+            nd = self.ndv.get(s)
+            if nd is None:
+                return _next_pow2(2 * max(1024, min(est_rows, 1 << 21)))
+            prod = min(prod * max(nd, 1), 1 << 40)
+        return _next_pow2(max(2 * min(prod, est_rows, 1 << 21), 16))
 
     def _plan_windows(self, qs: QState,
                       calls: list[A.FunctionCall], ctx: ExprCtx,
