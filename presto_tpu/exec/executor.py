@@ -67,9 +67,11 @@ class PlanInterpreter:
     """Walks the plan during trace, building the XLA computation."""
 
     def __init__(self, scans: dict[int, tuple[ScanInput, dict]],
-                 capacities: dict[tuple, int]):
+                 capacities: dict[tuple, int], session=None):
+        from presto_tpu.session import Session
         self.scans = scans  # id(node) -> (ScanInput, traced arrays)
         self.capacities = capacities  # (id(node), kind) -> forced capacity
+        self.session = session or Session()
         self.ok_flags: list = []
         self.ok_keys: list[tuple] = []
         self.used_capacity: dict[tuple, int] = {}
@@ -78,12 +80,20 @@ class PlanInterpreter:
         m = getattr(self, "_r_" + type(node).__name__.lower())
         return m(node)
 
-    def _capacity(self, node, default: int, kind: str = "table") -> int:
+    def _capacity(self, node, default: int, kind: str = "table",
+                  override: int | None = None) -> int:
+        """Host retry override > session override > planner hint >
+        default."""
         cap = self.capacities.get((id(node), kind))
         if cap is None:
-            hint = (getattr(node, "capacity", None) if kind == "table"
-                    else getattr(node, "output_capacity", None))
-            cap = hint or default
+            if override:
+                cap = next_pow2(override)
+            elif kind == "table":
+                cap = getattr(node, "capacity", None) or default
+            elif kind == "out":
+                cap = getattr(node, "output_capacity", None) or default
+            else:
+                cap = default
         self.used_capacity[(id(node), kind)] = cap
         return cap
 
@@ -128,7 +138,9 @@ class PlanInterpreter:
         else:
             # bounded default: overflow-retry grows it if the real group
             # count exceeds the guess (reference rehash analog)
-            cap = self._capacity(node, next_pow2(min(2 * src.n, 1 << 22)))
+            cap = self._capacity(
+                node, next_pow2(min(2 * src.n, 1 << 22)),
+                override=int(self.session.get("groupby_table_size") or 0))
         out, ok = OP.apply_aggregate(src, node, cap)
         if node.group_keys:
             self._note_ok(node, ok)
@@ -201,7 +213,7 @@ class PlanInterpreter:
 
 
 def make_traced(scan_inputs: list[ScanInput], plan: N.PlanNode,
-                capacities: dict[int, int]):
+                capacities: dict[int, int], session=None):
     """Build (traced_fn, flat_example_args, meta). ``traced_fn`` is a pure
     jittable function from flat scan arrays to
     (result columns, live mask, ok flags); ``meta`` is populated at trace
@@ -216,7 +228,7 @@ def make_traced(scan_inputs: list[ScanInput], plan: N.PlanNode,
         for scan in scan_inputs:
             traced = {sym: next(it) for sym in scan.arrays}
             scans[id(scan.node)] = (scan, traced)
-        interp = PlanInterpreter(scans, capacities)
+        interp = PlanInterpreter(scans, capacities, session)
         out = interp.run(plan)
         meta["out"] = [
             (sym, v.dtype, v.dictionary, v.valid is not None)
@@ -240,7 +252,7 @@ def execute_plan(engine, plan: N.PlanNode) -> Table:
 
     for _attempt in range(10):
         traced_fn, flat_arrays, meta = make_traced(
-            scan_inputs, plan, capacities)
+            scan_inputs, plan, capacities, engine.session)
         compiled = jax.jit(traced_fn)
         res, live, oks = compiled(*flat_arrays)
         if all(bool(o) for o in oks):

@@ -41,7 +41,9 @@ from presto_tpu.exec.operators import DTable
 from presto_tpu.expr.compile import Val
 from presto_tpu.ops import hash as H
 from presto_tpu.ops.hash import next_pow2
+from presto_tpu.parallel import exchange as EX
 from presto_tpu.plan import nodes as N
+from presto_tpu.session import Session
 
 AXIS = "d"
 
@@ -75,22 +77,35 @@ class ShardedInterpreter:
     Mirrors exec/executor.PlanInterpreter, with a distribution tag per
     intermediate and collectives at distribution boundaries."""
 
-    def __init__(self, scans, capacities, nshards: int):
+    def __init__(self, scans, capacities, nshards: int,
+                 session: Session | None = None):
         self.scans = scans
         self.capacities = capacities
         self.nshards = nshards
+        self.session = session or Session()
         self.ok_flags: list = []
         self.ok_keys: list[tuple] = []
         self.used_capacity: dict[tuple, int] = {}
 
     # -- plumbing shared with the local interpreter -------------------------
 
-    def _capacity(self, node, default: int, kind: str = "table") -> int:
+    def _capacity(self, node, default: int, kind: str = "table",
+                  override: int | None = None) -> int:
+        """Static capacity for a hash table / exchange bucket: host retry
+        override > session override > planner hint > default. Planner
+        hints are global-table-sized, so only the whole-table kinds read
+        them — per-shard structures (exchange buckets, partitioned
+        tables) must use their own per-shard defaults."""
         cap = self.capacities.get((id(node), kind))
         if cap is None:
-            hint = (getattr(node, "capacity", None) if kind == "table"
-                    else getattr(node, "output_capacity", None))
-            cap = hint or default
+            if override:
+                cap = next_pow2(override)
+            elif kind == "table":
+                cap = getattr(node, "capacity", None) or default
+            elif kind == "out":
+                cap = getattr(node, "output_capacity", None) or default
+            else:
+                cap = default
         self.used_capacity[(id(node), kind)] = cap
         return cap
 
@@ -109,6 +124,53 @@ class ShardedInterpreter:
         if out.dist == REPLICATED:
             return out.dt
         return _gather(out.dt, self.nshards)
+
+    def _repart(self, dt: DTable, keys: list[str], node, kind: str
+                ) -> DTable:
+        """FIXED_HASH exchange: hash-repartition ``dt``'s live rows over
+        the mesh axis so rows with equal key tuples land on the same
+        shard (reference PartitionedOutputOperator.partitionPage +
+        ExchangeOperator; here bucket + lax.all_to_all over ICI).
+        Per-destination bucket capacity grows via the host retry loop on
+        kernel-reported overflow."""
+        # partition on HIGH hash bits: the hash kernels' home slot uses
+        # the low bits (hash % capacity), so low-bit partitioning would
+        # leave only every nshards-th home slot reachable per shard
+        part_id = ((OP._row_hash(dt, keys) >> jnp.uint64(32))
+                   % jnp.uint64(self.nshards)).astype(jnp.int32)
+        live = dt.live_mask()
+        arrays = {}
+        for sym, v in dt.cols.items():
+            arrays[sym] = v.data
+            if v.valid is not None:
+                arrays[f"{sym}$valid"] = v.valid
+        cap = self._capacity(
+            node, next_pow2(2 * max(dt.n // self.nshards, 16)), kind)
+        ex, valid, ok = EX.repartition(
+            arrays, live, part_id, self.nshards, cap, AXIS)
+        self._note_ok(node, ok, kind)
+        cols = {sym: Val(v.dtype, ex[sym], ex.get(f"{sym}$valid"),
+                         v.dictionary)
+                for sym, v in dt.cols.items()}
+        return DTable(cols, valid, self.nshards * cap)
+
+    def _join_partitioned(self, node: N.Join) -> bool:
+        """Broadcast-vs-partitioned distribution choice, analog of the
+        reference's DetermineJoinDistributionType (AUTOMATIC mode uses
+        the planner's build-side estimate against the session
+        threshold)."""
+        if node.distribution == "broadcast":
+            return False
+        if node.distribution == "partitioned":
+            return True
+        mode = str(self.session.get("join_distribution_type")).upper()
+        if mode == "BROADCAST":
+            return False
+        if mode == "PARTITIONED":
+            return True
+        threshold = self.session.get("broadcast_join_threshold_rows")
+        return (node.build_rows is not None
+                and node.build_rows > threshold)
 
     # -- leaves -------------------------------------------------------------
 
@@ -143,19 +205,20 @@ class ShardedInterpreter:
     # -- aggregation: partial local, merge replicated -----------------------
 
     def _r_aggregate(self, node: N.Aggregate) -> DistTable:
+        ov = int(self.session.get("groupby_table_size") or 0)
         src = self.run(node.source)
         if src.dist == REPLICATED:
             cap = (1 if not node.group_keys else
                    self._capacity(node,
-                                  next_pow2(min(2 * src.dt.n, 1 << 22))))
+                                  next_pow2(min(2 * src.dt.n, 1 << 22)),
+                                  override=ov))
             out, ok = OP.apply_aggregate(src.dt, node, cap)
             if node.group_keys:
                 self._note_ok(node, ok)
             return DistTable(out, REPLICATED)
-        # partial -> gather states -> final merge (PushPartialAggregation
-        # ThroughExchange; psum-tree analog)
         cap = (1 if not node.group_keys else
-               self._capacity(node, next_pow2(min(2 * src.dt.n, 1 << 22))))
+               self._capacity(node, next_pow2(min(2 * src.dt.n, 1 << 22)),
+                              override=ov))
         partial_node = dataclasses.replace(node, step=N.AggStep.PARTIAL)
         final_node = dataclasses.replace(node, step=N.AggStep.FINAL)
         if node.step == N.AggStep.SINGLE:
@@ -163,35 +226,84 @@ class ShardedInterpreter:
         elif node.step == N.AggStep.PARTIAL:
             partial_node = node
             final_node = None
+        if not self.session.get("partial_aggregation") \
+                and final_node is not None:
+            # property off: ship raw rows and aggregate replicated (the
+            # reference's push_partial_aggregation_through_join=false
+            # analog; mainly a debugging/testing escape hatch)
+            gathered = _gather(src.dt, self.nshards)
+            out, ok = OP.apply_aggregate(gathered, node, cap)
+            if node.group_keys:
+                self._note_ok(node, ok)
+            return DistTable(out, REPLICATED)
+        # partial -> exchange states -> final merge (PushPartialAggregation
+        # ThroughExchange; psum-tree analog)
         partial, ok1 = OP.apply_aggregate(src.dt, partial_node, cap)
         if node.group_keys:
             self._note_ok(node, ok1)
-        gathered = _gather(partial, self.nshards)
         if final_node is None:
-            return DistTable(gathered, REPLICATED)
+            return DistTable(_gather(partial, self.nshards), REPLICATED)
+        est_groups = node.capacity or cap
+        if node.group_keys and est_groups >= int(
+                self.session.get("partitioned_agg_min_groups")):
+            # high cardinality: FIXED_HASH repartition of partial states
+            # by group-key hash, final merge local to each shard —
+            # per-device state is O(groups/nshards)
+            # (AddExchanges.java:215-245)
+            ex = self._repart(partial, node.group_keys, node, "agg_exch")
+            fcap = self._capacity(
+                node, next_pow2(2 * max(est_groups // self.nshards, 16)),
+                "final", override=ov)
+            out, ok2 = OP.apply_aggregate(ex, final_node, fcap)
+            self._note_ok(node, ok2, "final")
+            return DistTable(out, SHARDED)
+        gathered = _gather(partial, self.nshards)
         fcap = (1 if not node.group_keys else
-                self._capacity(node, next_pow2(2 * cap), "final"))
+                self._capacity(node, next_pow2(2 * cap), "final",
+                               override=ov))
         out, ok2 = OP.apply_aggregate(gathered, final_node, fcap)
         if node.group_keys:
             self._note_ok(node, ok2, "final")
         return DistTable(out, REPLICATED)
 
-    # -- joins: broadcast build side ----------------------------------------
+    # -- joins: broadcast or hash-repartitioned build/probe ------------------
 
     def _r_join(self, node: N.Join) -> DistTable:
         left = self.run(node.left)
-        build = self.replicated(node.right)  # FIXED_BROADCAST
-        cap = self._capacity(node, next_pow2(2 * build.n))
+        right = self.run(node.right)
+        lkeys = [lk for lk, _ in node.criteria]
+        rkeys = [rk for _, rk in node.criteria]
+        if (node.criteria and left.dist == SHARDED
+                and right.dist == SHARDED and self._join_partitioned(node)):
+            # FIXED_HASH: repartition both sides by join-key hash so each
+            # shard joins only its key range — per-device build memory is
+            # O(build/nshards) instead of O(build)
+            # (AddExchanges.java:245 partitionedExchange)
+            probe = self._repart(left.dt, lkeys, node, "probe_exch")
+            build = self._repart(right.dt, rkeys, node, "build_exch")
+            # per-shard table: must NOT pick up the planner's global-sized
+            # capacity hint (kind "ptable" skips it)
+            tab_kind, out_kind = "ptable", "pout"
+            cap = self._capacity(node, next_pow2(
+                2 * max((node.build_rows or build.n) // self.nshards, 16)),
+                tab_kind)
+        else:
+            # FIXED_BROADCAST: replicate the build side
+            probe = left.dt
+            build = (right.dt if right.dist == REPLICATED
+                     else _gather(right.dt, self.nshards))
+            tab_kind, out_kind = "table", "out"
+            cap = self._capacity(node, next_pow2(2 * build.n))
         if node.build_unique:
-            out, ok = OP.apply_join(left.dt, build, node, cap)
-            self._note_ok(node, ok)
+            out, ok = OP.apply_join(probe, build, node, cap)
+            self._note_ok(node, ok, tab_kind)
             return DistTable(out, left.dist)
         out_cap = self._capacity(
-            node, next_pow2(2 * (left.dt.n + build.n)), "out")
-        out, t_ok, o_ok = OP.apply_expand_join(left.dt, build, node, cap,
+            node, next_pow2(2 * (probe.n + build.n)), out_kind)
+        out, t_ok, o_ok = OP.apply_expand_join(probe, build, node, cap,
                                                out_cap)
-        self._note_ok(node, t_ok)
-        self._note_ok(node, o_ok, "out")
+        self._note_ok(node, t_ok, tab_kind)
+        self._note_ok(node, o_ok, out_kind)
         return DistTable(out, left.dist)
 
     def _r_semijoin(self, node: N.SemiJoin) -> DistTable:
@@ -261,6 +373,12 @@ class ShardedInterpreter:
         src = self.run(node.source)
         if node.kind == N.ExchangeType.GATHER and src.dist == SHARDED:
             return DistTable(_gather(src.dt, self.nshards), REPLICATED)
+        if node.kind == N.ExchangeType.REPLICATE and src.dist == SHARDED:
+            return DistTable(_gather(src.dt, self.nshards), REPLICATED)
+        if node.kind == N.ExchangeType.REPARTITION and src.dist == SHARDED:
+            return DistTable(
+                self._repart(src.dt, node.partition_keys, node, "exch"),
+                SHARDED)
         return src
 
     def _r_output(self, node: N.Output) -> DistTable:
@@ -308,7 +426,8 @@ def execute_plan_distributed(engine, plan: N.PlanNode,
                 per_scan.setdefault(i, {})[sym] = a
             for i, scan in enumerate(scan_inputs):
                 scans[id(scan.node)] = (scan, per_scan[i])
-            interp = ShardedInterpreter(scans, capacities, nshards)
+            interp = ShardedInterpreter(scans, capacities, nshards,
+                                        engine.session)
             out = interp.run(plan).dt
             meta["out"] = [
                 (sym, v.dtype, v.dictionary, v.valid is not None)
@@ -328,7 +447,8 @@ def execute_plan_distributed(engine, plan: N.PlanNode,
             in_specs=tuple(P(AXIS) for _ in flat_arrays),
             out_specs=(P(), P(), P()),
             check_vma=False)
-        compiled = jax.jit(sharded)
+        lowered = jax.jit(sharded).lower(*flat_arrays)
+        compiled = lowered.compile()
         with mesh:
             res, live, oks = compiled(*flat_arrays)
         del n_out
@@ -339,6 +459,12 @@ def execute_plan_distributed(engine, plan: N.PlanNode,
                 capacities[key] = 2 * meta["used_capacity"][key]
     else:
         raise RuntimeError("hash table capacity retry limit exceeded")
+
+    # introspection for tests/EXPLAIN (successful attempt only — as_text
+    # materializes the whole module, so keep it off the retry path):
+    # the distribution strategy is visible as collectives in the program
+    engine.last_dist_hlo = lowered.as_text()
+    engine.last_dist_meta = {"used_capacity": dict(meta["used_capacity"])}
 
     live_np = np.asarray(live)
     cols: dict[str, Column] = {}

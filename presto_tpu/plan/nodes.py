@@ -165,7 +165,11 @@ class Join(PlanNode):
     # planner hint: probe-side rows match at most one build row (FK->PK,
     # criteria cover a unique key of the build side)
     build_unique: bool = True
-    distribution: str = "broadcast"  # broadcast | partitioned
+    distribution: str = "automatic"  # automatic | broadcast | partitioned
+    # planner cardinality estimate of the build side (drives the
+    # broadcast-vs-partitioned choice, reference
+    # DetermineJoinDistributionType)
+    build_rows: int | None = None
     capacity: int | None = None
     # static output-row capacity for the expanding (many-to-many) path
     output_capacity: int | None = None

@@ -913,6 +913,7 @@ class LogicalPlanner:
               else N.JoinType.LEFT)
         node = N.Join(probe.node, build_node, jt, criteria,
                       filt, build_unique,
+                      build_rows=build.est,
                       capacity=_next_pow2(2 * build.est),
                       output_capacity=None if build_unique
                       else _next_pow2(2 * (probe.est + build.est)))
@@ -1249,6 +1250,7 @@ class LogicalPlanner:
             build_unique = any(k <= build_syms for k in build.unique)
             node = N.Join(node, build.node, N.JoinType.INNER, criteria,
                           None, build_unique,
+                          build_rows=build.est,
                           capacity=_next_pow2(2 * build.est),
                           output_capacity=None if build_unique else
                           _next_pow2(2 * max(est, build.est)))
@@ -1681,6 +1683,7 @@ class LogicalPlanner:
                                  tuple(sub_qs.residual_corr)))
         expand = N.Join(qs.node, sub_qs.node, N.JoinType.INNER, criteria,
                         residual, build_unique=False,
+                        build_rows=sub_qs.est,
                         capacity=_next_pow2(2 * min(sub_qs.est, 1 << 22)))
         types = qs.node.output_types()
         keys_proj = N.Project(expand, {
@@ -1731,7 +1734,7 @@ class LogicalPlanner:
         # output projection; join on them
         criteria = [(o, i) for (o, i, _t) in corr]
         qs.node = N.Join(qs.node, rp.node, N.JoinType.LEFT, criteria,
-                         None, True,
+                         None, True, build_rows=rp.est,
                          capacity=_next_pow2(2 * min(rp.est, 1 << 22)))
         qs.scope = Scope(qs.scope.fields
                          + [Field(None, None, value_f.symbol,

@@ -12,25 +12,25 @@ import dataclasses
 from typing import Any
 
 
-# name -> (default, type, description)
+# name -> (default, type, description). Every property is read by the
+# engine (tests/test_partitioned.py flips each and asserts the
+# plan/HLO/result changes); analog of SystemSessionProperties.java:55-129.
 SYSTEM_SESSION_PROPERTIES: dict[str, tuple[Any, type, str]] = {
-    "block_rows": (1 << 20, int,
-                   "physical row-block granularity tables are padded to"),
     "groupby_table_size": (0, int,
                            "hash-table capacity override for group-by "
                            "(0 = derive from stats)"),
-    "join_table_fill": (0.5, float,
-                        "target fill factor for join hash tables"),
     "join_distribution_type": ("AUTOMATIC", str,
-                               "AUTOMATIC | BROADCAST | PARTITIONED"),
-    "broadcast_join_threshold_rows": (4_000_000, int,
-                                      "max build rows for broadcast joins"),
-    "max_hash_probes": (64, int,
-                        "bound on linear-probe steps in hash kernels"),
-    "data_parallel_shards": (1, int,
-                             "number of mesh shards for data-parallel scan"),
-    "enable_dynamic_filtering": (True, bool,
-                                 "build-side min/max filters onto probe scans"),
+                               "AUTOMATIC | BROADCAST | PARTITIONED "
+                               "(distributed joins; reference "
+                               "DetermineJoinDistributionType)"),
+    "broadcast_join_threshold_rows": (1 << 20, int,
+                                      "AUTOMATIC: max build rows for "
+                                      "broadcast joins"),
+    "partitioned_agg_min_groups": (1 << 15, int,
+                                   "min estimated groups before a "
+                                   "distributed aggregate hash-repartitions "
+                                   "its partial states instead of "
+                                   "gathering them"),
     "partial_aggregation": (True, bool,
                             "partial->final aggregation across shards"),
 }
