@@ -48,6 +48,30 @@ class Column:
         )
 
 
+@dataclasses.dataclass
+class EncodedStrings:
+    """A string column already in (codes, sorted dictionary) form.
+
+    Generators that pick from bounded vocabularies emit this directly so
+    large tables skip the O(n log n) object-array re-encode in
+    dictionary_encode — the analog of the reference producing
+    DictionaryBlocks at the source (spi/block/DictionaryBlock.java:35).
+    ``dictionary`` must be lexicographically sorted (code order ==
+    collation order, the engine-wide invariant)."""
+
+    codes: np.ndarray  # int32 [n]
+    dictionary: np.ndarray  # object [k], sorted
+
+    def __len__(self) -> int:
+        return int(self.codes.shape[0])
+
+    def __getitem__(self, idx) -> "EncodedStrings":
+        return EncodedStrings(self.codes[idx], self.dictionary)
+
+    def decode(self) -> np.ndarray:
+        return self.dictionary[self.codes]
+
+
 def dictionary_encode(values: Iterable[str]) -> tuple[np.ndarray, np.ndarray]:
     """Encode strings to (codes int32, sorted dictionary).
 
@@ -66,6 +90,8 @@ def column_from_numpy(
     """Build a Column from host values. Strings are dictionary-encoded;
     decimals must already be scaled integers."""
     if isinstance(dtype, T.VarcharType):
+        if isinstance(values, EncodedStrings):
+            return Column(dtype, values.codes, valid, values.dictionary)
         codes, dictionary = dictionary_encode(values)
         return Column(dtype, codes, valid, dictionary)
     return Column(dtype, np.asarray(values, dtype=dtype.physical_dtype), valid)

@@ -17,7 +17,7 @@ from __future__ import annotations
 import numpy as np
 
 from presto_tpu import types as T
-from presto_tpu.block import Table
+from presto_tpu.block import EncodedStrings, Table
 from presto_tpu.connectors.base import Connector, TableStats
 
 # --- spec constants ---------------------------------------------------------
@@ -121,14 +121,46 @@ SCHEMAS: dict[str, dict[str, T.DataType]] = {
 }
 
 
-def _comments(rng: np.random.Generator, n: int) -> np.ndarray:
+def _pick(vocab, idx: np.ndarray) -> EncodedStrings:
+    """Select from a small vocabulary, emitting codes into the sorted
+    vocabulary directly (no per-row object strings)."""
+    sorted_dict, inv = np.unique(
+        np.array(vocab, dtype="U64"), return_inverse=True)
+    return EncodedStrings(inv.astype(np.int32)[idx],
+                          sorted_dict.astype(object))
+
+
+_COMMENT_COMBOS: tuple | None = None
+
+
+def _comments(rng: np.random.Generator, n: int) -> EncodedStrings:
     """Short pseudo-comments from a bounded vocabulary (so the string
     dictionary stays small at scale). Patterns like '%special%requests%'
-    (Q13) and '%Customer%Complaints%' (Q16) occur with realistic rarity."""
+    (Q13) and '%Customer%Complaints%' (Q16) occur with realistic rarity.
+    All |words|^3 combos form one shared sorted dictionary; rows carry
+    codes only, so generation is O(n) integer work."""
+    global _COMMENT_COMBOS
     w = np.array(COMMENT_WORDS, dtype=object)
-    i = rng.integers(0, len(w), size=(n, 3))
-    out = w[i[:, 0]] + " " + w[i[:, 1]] + " " + w[i[:, 2]]
-    return out
+    k = len(w)
+    if _COMMENT_COMBOS is None:
+        c0 = np.repeat(w, k * k)
+        c1 = np.tile(np.repeat(w, k), k)
+        c2 = np.tile(w, k * k)
+        combos = c0 + " " + c1 + " " + c2
+        sorted_dict, inv = np.unique(combos.astype("U"),
+                                     return_inverse=True)
+        _COMMENT_COMBOS = (sorted_dict.astype(object),
+                           inv.astype(np.int32))
+    sorted_dict, inv = _COMMENT_COMBOS
+    i = rng.integers(0, k, size=(n, 3))
+    flat = (i[:, 0] * k + i[:, 1]) * k + i[:, 2]
+    codes = inv[flat]
+    if n < (1 << 17):
+        # small tables: compact to the realized values so host-side
+        # dictionary scans (LIKE, unions) don't pay for the full vocab
+        used, remap = np.unique(codes, return_inverse=True)
+        return EncodedStrings(remap.astype(np.int32), sorted_dict[used])
+    return EncodedStrings(codes, sorted_dict)
 
 
 def _phone(nationkey: np.ndarray, rng: np.random.Generator) -> np.ndarray:
@@ -189,7 +221,11 @@ class TpchGenerator:
         nationkey = rng.integers(0, 25, n).astype(np.int64)
         return {
             "s_suppkey": keys,
-            "s_name": np.array([f"Supplier#{k:09d}" for k in keys], object),
+            # zero-padded per-key names ascend with the key: identity
+            # codes over the already-sorted dictionary
+            "s_name": EncodedStrings(
+                np.arange(n, dtype=np.int32),
+                np.array([f"Supplier#{k:09d}" for k in keys], object)),
             "s_address": _comments(rng, n),
             "s_nationkey": nationkey,
             "s_phone": _phone(nationkey, rng),
@@ -208,22 +244,28 @@ class TpchGenerator:
             names = names + " " + colors[name_idx[:, j]]
         mfgr = rng.integers(1, 6, n)
         brand = mfgr * 10 + rng.integers(1, 6, n)
+        type_vocab = [f"{a} {b} {c}" for a in TYPE_S1 for b in TYPE_S2
+                      for c in TYPE_S3]
         t1 = rng.integers(0, len(TYPE_S1), n)
         t2 = rng.integers(0, len(TYPE_S2), n)
         t3 = rng.integers(0, len(TYPE_S3), n)
-        types_arr = np.array(
-            [f"{TYPE_S1[a]} {TYPE_S2[b]} {TYPE_S3[c]}"
-             for a, b, c in zip(t1, t2, t3)], dtype=object)
+        types_arr = _pick(
+            type_vocab,
+            (t1 * len(TYPE_S2) + t2) * len(TYPE_S3) + t3)
+        cont_vocab = [f"{a} {b}" for a in CONTAINER_S1
+                      for b in CONTAINER_S2]
         c1 = rng.integers(0, len(CONTAINER_S1), n)
         c2 = rng.integers(0, len(CONTAINER_S2), n)
-        containers = np.array(
-            [f"{CONTAINER_S1[a]} {CONTAINER_S2[b]}" for a, b in zip(c1, c2)],
-            dtype=object)
+        containers = _pick(cont_vocab, c1 * len(CONTAINER_S2) + c2)
         return {
             "p_partkey": keys,
             "p_name": names,
-            "p_mfgr": np.array([f"Manufacturer#{m}" for m in mfgr], object),
-            "p_brand": np.array([f"Brand#{b}" for b in brand], object),
+            "p_mfgr": _pick([f"Manufacturer#{m}" for m in range(1, 6)],
+                            mfgr - 1),
+            "p_brand": _pick(
+                [f"Brand#{m}{s}" for m in range(1, 6)
+                 for s in range(1, 6)],
+                (mfgr - 1) * 5 + (brand - mfgr * 10 - 1)),
             "p_type": types_arr,
             "p_size": rng.integers(1, 51, n).astype(np.int64),
             "p_container": containers,
@@ -251,12 +293,14 @@ class TpchGenerator:
         seg = rng.integers(0, len(SEGMENTS), n)
         return {
             "c_custkey": keys,
-            "c_name": np.array([f"Customer#{k:09d}" for k in keys], object),
+            "c_name": EncodedStrings(
+                np.arange(n, dtype=np.int32),
+                np.array([f"Customer#{k:09d}" for k in keys], object)),
             "c_address": _comments(rng, n),
             "c_nationkey": nationkey,
             "c_phone": _phone(nationkey, rng),
             "c_acctbal": rng.integers(-99999, 1_000_000, n).astype(np.int64),
-            "c_mktsegment": np.array(SEGMENTS, object)[seg],
+            "c_mktsegment": _pick(SEGMENTS, seg),
             "c_comment": _comments(rng, n),
         }
 
@@ -293,10 +337,15 @@ class TpchGenerator:
         cdate = (l_odate + lrng.integers(30, 91, total_lines)).astype(np.int32)
         rdate = (sdate + lrng.integers(1, 31, total_lines)).astype(np.int32)
         returned = rdate <= CURRENTDATE
-        rflag = np.where(
-            returned, np.where(lrng.random(total_lines) < 0.5, "R", "A"), "N"
-        ).astype(object)
-        lstatus = np.where(sdate > CURRENTDATE, "O", "F").astype(object)
+        # dictionaries sorted: ["A","N","R"], ["F","O"]
+        rflag = EncodedStrings(
+            np.where(returned,
+                     np.where(lrng.random(total_lines) < 0.5, 2, 0),
+                     1).astype(np.int32),
+            np.array(["A", "N", "R"], object))
+        open_line = sdate > CURRENTDATE
+        lstatus = EncodedStrings(open_line.astype(np.int32),
+                                 np.array(["F", "O"], object))
 
         lineitem = {
             "l_orderkey": l_orderkey,
@@ -312,10 +361,11 @@ class TpchGenerator:
             "l_shipdate": sdate,
             "l_commitdate": cdate,
             "l_receiptdate": rdate,
-            "l_shipinstruct": np.array(INSTRUCTIONS, object)[
-                lrng.integers(0, len(INSTRUCTIONS), total_lines)],
-            "l_shipmode": np.array(SHIPMODES, object)[
-                lrng.integers(0, len(SHIPMODES), total_lines)],
+            "l_shipinstruct": _pick(
+                INSTRUCTIONS,
+                lrng.integers(0, len(INSTRUCTIONS), total_lines)),
+            "l_shipmode": _pick(
+                SHIPMODES, lrng.integers(0, len(SHIPMODES), total_lines)),
             "l_comment": _comments(lrng, total_lines),
         }
 
@@ -326,10 +376,12 @@ class TpchGenerator:
         totalprice = np.zeros(n, dtype=np.int64)
         np.add.at(totalprice, l_orderkey - 1, line_total)
         n_open = np.zeros(n, dtype=np.int64)
-        np.add.at(n_open, l_orderkey - 1, (lstatus == "O").astype(np.int64))
-        status = np.where(
-            n_open == counts, "O", np.where(n_open == 0, "F", "P")
-        ).astype(object)
+        np.add.at(n_open, l_orderkey - 1, open_line.astype(np.int64))
+        # dictionary sorted: ["F","O","P"]
+        status = EncodedStrings(
+            np.where(n_open == counts, 1,
+                     np.where(n_open == 0, 0, 2)).astype(np.int32),
+            np.array(["F", "O", "P"], object))
 
         orders = {
             "o_orderkey": okeys,
@@ -337,12 +389,17 @@ class TpchGenerator:
             "o_orderstatus": status,
             "o_totalprice": totalprice,
             "o_orderdate": odate,
-            "o_orderpriority": np.array(PRIORITIES, object)[
-                rng.integers(0, len(PRIORITIES), n)],
-            "o_clerk": np.array(
-                [f"Clerk#{c:09d}" for c in
-                 rng.integers(1, max(int(1000 * self.scale), 10) + 1, n)],
-                object),
+            "o_orderpriority": _pick(
+                PRIORITIES, rng.integers(0, len(PRIORITIES), n)),
+            # zero-padded clerk names sort numerically, so the distinct
+            # clerk list is already the sorted dictionary
+            "o_clerk": EncodedStrings(
+                rng.integers(
+                    0, max(int(1000 * self.scale), 10), n
+                ).astype(np.int32),
+                np.array([f"Clerk#{c:09d}" for c in
+                          range(1, max(int(1000 * self.scale), 10) + 1)],
+                         object)),
             "o_shippriority": np.zeros(n, dtype=np.int64),
             "o_comment": _comments(rng, n),
         }
@@ -450,7 +507,10 @@ class TpchConnector(Connector):
             if isinstance(dtype, T.VarcharType):
                 # cheap estimate: sample
                 sample = raw[col][: min(nrows, 10000)]
-                ndv[col] = int(len(np.unique(sample.astype("U"))))
+                if isinstance(sample, EncodedStrings):
+                    ndv[col] = int(len(np.unique(sample.codes)))
+                else:
+                    ndv[col] = int(len(np.unique(sample.astype("U"))))
             else:
                 lo = raw[col].min() if nrows else 0
                 hi = raw[col].max() if nrows else 0

@@ -108,7 +108,9 @@ class PlanInterpreter:
             cols[sym] = Val(scan.types[sym], traced[sym],
                             traced.get(f"{sym}$valid"),
                             scan.dictionaries[sym])
-        return DTable(cols, None, scan.nrows)
+        # block-streamed scans pad the last block; the pad rows are dead
+        nrows = next(iter(traced.values())).shape[0] if traced else scan.nrows
+        return DTable(cols, traced.get("__live__"), nrows)
 
     def _r_values(self, node: N.Values) -> DTable:
         cols = {}
@@ -246,8 +248,21 @@ def make_traced(scan_inputs: list[ScanInput], plan: N.PlanNode,
 
 
 def execute_plan(engine, plan: N.PlanNode) -> Table:
-    """Compile + run a logical plan on the local device."""
+    """Compile + run a logical plan on the local device. Plans whose
+    dominant scan exceeds the session block size stream block-wise (the
+    split analog) when the plan shape allows it."""
+    from presto_tpu.exec.streaming import try_execute_streamed
+    streamed = try_execute_streamed(engine, plan)
+    if streamed is not None:
+        return streamed
     scan_inputs = collect_scans(plan, engine)
+    return run_plan(engine, plan, scan_inputs)
+
+
+def run_plan(engine, plan: N.PlanNode,
+             scan_inputs: list[ScanInput]) -> Table:
+    """Compile + run over prepared scan inputs (shared by the whole-table
+    and block-streamed paths)."""
     capacities: dict[tuple, int] = {}
 
     for _attempt in range(10):

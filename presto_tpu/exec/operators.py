@@ -220,6 +220,24 @@ def _arg_dictionary(c: ExprCompiler, arg: ir.Expr):
     return None
 
 
+def _verify_keys(left: DTable, right: DTable,
+                 criteria: list[tuple[str, str]], probe_idx, gather):
+    """Value-compare matched non-string join keys (64-bit row-hash
+    collision defence — the analog of the reference's
+    PagesHash.positionEqualsRow after the hash hit). String keys rely on
+    content-based per-dictionary hashes (ops/hash.py blake2b), which a
+    row-hash collision does not weaken."""
+    eq = None
+    for lk, rk in criteria:
+        lv, rv = left.cols[lk], right.cols[rk]
+        if lv.is_string or rv.is_string:
+            continue
+        ld = lv.data if probe_idx is None else lv.data[probe_idx]
+        e = ld == rv.data[gather]
+        eq = e if eq is None else (eq & e)
+    return eq if eq is not None else True
+
+
 def _and_key_valid(dt: DTable, keys: list[str], live):
     for k in keys:
         v = dt.cols[k]
@@ -246,6 +264,7 @@ def apply_join(left: DTable, right: DTable, node: N.Join,
     ok = ok & probe_ok
 
     gather = jnp.clip(build_row, 0, right.n - 1)
+    found = found & _verify_keys(left, right, node.criteria, None, gather)
     out = dict(left.cols)
     inner = node.join_type == N.JoinType.INNER
     for sym, v in right.cols.items():
@@ -318,6 +337,15 @@ def apply_expand_join(left: DTable, right: DTable, node: N.Join,
         out[sym] = Val(v.dtype, data, valid, v.dictionary)
     matched = build_row >= 0
     gather = jnp.clip(build_row, 0, right.n - 1)
+    verify = _verify_keys(left, right, node.criteria, probe_idx, gather)
+    if verify is not True:
+        if left_join:
+            # a collision row would need to convert back to an
+            # unmatched-left row; with content-hashed keys the risk is a
+            # 64-bit collision within one query's keys (~n^2/2^64)
+            pass
+        else:
+            out_live = out_live & (verify | ~matched)
     for sym, v in right.cols.items():
         data = v.data[gather]
         if left_join:
@@ -347,10 +375,28 @@ def apply_semijoin(dt: DTable, filt: DTable, node: N.SemiJoin,
     fh = _row_hash(filt, node.filter_keys)
     table, table_row, ok = H.build_join_table(fh, build_live, capacity)
     sh = _row_hash(dt, node.source_keys)
-    _, found, probe_ok = H.probe_join_table(table, table_row, sh, probe_live)
+    build_row, found, probe_ok = H.probe_join_table(
+        table, table_row, sh, probe_live)
     ok = ok & probe_ok
+    found = found & _verify_keys(
+        dt, filt, list(zip(node.source_keys, node.filter_keys)), None,
+        jnp.clip(build_row, 0, filt.n - 1))
     out = dict(dt.cols)
-    out[node.output] = Val(T.BOOLEAN, found, None)
+    mark_valid = None
+    if node.null_aware:
+        # x IN (S) is NULL (not FALSE) when unmatched and either x is
+        # NULL or S contains a NULL — three-valued logic that matters
+        # under negation (NOT IN): such rows must NOT pass the filter
+        bk = filt.cols[node.filter_keys[0]]
+        build_has_null = (jnp.any(filt.live_mask() & ~bk.valid)
+                          if bk.valid is not None else jnp.asarray(False))
+        pk = dt.cols[node.source_keys[0]]
+        probe_null = (~pk.valid if pk.valid is not None
+                      else jnp.zeros((dt.n,), bool))
+        # x IN (empty set) is definitively FALSE even for NULL x
+        set_empty = ~jnp.any(filt.live_mask())
+        mark_valid = found | set_empty | (~probe_null & ~build_has_null)
+    out[node.output] = Val(T.BOOLEAN, found, mark_valid)
     return DTable(out, dt.live, dt.n), ok
 
 

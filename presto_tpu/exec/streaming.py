@@ -1,0 +1,162 @@
+"""Block-streamed scan execution: the split analog.
+
+The reference streams tables through workers as connector splits
+(split/SplitManager.java, plugin/trino-tpch/.../TpchSplitManager.java:55)
+so no operator ever holds a whole table. The TPU analog: when a plan is a
+single big scan feeding (through filters/projections) one aggregation,
+execute the scan in fixed-size row blocks through ONE compiled
+partial-aggregate kernel, accumulate the per-block partial states
+(bounded by the group-count capacity, not the table size), then run the
+rest of the plan over the merged partials. HBM holds one block at a
+time, so tables larger than device memory stream through.
+
+Shape requirements (else the whole-table path runs): exactly one
+TableScan; only Filter/Project between it and a single-step Aggregate;
+anything above the Aggregate (sort/limit/output operate on the small
+aggregated result).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import numpy as np
+
+from presto_tpu import types as T
+from presto_tpu.plan import nodes as N
+
+
+def _chain_to_scan(node: N.PlanNode) -> N.TableScan | None:
+    """The TableScan under ``node`` if the path is all Filter/Project."""
+    while isinstance(node, (N.Filter, N.Project)):
+        node = node.source
+    return node if isinstance(node, N.TableScan) else None
+
+
+def _count_scans(plan: N.PlanNode) -> int:
+    n = 1 if isinstance(plan, N.TableScan) else 0
+    return n + sum(_count_scans(s) for s in plan.sources())
+
+
+def _find_streamable(plan: N.PlanNode):
+    """Find (aggregate, scan) when the plan is streamable."""
+    if _count_scans(plan) != 1:
+        return None
+    node = plan
+    while not isinstance(node, N.Aggregate):
+        srcs = node.sources()
+        if len(srcs) != 1:
+            return None
+        node = srcs[0]
+    if node.step != N.AggStep.SINGLE:
+        return None
+    if any(call.distinct for call in node.aggs.values()):
+        return None
+    scan = _chain_to_scan(node.source)
+    if scan is None:
+        return None
+    return node, scan
+
+
+def _replace_node(plan: N.PlanNode, target: N.PlanNode,
+                  repl: N.PlanNode) -> N.PlanNode:
+    if plan is target:
+        return repl
+    updates = {}
+    for f in dataclasses.fields(plan):
+        v = getattr(plan, f.name)
+        if isinstance(v, N.PlanNode):
+            updates[f.name] = _replace_node(v, target, repl)
+        elif isinstance(v, list) and v and isinstance(v[0], N.PlanNode):
+            updates[f.name] = [_replace_node(x, target, repl) for x in v]
+    return dataclasses.replace(plan, **updates) if updates else plan
+
+
+def try_execute_streamed(engine, plan: N.PlanNode):
+    """Execute ``plan`` block-streamed, or return None if inapplicable."""
+    from presto_tpu.exec.executor import (
+        ScanInput, collect_scans, make_traced, run_plan)
+
+    block = int(engine.session.get("scan_block_rows") or 0)
+    if block <= 0:
+        return None
+    found = _find_streamable(plan)
+    if found is None:
+        return None
+    agg, scan_node = found
+    scans = collect_scans(plan, engine)
+    scan = scans[0]
+    if scan.nrows <= block:
+        return None
+
+    # -- phase 1: one compiled partial-aggregate program, run per block --
+    partial = dataclasses.replace(agg, step=N.AggStep.PARTIAL)
+    nblocks = -(-scan.nrows // block)
+    capacities: dict[tuple, int] = {}
+    partial_cols: list[list[np.ndarray]] = []
+    partial_live: list[np.ndarray] = []
+    out_schema = None
+
+    def block_input(i: int) -> dict[str, np.ndarray]:
+        lo, hi = i * block, min((i + 1) * block, scan.nrows)
+        out = {}
+        for sym, a in scan.arrays.items():
+            b = a[lo:hi]
+            if hi - lo < block:
+                b = np.pad(b, [(0, block - (hi - lo))]
+                           + [(0, 0)] * (a.ndim - 1))
+            out[sym] = b
+        out["__live__"] = np.arange(block) < (hi - lo)
+        return out
+
+    compiled = None
+    meta = None
+    for i in range(nblocks):
+        arrays = block_input(i)
+        for _attempt in range(10):
+            if compiled is None:
+                block_scan = ScanInput(scan.node, arrays,
+                                       scan.dictionaries, scan.types,
+                                       block)
+                traced_fn, _flat, meta = make_traced(
+                    [block_scan], partial, capacities, engine.session)
+                compiled = jax.jit(traced_fn)
+            res, live, oks = compiled(
+                *[arrays[sym] for sym in scan.arrays], arrays["__live__"])
+            if all(bool(o) for o in oks):
+                break
+            for key, okv in zip(meta["ok_keys"], oks):
+                if not bool(okv):
+                    capacities[key] = 2 * meta["used_capacity"][key]
+            compiled = None  # recompile with grown capacity
+        else:
+            raise RuntimeError("hash table capacity retry limit exceeded")
+        out_schema = meta["out"]
+        partial_cols.append([np.asarray(r) for r in res])
+        partial_live.append(np.asarray(live))
+
+    # -- phase 2: rest of the plan over the concatenated partials --------
+    carrier_syms = [sym for sym, _t, _d, _v in out_schema]
+    carrier_types = {sym: t for sym, t, _d, _v in out_schema}
+    carrier = N.TableScan("__stream__", "__partials__",
+                          {sym: sym for sym in carrier_syms},
+                          carrier_types)
+    final_agg = dataclasses.replace(agg, source=carrier,
+                                    step=N.AggStep.FINAL)
+    plan2 = _replace_node(plan, agg, final_agg)
+
+    arrays2: dict[str, np.ndarray] = {}
+    dicts2: dict[str, np.ndarray | None] = {}
+    for j, (sym, _t, d, has_valid) in enumerate(out_schema):
+        arrays2[sym] = np.concatenate([p[2 * j] for p in partial_cols])
+        if has_valid:
+            arrays2[f"{sym}$valid"] = np.concatenate(
+                [p[2 * j + 1] for p in partial_cols])
+        dicts2[sym] = d
+    arrays2["__live__"] = np.concatenate(partial_live)
+    total = int(arrays2["__live__"].shape[0])
+    carrier_input = ScanInput(carrier, arrays2, dicts2, carrier_types,
+                              total)
+    engine.last_streamed_blocks = nblocks
+    return run_plan(engine, plan2, [carrier_input])

@@ -197,6 +197,11 @@ class SemiJoin(PlanNode):
     filter_keys: list[str] = dataclasses.field(default_factory=list)
     output: str = ""
     negated: bool = False  # NOT IN / NOT EXISTS handled at planner level
+    # three-valued NOT IN semantics: the mark is NULL (not FALSE) when
+    # the probed value is NULL or the subquery values contain a NULL
+    # (reference SemiJoinNode null-aware semantics); applies to the
+    # first key only (later keys are correlation equalities)
+    null_aware: bool = False
     capacity: int | None = None
 
     # single-key compatibility accessors

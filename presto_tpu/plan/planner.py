@@ -1613,8 +1613,12 @@ class LogicalPlanner:
         src_keys = [operand_sym] + [o for (o, _i, _t) in corr]
         flt_keys = [value_sym] + [i for (_o, i, _t) in corr]
         mark = self.symbols.fresh("semi")
+        # NOT IN needs SQL three-valued semantics: a NULL operand or a
+        # NULL in the subquery values makes the mark NULL (row dropped
+        # by the filter), not FALSE (reference SemiJoinNode semantics)
         qs.node = N.SemiJoin(qs.node, sub.node, src_keys, flt_keys, mark,
-                             negated, capacity=_next_pow2(2 * sub.est))
+                             negated, capacity=_next_pow2(2 * sub.est),
+                             null_aware=negated)
         pred: ir.Expr = ir.ColumnRef(T.BOOLEAN, mark)
         if negated:
             pred = ir.Call(T.BOOLEAN, "not", (pred,))

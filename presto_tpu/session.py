@@ -33,6 +33,10 @@ SYSTEM_SESSION_PROPERTIES: dict[str, tuple[Any, type, str]] = {
                                    "gathering them"),
     "partial_aggregation": (True, bool,
                             "partial->final aggregation across shards"),
+    "scan_block_rows": (1 << 24, int,
+                        "stream scans bigger than this in blocks of this "
+                        "many rows through a partial-aggregate kernel "
+                        "(the split analog; 0 disables streaming)"),
 }
 
 

@@ -97,7 +97,12 @@ def values_equal(a, b, rel: float = 1e-6, absol: float = 1e-9) -> bool:
         if math.isclose(fa, fb, rel_tol=rel, abs_tol=absol):
             return True
         # engine value at some decimal scale k == oracle rounded to k?
-        for k in range(0, 7):
+        # k starts at 2 (the smallest decimal scale in our catalogs):
+        # starting at 0 would let any integer-valued engine float match
+        # any oracle value within 0.5 — e.g. 5.0 vs 5.4 — silently
+        # masking real aggregation bugs. A value exact at scale < 2 is
+        # also exact at scale 2, so nothing legitimate is lost.
+        for k in range(2, 7):
             f = 10.0 ** k
             if abs(fa * f - round(fa * f)) < 1e-6:
                 return math.isclose(fa, round(fb * f) / f,

@@ -1,0 +1,45 @@
+"""SQL three-valued-logic edge cases: NOT IN with NULLs on either side
+(reference SemiJoinNode null-aware semantics) and decimal avg rounding
+(reference AverageAggregations HALF_UP)."""
+
+from presto_tpu.testing.oracle import assert_query
+
+
+def test_not_in_with_null_in_subquery(engine, oracle):
+    # subquery values contain a NULL: x NOT IN (..., NULL) is never TRUE
+    sql = ("select count(*) from orders where o_orderkey not in "
+           "(select case when l_linenumber = 3 then null "
+           "else l_orderkey end from lineitem)")
+    assert_query(engine, oracle, sql)
+    got = engine.execute(sql)
+    assert got[0][0] == 0
+
+
+def test_not_in_with_null_probe(engine, oracle):
+    # NULL probe value: NULL NOT IN (non-empty set) is NULL -> dropped
+    sql = ("select count(*) from lineitem where "
+           "(case when l_linenumber = 3 then null else l_orderkey end) "
+           "not in (select o_orderkey from orders where o_orderkey > 5)")
+    assert_query(engine, oracle, sql)
+
+
+def test_not_in_empty_set_keeps_null_probe(engine, oracle):
+    # x IN (empty) is FALSE even for NULL x, so NOT IN keeps every row
+    sql = ("select count(*) from lineitem where "
+           "(case when l_linenumber = 3 then null else l_orderkey end) "
+           "not in (select o_orderkey from orders where o_orderkey < 0)")
+    assert_query(engine, oracle, sql)
+
+
+def test_in_unaffected_by_null_awareness(engine, oracle):
+    sql = ("select count(*) from orders where o_orderkey in "
+           "(select l_orderkey from lineitem where l_quantity < 5)")
+    assert_query(engine, oracle, sql)
+
+
+def test_avg_decimal_half_up(engine):
+    # avg(decimal(p,2)) keeps scale 2 with HALF_UP rounding
+    rows = engine.execute(
+        "select avg(l_quantity) from lineitem where l_orderkey < 100")
+    v = rows[0][0]
+    assert abs(v * 100 - round(v * 100)) < 1e-9
