@@ -63,6 +63,14 @@ def collect_scans(plan: N.PlanNode, engine) -> list[ScanInput]:
     return out
 
 
+def _df_hash(v: Val):
+    """Content hash of a key column for dynamic-filter blooms."""
+    from presto_tpu.ops import hash as H
+    if v.is_string:
+        return H.hash_string_column(v.data, v.dictionary, v.valid)
+    return H.hash_int_column(v.data, v.valid)
+
+
 class PlanInterpreter:
     """Walks the plan during trace, building the XLA computation."""
 
@@ -75,10 +83,60 @@ class PlanInterpreter:
         self.ok_flags: list = []
         self.ok_keys: list[tuple] = []
         self.used_capacity: dict[tuple, int] = {}
+        # dynamic filtering: probe-key symbol -> (min, max) from the
+        # already-traced build side; applied at the FIRST probe-subtree
+        # node that outputs the symbol (i.e. the scan), the trace-time
+        # analog of the reference's DynamicFilterService pushdown
+        # (server/DynamicFilterService.java:102,
+        # operator/DynamicFilterSourceOperator.java:55)
+        self.dyn_filters: dict[str, tuple] = {}
+        self._df_applied: set[str] = set()
 
     def run(self, node: N.PlanNode) -> DTable:
         m = getattr(self, "_r_" + type(node).__name__.lower())
-        return m(node)
+        dt = m(node)
+        if self.dyn_filters:
+            dt = self._apply_dyn_filters(dt)
+        return dt
+
+    def _apply_dyn_filters(self, dt: DTable) -> DTable:
+        keep = None
+        for sym, bits in self.dyn_filters.items():
+            v = dt.cols.get(sym)
+            if v is None or sym in self._df_applied:
+                continue
+            self._df_applied.add(sym)
+            m = jnp.uint64(bits.shape[0])
+            h = (_df_hash(v) % m).astype(jnp.int32)
+            k = bits[h]
+            if v.valid is not None:
+                # NULL keys never match an inner join
+                k = k & v.valid
+            keep = k if keep is None else (keep & k)
+        if keep is None:
+            return dt
+        live = keep if dt.live is None else (dt.live & keep)
+        return DTable(dt.cols, live, dt.n)
+
+    def _collect_dyn_filters(self, node: N.Join, build: DTable,
+                             max_bits: int = 1 << 22) -> list[str]:
+        """Build a one-hash bloom mask of the build-side key set per
+        equi-key before the probe subtree is traced. False positives
+        only cost the pruning (the join re-verifies); false negatives
+        are impossible. Returns the registered probe symbols (a symbol
+        may be re-registered by a later join over the same key)."""
+        live = build.live_mask()
+        m = next_pow2(min(4 * max(build.n, 16), max_bits))
+        registered = []
+        for lk, rk in node.criteria:
+            v = build.cols[rk]
+            w = live if v.valid is None else (live & v.valid)
+            h = (_df_hash(v) % jnp.uint64(m)).astype(jnp.int32)
+            bits = jnp.zeros((m,), dtype=bool)
+            bits = bits.at[jnp.where(w, h, m)].set(True, mode="drop")
+            self.dyn_filters[lk] = bits
+            registered.append(lk)
+        return registered
 
     def _capacity(self, node, default: int, kind: str = "table",
                   override: int | None = None) -> int:
@@ -149,8 +207,12 @@ class PlanInterpreter:
         return out
 
     def _r_join(self, node: N.Join) -> DTable:
-        left = self.run(node.left)
+        # build side first so its key range can prune the probe scan
         right = self.run(node.right)
+        if (node.join_type == N.JoinType.INNER
+                and self.session.get("enable_dynamic_filtering")):
+            self._collect_dyn_filters(node, right)
+        left = self.run(node.left)
         cap = self._capacity(node, next_pow2(2 * right.n))
         if node.build_unique:
             out, ok = OP.apply_join(left, right, node, cap)

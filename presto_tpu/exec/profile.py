@@ -23,8 +23,8 @@ from presto_tpu.plan.printer import format_plan
 
 
 class ProfilingInterpreter(PlanInterpreter):
-    def __init__(self, scans, capacities):
-        super().__init__(scans, capacities)
+    def __init__(self, scans, capacities, session=None):
+        super().__init__(scans, capacities, session)
         self.row_counts: list[tuple[int, object]] = []
 
     def run(self, node: N.PlanNode):
@@ -48,7 +48,8 @@ def explain_analyze(engine, plan: N.PlanNode) -> str:
             for scan in scan_inputs:
                 traced = {sym: next(it) for sym in scan.arrays}
                 scans[id(scan.node)] = (scan, traced)
-            interp = ProfilingInterpreter(scans, capacities)
+            interp = ProfilingInterpreter(scans, capacities,
+                                          engine.session)
             out = interp.run(plan)
             meta["ok_keys"] = interp.ok_keys
             meta["used_capacity"] = interp.used_capacity

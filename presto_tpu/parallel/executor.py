@@ -36,7 +36,8 @@ from jax.sharding import Mesh, PartitionSpec as P
 from presto_tpu import types as T
 from presto_tpu.block import Column, Table
 from presto_tpu.exec import operators as OP
-from presto_tpu.exec.executor import ScanInput, collect_scans
+from presto_tpu.exec.executor import (PlanInterpreter, ScanInput,
+                                      collect_scans)
 from presto_tpu.exec.operators import DTable
 from presto_tpu.expr.compile import Val
 from presto_tpu.ops import hash as H
@@ -86,6 +87,11 @@ class ShardedInterpreter:
         self.ok_flags: list = []
         self.ok_keys: list[tuple] = []
         self.used_capacity: dict[tuple, int] = {}
+        # dynamic filtering (see exec/executor.PlanInterpreter): probe
+        # symbol -> (min, max); ranges are mesh-global (pmin/pmax) so
+        # pruning is consistent across shards
+        self.dyn_filters: dict[str, tuple] = {}
+        self._df_applied: set[str] = set()
 
     # -- plumbing shared with the local interpreter -------------------------
 
@@ -117,7 +123,26 @@ class ShardedInterpreter:
 
     def run(self, node: N.PlanNode) -> DistTable:
         m = getattr(self, "_r_" + type(node).__name__.lower())
-        return m(node)
+        out = m(node)
+        if self.dyn_filters:
+            dt = PlanInterpreter._apply_dyn_filters(self, out.dt)
+            if dt is not out.dt:
+                out = DistTable(dt, out.dist)
+        return out
+
+    def _collect_dyn_filters(self, node: N.Join, build: DTable,
+                             global_reduce: bool) -> None:
+        # smaller bloom under the mesh: the bit array crosses ICI
+        registered = PlanInterpreter._collect_dyn_filters(
+            self, node, build, max_bits=1 << 20)
+        if global_reduce:
+            # union of per-shard key sets — every registration needs it,
+            # including re-registrations of a symbol by a later join
+            # (shard-local bits would falsely prune other shards' keys)
+            for lk in registered:
+                bits = self.dyn_filters[lk]
+                self.dyn_filters[lk] = jax.lax.pmax(
+                    bits.astype(jnp.int32), AXIS) > 0
 
     def replicated(self, node: N.PlanNode) -> DTable:
         out = self.run(node)
@@ -187,7 +212,6 @@ class ShardedInterpreter:
         return DistTable(DTable(cols, live, local_n), SHARDED)
 
     def _r_values(self, node: N.Values) -> DistTable:
-        from presto_tpu.exec.executor import PlanInterpreter
         dt = PlanInterpreter({}, {})._r_values(node)
         return DistTable(dt, REPLICATED)
 
@@ -269,8 +293,13 @@ class ShardedInterpreter:
     # -- joins: broadcast or hash-repartitioned build/probe ------------------
 
     def _r_join(self, node: N.Join) -> DistTable:
-        left = self.run(node.left)
+        # build side first so its key range can prune the probe scans
         right = self.run(node.right)
+        if (node.join_type == N.JoinType.INNER
+                and self.session.get("enable_dynamic_filtering")):
+            self._collect_dyn_filters(node, right.dt,
+                                      right.dist == SHARDED)
+        left = self.run(node.left)
         lkeys = [lk for lk, _ in node.criteria]
         rkeys = [rk for _, rk in node.criteria]
         if (node.criteria and left.dist == SHARDED

@@ -33,6 +33,10 @@ SYSTEM_SESSION_PROPERTIES: dict[str, tuple[Any, type, str]] = {
                                    "gathering them"),
     "partial_aggregation": (True, bool,
                             "partial->final aggregation across shards"),
+    "enable_dynamic_filtering": (True, bool,
+                                 "prune probe scans with build-side "
+                                 "join-key min/max ranges (reference "
+                                 "DynamicFilterService)"),
     "scan_block_rows": (1 << 24, int,
                         "stream scans bigger than this in blocks of this "
                         "many rows through a partial-aggregate kernel "
