@@ -463,7 +463,10 @@ def apply_union(parts: list[DTable], node: N.Union) -> DTable:
     return DTable(out, live, n)
 
 
-def _sort_perm(dt: DTable, orderings: list[N.Ordering]):
+def _sort_keys(dt: DTable, orderings: list[N.Ordering]) -> list:
+    """Per-row sort key arrays: ascending lexicographic order over the
+    returned list == the requested ordering (dead rows last, null
+    placement per SQL semantics folded into the key values)."""
     live = dt.live_mask()
     keys = [(~live).astype(jnp.int32)]  # dead rows last
     for o in orderings:
@@ -485,9 +488,79 @@ def _sort_perm(dt: DTable, orderings: list[N.Ordering]):
         if v.valid is not None:
             data = jnp.where(v.valid, data, null_key)
         keys.append(data)
+    return keys
+
+
+def _sort_perm(dt: DTable, orderings: list[N.Ordering]):
+    keys = _sort_keys(dt, orderings)
     operands = tuple(keys) + (jnp.arange(dt.n, dtype=jnp.int32),)
     sorted_ops = jax.lax.sort(operands, num_keys=len(keys), is_stable=True)
     return sorted_ops[-1]
+
+
+def merge_runs_perm(keys: list, k: int, m: int):
+    """Permutation merging ``k`` presorted runs of ``m`` rows each
+    (stored concatenated) into one sorted order — the kernel behind
+    merge exchange / distributed sort (reference MergeOperator.java:44,
+    docs/admin/dist-sort.rst).
+
+    Each row's output position is its local rank plus, for every other
+    run, the count of rows ordered before it — found by a vectorised
+    binary search with the full lexicographic comparator, O(N·k·log m)
+    elementwise work instead of re-sorting N rows (O(N·log^2 N)
+    compare-exchange stages), with the expensive per-shard sorts running
+    in parallel on their own devices. Ties break by (run, local rank),
+    matching a stable sort of the concatenation. NaN sort-key values are
+    unsupported (SQL nulls are already folded to +/-inf by _sort_keys).
+    """
+    n = k * m
+    run_of = jnp.arange(n, dtype=jnp.int32) // m
+    local_rank = jnp.arange(n, dtype=jnp.int32) % m
+    rank = local_rank
+    # lower-bound binary search over [0, m] needs floor(log2 m)+1 halvings
+    steps = m.bit_length()
+    for j in range(k):
+        run_keys = [kk[j * m:(j + 1) * m] for kk in keys]
+        # ties in run j precede rows of later runs (stability)
+        tie_after = run_of > j
+        lo = jnp.zeros((n,), jnp.int32)
+        hi = jnp.full((n,), m, jnp.int32)
+        for _ in range(steps):
+            mid = (lo + hi) >> 1
+            lt = jnp.zeros((n,), bool)
+            eq = jnp.ones((n,), bool)
+            for rk, qk in zip(run_keys, keys):
+                c = rk[mid]
+                lt = lt | (eq & (c < qk))
+                eq = eq & (c == qk)
+            before = lt | (eq & tie_after)  # run[mid] orders before query
+            open_ = lo < hi  # converged lanes must not move past hi
+            lo = jnp.where(open_ & before, mid + 1, lo)
+            hi = jnp.where(open_ & ~before, mid, hi)
+        rank = rank + jnp.where(run_of == j, 0, lo)
+    # rank is a permutation of 0..n-1; invert to a gather index
+    return jnp.zeros((n,), jnp.int32).at[rank].set(
+        jnp.arange(n, dtype=jnp.int32))
+
+
+def merge_sorted_runs(dt: DTable, orderings: list[N.Ordering],
+                      k: int) -> DTable:
+    """Merge a table holding ``k`` concatenated presorted runs."""
+    assert dt.n % k == 0
+    perm = merge_runs_perm(_sort_keys(dt, orderings), k, dt.n // k)
+    return _gather_table(dt, perm)
+
+
+def head(dt: DTable, count: int) -> DTable:
+    """Static slice of the first ``count`` rows (compaction after sort —
+    the analog of a bounded PageBuilder flush before an exchange)."""
+    c = min(count, dt.n)
+    cols = {sym: Val(v.dtype, v.data[:c],
+                     None if v.valid is None else v.valid[:c],
+                     v.dictionary)
+            for sym, v in dt.cols.items()}
+    live = None if dt.live is None else dt.live[:c]
+    return DTable(cols, live, c)
 
 
 def _nulls_last(o: N.Ordering) -> bool:

@@ -376,12 +376,35 @@ class ShardedInterpreter:
         return DistTable(OP.apply_window(dt, node), REPLICATED)
 
     def _r_sort(self, node: N.Sort) -> DistTable:
-        dt = self.replicated(node.source)
+        src = self.run(node.source)
+        if src.dist == SHARDED and self.session.get("distributed_sort"):
+            # merge exchange (MergeOperator.java:44): the O(n log^2 n)
+            # sort network runs on n/nshards rows per device in
+            # parallel; the replicated stage only merges presorted runs
+            local = OP.apply_sort(src.dt, node.orderings)
+            gathered = _gather(local, self.nshards)
+            merged = OP.merge_sorted_runs(gathered, node.orderings,
+                                          self.nshards)
+            return DistTable(merged, REPLICATED)
+        dt = (src.dt if src.dist == REPLICATED
+              else _gather(src.dt, self.nshards))
         return DistTable(OP.apply_sort(dt, node.orderings), REPLICATED)
 
     def _r_topn(self, node: N.TopN) -> DistTable:
-        dt = self.replicated(node.source)
-        return DistTable(OP.apply_topn(dt, node.count, node.orderings),
+        src = self.run(node.source)
+        if src.dist == SHARDED:
+            # partial topN per shard, compact to `count` rows, then a
+            # final topN over nshards*count gathered candidates — the
+            # exchange carries O(count) rows instead of the whole input
+            # (reference TopNOperator partial/final split)
+            local = OP.head(
+                OP.apply_topn(src.dt, node.count, node.orderings),
+                node.count)
+            gathered = _gather(local, self.nshards)
+            return DistTable(
+                OP.apply_topn(gathered, node.count, node.orderings),
+                REPLICATED)
+        return DistTable(OP.apply_topn(src.dt, node.count, node.orderings),
                          REPLICATED)
 
     def _r_limit(self, node: N.Limit) -> DistTable:

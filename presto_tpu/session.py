@@ -37,6 +37,10 @@ SYSTEM_SESSION_PROPERTIES: dict[str, tuple[Any, type, str]] = {
                                  "prune probe scans with build-side "
                                  "join-key min/max ranges (reference "
                                  "DynamicFilterService)"),
+    "distributed_sort": (True, bool,
+                         "sort sharded inputs per-shard and n-way merge "
+                         "the presorted runs (reference MergeOperator) "
+                         "instead of gathering and fully sorting"),
     "scan_block_rows": (1 << 24, int,
                         "stream scans bigger than this in blocks of this "
                         "many rows through a partial-aggregate kernel "

@@ -40,3 +40,62 @@ def test_distributed_row_sharded_scan_count(engine, mesh):
     got = engine.execute("select count(*) from lineitem", mesh=mesh)
     want = engine.execute("select count(*) from lineitem")
     assert got == want
+
+
+# -- merge-exchange distributed sort (reference MergeOperator.java:44) ----
+
+SORT_SQL = ("select l_orderkey, l_extendedprice from lineitem "
+            "where l_quantity < 10 "
+            "order by l_extendedprice desc, l_orderkey")
+
+
+def _sort_dims(hlo: str) -> list[int]:
+    """Row counts of every sort op in the compiled (StableHLO) module."""
+    import re
+    return [int(m_.group(1)) for m_ in
+            re.finditer(r'"stablehlo\.sort".*?\}\) : \(tensor<(\d+)x',
+                        hlo, re.S)]
+
+
+def test_distributed_sort_merges_presorted_runs(engine, oracle, mesh):
+    """With distributed_sort on, every sort in the HLO runs on a
+    per-shard row count (the merge replaces the replicated full sort);
+    flipping the property off brings back the full-size sort. Results
+    match the oracle either way."""
+    from presto_tpu.sql.parser import parse_statement
+    from presto_tpu.sql.sqlite_dialect import to_sqlite
+
+    want = oracle.query(to_sqlite(parse_statement(SORT_SQL)))
+
+    engine.session.set("distributed_sort", True)
+    got = engine.execute(SORT_SQL, mesh=mesh)
+    ok, msg = rows_equal(got, want, ordered=True)
+    assert ok, msg
+    dims_on = _sort_dims(engine.last_dist_hlo)
+    assert dims_on, "expected per-shard sort ops in HLO"
+    local_max = max(dims_on)
+
+    engine.session.set("distributed_sort", False)
+    try:
+        got = engine.execute(SORT_SQL, mesh=mesh)
+        ok, msg = rows_equal(got, want, ordered=True)
+        assert ok, msg
+        dims_off = _sort_dims(engine.last_dist_hlo)
+    finally:
+        engine.session.set("distributed_sort", True)
+    # gather-then-sort sorts the full (8x) row count
+    assert max(dims_off) >= 8 * local_max, (dims_on, dims_off)
+
+
+def test_distributed_topn_partial_final(engine, oracle, mesh):
+    """Distributed TopN sorts per shard and exchanges only `count`
+    candidate rows per shard."""
+    from presto_tpu.sql.parser import parse_statement
+    from presto_tpu.sql.sqlite_dialect import to_sqlite
+
+    sql = ("select l_orderkey, l_extendedprice from lineitem "
+           "order by l_extendedprice desc, l_orderkey limit 20")
+    got = engine.execute(sql, mesh=mesh)
+    want = oracle.query(to_sqlite(parse_statement(sql)))
+    ok, msg = rows_equal(got, want, ordered=True)
+    assert ok, msg

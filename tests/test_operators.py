@@ -164,3 +164,29 @@ def test_hand_semijoin_and_topn(engine, oracle):
         "ORDER BY o_totalprice DESC, o_orderkey LIMIT 5")
     ok, msg = rows_equal(got, want, ordered=True)
     assert ok, msg
+
+
+def test_merge_runs_perm_matches_stable_sort():
+    """k presorted runs merge to exactly a stable full sort (the merge
+    exchange kernel behind distributed sort)."""
+    import numpy as np
+    import jax.numpy as jnp
+    from presto_tpu.exec.operators import merge_runs_perm
+
+    rng = np.random.default_rng(7)
+    for k, m in [(1, 5), (2, 8), (4, 1), (8, 33), (8, 64)]:
+        k1 = rng.integers(0, 5, k * m)
+        k2 = rng.integers(0, 3, k * m)
+        for j in range(k):
+            sl = slice(j * m, (j + 1) * m)
+            order = np.lexsort((k2[sl], k1[sl]))
+            k1[sl], k2[sl] = k1[sl][order], k2[sl][order]
+        perm = np.asarray(merge_runs_perm(
+            [jnp.asarray(k1), jnp.asarray(k2)], k, m))
+        assert sorted(perm.tolist()) == list(range(k * m))
+        assert list(zip(k1[perm], k2[perm])) == sorted(zip(k1, k2))
+        prev = None
+        for p in perm:  # stability: ties keep (run, local rank) order
+            if prev is not None and (k1[prev], k2[prev]) == (k1[p], k2[p]):
+                assert prev < p
+            prev = p
