@@ -474,19 +474,32 @@ def _sort_keys(dt: DTable, orderings: list[N.Ordering]) -> list:
         data = v.data
         if data.dtype == jnp.bool_:
             data = data.astype(jnp.int32)
-        if jnp.issubdtype(data.dtype, jnp.floating):
-            big = jnp.asarray(jnp.inf, data.dtype)
-        else:
-            big = jnp.asarray(jnp.iinfo(data.dtype).max, data.dtype)
+        is_float = jnp.issubdtype(data.dtype, jnp.floating)
         if not o.ascending:
-            data = -data
-            # nulls: reference semantics treat null as largest -> first in
-            # DESC unless overridden
-            null_key = -big if not _nulls_last(o) else big
-        else:
-            null_key = big if _nulls_last(o) else -big
+            # ints reverse via bitwise NOT (~x = -x-1): monotone
+            # decreasing with no INT_MIN negation wrap
+            data = -data if is_float else ~data
+        # Nulls and NaNs order via a separate class-key level rather
+        # than folding into extreme data values: value < NaN < NULL
+        # (reference NaN-is-largest + null-is-largest semantics, null
+        # placement per _nulls_last). Folding would collide NULL/NaN
+        # with genuine +-inf / INT_MAX data, and NaN would break
+        # merge_runs_perm's rank counting (needs a total comparator) —
+        # dead lanes can carry NaN from computed expressions even when
+        # live rows never do.
+        cls = None
+        if is_float:
+            nan = jnp.isnan(data)
+            cls = jnp.where(nan, 1 if o.ascending else -1, 0
+                            ).astype(jnp.int32)
+            data = jnp.where(nan, jnp.zeros_like(data), data)
         if v.valid is not None:
-            data = jnp.where(v.valid, data, null_key)
+            if cls is None:
+                cls = jnp.zeros(data.shape, jnp.int32)
+            cls = jnp.where(v.valid, cls, 2 if _nulls_last(o) else -2)
+            data = jnp.where(v.valid, data, jnp.zeros_like(data))
+        if cls is not None:
+            keys.append(cls)
         keys.append(data)
     return keys
 
@@ -510,8 +523,10 @@ def merge_runs_perm(keys: list, k: int, m: int):
     elementwise work instead of re-sorting N rows (O(N·log^2 N)
     compare-exchange stages), with the expensive per-shard sorts running
     in parallel on their own devices. Ties break by (run, local rank),
-    matching a stable sort of the concatenation. NaN sort-key values are
-    unsupported (SQL nulls are already folded to +/-inf by _sort_keys).
+    matching a stable sort of the concatenation. Key arrays must be
+    NaN-free so the comparator is total — _sort_keys guarantees this by
+    encoding NULL/NaN in a separate int32 class-key level and zeroing
+    the data lanes underneath.
     """
     n = k * m
     run_of = jnp.arange(n, dtype=jnp.int32) // m

@@ -190,3 +190,59 @@ def test_merge_runs_perm_matches_stable_sort():
             if prev is not None and (k1[prev], k2[prev]) == (k1[p], k2[p]):
                 assert prev < p
             prev = p
+
+
+def test_sort_nan_inf_null_ordering():
+    """ORDER BY total order is value < inf < NaN < NULL ascending
+    (reference NaN-is-largest + null-is-largest), via the class-key
+    level in _sort_keys — folding into the float domain would collide
+    NaN/NULL with genuine infinities."""
+    import numpy as np
+    import jax.numpy as jnp
+    from presto_tpu import types as T
+    from presto_tpu.exec.operators import DTable, apply_sort
+    from presto_tpu.expr.compile import Val
+    from presto_tpu.plan import nodes as N
+
+    data = np.array([np.nan, np.inf, 1.0, 0.0, -np.inf])
+    valid = np.array([True, True, True, False, True])
+    dt = DTable({"x": Val(T.DOUBLE, jnp.asarray(data),
+                          jnp.asarray(valid), None)}, None, 5)
+
+    def vals(out):
+        v = out.cols["x"]
+        return [None if not bool(v.valid[i]) else float(v.data[i])
+                for i in range(5)]
+
+    asc = vals(apply_sort(dt, [N.Ordering("x", True, None)]))
+    assert asc == [-np.inf, 1.0, np.inf, asc[3], None] and np.isnan(asc[3])
+    desc = vals(apply_sort(dt, [N.Ordering("x", False, None)]))
+    assert desc[0] is None and np.isnan(desc[1])
+    assert desc[2:] == [np.inf, 1.0, -np.inf]
+
+
+def test_merge_runs_nan_keys_stay_permutation():
+    """NaN in a float sort key (possible in dead lanes of computed
+    expressions) must not break the merge's rank counting: _sort_keys
+    emits NaN-free key levels so the comparator stays total."""
+    import numpy as np
+    import jax.numpy as jnp
+    from presto_tpu import types as T
+    from presto_tpu.exec.operators import (DTable, _sort_keys,
+                                           merge_runs_perm)
+    from presto_tpu.expr.compile import Val
+    from presto_tpu.plan import nodes as N
+
+    for asc in (True, False):
+        data = np.array([1.0, 2.0, 3.0, np.nan, 0.5, 1.5, 2.5, 3.5])
+        dt = DTable({"x": Val(T.DOUBLE, jnp.asarray(data), None, None)},
+                    None, 8)
+        keys = _sort_keys(dt, [N.Ordering("x", asc, None)])
+        k1 = np.array(keys[1])
+        for j in range(2):
+            sl = slice(j * 4, (j + 1) * 4)
+            k1[sl] = np.sort(k1[sl])
+        perm = np.asarray(merge_runs_perm(
+            [keys[0], jnp.asarray(k1)], 2, 4))
+        assert sorted(perm.tolist()) == list(range(8))
+        assert (k1[perm] == np.sort(k1)).all()
