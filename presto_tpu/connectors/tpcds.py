@@ -27,7 +27,8 @@ SCHEMAS: dict[str, dict[str, T.DataType]] = {
     "date_dim": {
         "d_date_sk": T.BIGINT, "d_date_id": T.VARCHAR, "d_date": T.DATE,
         "d_year": T.BIGINT, "d_moy": T.BIGINT, "d_dom": T.BIGINT,
-        "d_qoy": T.BIGINT, "d_day_name": T.VARCHAR,
+        "d_qoy": T.BIGINT, "d_dow": T.BIGINT,
+        "d_day_name": T.VARCHAR,
         "d_month_seq": T.BIGINT, "d_week_seq": T.BIGINT,
     },
     "item": {
@@ -81,7 +82,8 @@ SCHEMAS: dict[str, dict[str, T.DataType]] = {
         "p_channel_tv": T.VARCHAR, "p_promo_name": T.VARCHAR,
     },
     "store_sales": {
-        "ss_sold_date_sk": T.BIGINT, "ss_item_sk": T.BIGINT,
+        "ss_sold_date_sk": T.BIGINT, "ss_sold_time_sk": T.BIGINT,
+        "ss_item_sk": T.BIGINT,
         "ss_customer_sk": T.BIGINT, "ss_cdemo_sk": T.BIGINT,
         "ss_hdemo_sk": T.BIGINT, "ss_addr_sk": T.BIGINT,
         "ss_store_sk": T.BIGINT, "ss_promo_sk": T.BIGINT,
@@ -95,21 +97,64 @@ SCHEMAS: dict[str, dict[str, T.DataType]] = {
     "catalog_sales": {
         "cs_sold_date_sk": T.BIGINT, "cs_item_sk": T.BIGINT,
         "cs_bill_customer_sk": T.BIGINT, "cs_ship_customer_sk": T.BIGINT,
+        "cs_bill_cdemo_sk": T.BIGINT, "cs_bill_hdemo_sk": T.BIGINT,
         "cs_ship_date_sk": T.BIGINT, "cs_warehouse_sk": T.BIGINT,
+        "cs_ship_mode_sk": T.BIGINT, "cs_call_center_sk": T.BIGINT,
         "cs_promo_sk": T.BIGINT, "cs_order_number": T.BIGINT,
         "cs_quantity": T.BIGINT, "cs_wholesale_cost": DEC2,
         "cs_list_price": DEC2, "cs_sales_price": DEC2,
-        "cs_ext_sales_price": DEC2, "cs_net_paid": DEC2,
-        "cs_net_profit": DEC2,
+        "cs_ext_discount_amt": DEC2, "cs_ext_sales_price": DEC2,
+        "cs_ext_wholesale_cost": DEC2, "cs_ext_list_price": DEC2,
+        "cs_ext_ship_cost": DEC2, "cs_coupon_amt": DEC2,
+        "cs_net_paid": DEC2, "cs_net_profit": DEC2,
     },
     "web_sales": {
-        "ws_sold_date_sk": T.BIGINT, "ws_item_sk": T.BIGINT,
+        "ws_sold_date_sk": T.BIGINT, "ws_sold_time_sk": T.BIGINT,
+        "ws_item_sk": T.BIGINT,
         "ws_bill_customer_sk": T.BIGINT, "ws_ship_customer_sk": T.BIGINT,
+        "ws_ship_hdemo_sk": T.BIGINT, "ws_ship_addr_sk": T.BIGINT,
         "ws_ship_date_sk": T.BIGINT, "ws_warehouse_sk": T.BIGINT,
+        "ws_web_site_sk": T.BIGINT, "ws_web_page_sk": T.BIGINT,
+        "ws_ship_mode_sk": T.BIGINT,
         "ws_promo_sk": T.BIGINT, "ws_order_number": T.BIGINT,
-        "ws_quantity": T.BIGINT, "ws_sales_price": DEC2,
-        "ws_ext_sales_price": DEC2, "ws_net_paid": DEC2,
-        "ws_net_profit": DEC2,
+        "ws_quantity": T.BIGINT, "ws_list_price": DEC2,
+        "ws_sales_price": DEC2,
+        "ws_ext_discount_amt": DEC2, "ws_ext_sales_price": DEC2,
+        "ws_ext_wholesale_cost": DEC2, "ws_ext_ship_cost": DEC2,
+        "ws_net_paid": DEC2, "ws_net_profit": DEC2,
+    },
+    "catalog_returns": {
+        "cr_returned_date_sk": T.BIGINT, "cr_item_sk": T.BIGINT,
+        "cr_order_number": T.BIGINT,
+        "cr_returning_customer_sk": T.BIGINT,
+        "cr_return_quantity": T.BIGINT, "cr_return_amount": DEC2,
+        "cr_refunded_cash": DEC2, "cr_net_loss": DEC2,
+    },
+    "web_returns": {
+        "wr_returned_date_sk": T.BIGINT, "wr_item_sk": T.BIGINT,
+        "wr_order_number": T.BIGINT,
+        "wr_returning_customer_sk": T.BIGINT,
+        "wr_return_quantity": T.BIGINT, "wr_return_amt": DEC2,
+        "wr_refunded_cash": DEC2, "wr_net_loss": DEC2,
+    },
+    "web_site": {
+        "web_site_sk": T.BIGINT, "web_site_id": T.VARCHAR,
+        "web_name": T.VARCHAR, "web_company_name": T.VARCHAR,
+    },
+    "web_page": {
+        "wp_web_page_sk": T.BIGINT, "wp_web_page_id": T.VARCHAR,
+        "wp_char_count": T.BIGINT,
+    },
+    "time_dim": {
+        "t_time_sk": T.BIGINT, "t_time_id": T.VARCHAR,
+        "t_time": T.BIGINT, "t_hour": T.BIGINT,
+        "t_minute": T.BIGINT, "t_second": T.BIGINT,
+        "t_meal_time": T.VARCHAR,
+    },
+    "ship_mode": {
+        "sm_ship_mode_sk": T.BIGINT, "sm_ship_mode_id": T.VARCHAR,
+        "sm_type": T.VARCHAR, "sm_carrier": T.VARCHAR,
+        "sm_code": T.VARCHAR,
     },
     "store_returns": {
         "sr_returned_date_sk": T.BIGINT, "sr_item_sk": T.BIGINT,
@@ -131,7 +176,10 @@ _BASE_ROWS = {
     "store": 12, "warehouse": 5, "promotion": 300,
     "store_sales": 2_880_000, "catalog_sales": 1_440_000,
     "web_sales": 720_000, "store_returns": 288_000,
+    "catalog_returns": 144_000, "web_returns": 72_000,
     "inventory": 783_000,
+    "web_site": 30, "web_page": 60, "time_dim": 86_400,
+    "ship_mode": 20,
 }
 
 _UNIQUE = {
@@ -142,6 +190,8 @@ _UNIQUE = {
     "household_demographics": [("hd_demo_sk",)],
     "store": [("s_store_sk",)], "warehouse": [("w_warehouse_sk",)],
     "promotion": [("p_promo_sk",)],
+    "web_site": [("web_site_sk",)], "web_page": [("wp_web_page_sk",)],
+    "time_dim": [("t_time_sk",)], "ship_mode": [("sm_ship_mode_sk",)],
 }
 
 _CATEGORIES = ["Home", "Books", "Electronics", "Shoes", "Women", "Men",
@@ -162,14 +212,21 @@ _LAST = ["Smith", "Johnson", "Brown", "Jones", "Miller", "Davis",
 class TpcdsGenerator:
     START = _D("1998-01-01")
 
-    def __init__(self, scale: float, seed: int = 20030527):
+    def __init__(self, scale: float, seed: int = 20030527,
+                 sales_provider=None):
         self.scale = scale
         self.seed = seed
+        # Returns tables sample real (order_number, item_sk, ...) rows
+        # from their sales table so the join keys hit. The connector
+        # wires this to its Table cache so the numeric sales arrays are
+        # held once; standalone generators fall back to regeneration.
+        self._sales = sales_provider or self.generate
 
     def rows(self, name: str) -> int:
         base = _BASE_ROWS[name]
         if name in ("date_dim", "store", "warehouse", "promotion",
-                    "customer_demographics", "household_demographics"):
+                    "customer_demographics", "household_demographics",
+                    "web_site", "web_page", "time_dim", "ship_mode"):
             return base
         return max(10, int(base * self.scale))
 
@@ -196,6 +253,7 @@ class TpcdsGenerator:
             "d_date": dates.astype(np.int32),
             "d_year": years, "d_moy": months, "d_dom": dom,
             "d_qoy": (months - 1) // 3 + 1,
+            "d_dow": dow,
             "d_day_name": np.array(_DAYNAMES, object)[dow],
             "d_month_seq": (years - 1998) * 12 + months - 1,
             "d_week_seq": (dates - self.START) // 7,
@@ -390,6 +448,8 @@ class TpcdsGenerator:
         net_paid = ext_sales - coupon
         return {
             "ss_sold_date_sk": date_sk,
+            "ss_sold_time_sk": rng.integers(
+                1, self.rows("time_dim") + 1, n),
             "ss_item_sk": item_sk,
             "ss_customer_sk": rng.integers(
                 1, self.rows("customer") + 1, n),
@@ -422,7 +482,10 @@ class TpcdsGenerator:
         date_sk, item_sk, qty, wholesale, lp, sp = self._sales_common(
             n, rng, n_dates)
         ext_sales = sp * qty
-        net_paid = ext_sales
+        ext_list = lp * qty
+        coupon = np.where(rng.integers(0, 10, n) == 0,
+                          ext_sales // 10, 0)
+        net_paid = ext_sales - coupon
         return {
             "cs_sold_date_sk": date_sk,
             "cs_item_sk": item_sk,
@@ -430,17 +493,32 @@ class TpcdsGenerator:
                 1, self.rows("customer") + 1, n),
             "cs_ship_customer_sk": rng.integers(
                 1, self.rows("customer") + 1, n),
+            "cs_bill_cdemo_sk": rng.integers(
+                1, self.rows("customer_demographics") + 1, n),
+            "cs_bill_hdemo_sk": rng.integers(
+                1, self.rows("household_demographics") + 1, n),
             "cs_ship_date_sk": np.minimum(
                 date_sk + rng.integers(1, 30, n), n_dates),
             "cs_warehouse_sk": rng.integers(
                 1, self.rows("warehouse") + 1, n),
-            "cs_promo_sk": rng.integers(1, self.rows("promotion") + 1, n),
+            "cs_ship_mode_sk": rng.integers(
+                1, self.rows("ship_mode") + 1, n),
+            "cs_call_center_sk": rng.integers(1, 7, n),
+            # ~half the promo keys miss the promotion table so LEFT
+            # JOIN promotion (Q72) produces real NULL p_promo_sk rows
+            "cs_promo_sk": rng.integers(
+                1, 2 * self.rows("promotion") + 1, n),
             "cs_order_number": np.arange(1, n + 1) // 3 + 1,
             "cs_quantity": qty,
             "cs_wholesale_cost": wholesale,
             "cs_list_price": lp,
             "cs_sales_price": sp,
+            "cs_ext_discount_amt": ext_list - ext_sales,
             "cs_ext_sales_price": ext_sales,
+            "cs_ext_wholesale_cost": wholesale * qty,
+            "cs_ext_list_price": ext_list,
+            "cs_ext_ship_cost": (ext_sales * rng.integers(2, 10, n)) // 100,
+            "cs_coupon_amt": coupon,
             "cs_net_paid": net_paid,
             "cs_net_profit": net_paid - wholesale * qty,
         }
@@ -452,40 +530,167 @@ class TpcdsGenerator:
         date_sk, item_sk, qty, wholesale, lp, sp = self._sales_common(
             n, rng, n_dates)
         ext_sales = sp * qty
+        ext_list = lp * qty
         return {
             "ws_sold_date_sk": date_sk,
+            "ws_sold_time_sk": rng.integers(
+                1, self.rows("time_dim") + 1, n),
             "ws_item_sk": item_sk,
             "ws_bill_customer_sk": rng.integers(
                 1, self.rows("customer") + 1, n),
             "ws_ship_customer_sk": rng.integers(
                 1, self.rows("customer") + 1, n),
+            "ws_ship_hdemo_sk": rng.integers(
+                1, self.rows("household_demographics") + 1, n),
+            "ws_ship_addr_sk": rng.integers(
+                1, self.rows("customer_address") + 1, n),
             "ws_ship_date_sk": np.minimum(
                 date_sk + rng.integers(1, 30, n), n_dates),
             "ws_warehouse_sk": rng.integers(
                 1, self.rows("warehouse") + 1, n),
+            "ws_web_site_sk": rng.integers(
+                1, self.rows("web_site") + 1, n),
+            "ws_web_page_sk": rng.integers(
+                1, self.rows("web_page") + 1, n),
+            "ws_ship_mode_sk": rng.integers(
+                1, self.rows("ship_mode") + 1, n),
             "ws_promo_sk": rng.integers(1, self.rows("promotion") + 1, n),
             "ws_order_number": np.arange(1, n + 1) // 3 + 1,
             "ws_quantity": qty,
+            "ws_list_price": lp,
             "ws_sales_price": sp,
+            "ws_ext_discount_amt": ext_list - ext_sales,
             "ws_ext_sales_price": ext_sales,
+            "ws_ext_wholesale_cost": wholesale * qty,
+            "ws_ext_ship_cost": (ext_sales * rng.integers(2, 10, n)) // 100,
             "ws_net_paid": ext_sales,
             "ws_net_profit": ext_sales - wholesale * qty,
         }
 
     def _g_store_returns(self):
+        """Samples real store_sales rows so the
+        (sr_customer_sk, sr_item_sk, sr_ticket_number) triple joins back
+        to its sale (Q25/Q29 shapes need matching return lines)."""
         n = self.rows("store_returns")
         rng = self._rng(10)
+        ss = self._sales("store_sales")
+        idx = rng.integers(0, len(ss["ss_ticket_number"]), n)
         return {
-            "sr_returned_date_sk": rng.integers(
-                1, self.rows("date_dim") + 1, n),
-            "sr_item_sk": rng.integers(1, self.rows("item") + 1, n),
-            "sr_customer_sk": rng.integers(
-                1, self.rows("customer") + 1, n),
-            "sr_ticket_number": rng.integers(
-                1, self.rows("store_sales") // 4 + 2, n),
-            "sr_return_quantity": rng.integers(1, 20, n),
+            "sr_returned_date_sk": np.minimum(
+                ss["ss_sold_date_sk"][idx] + rng.integers(1, 60, n),
+                self.rows("date_dim")),
+            "sr_item_sk": ss["ss_item_sk"][idx],
+            "sr_customer_sk": ss["ss_customer_sk"][idx],
+            "sr_ticket_number": ss["ss_ticket_number"][idx],
+            "sr_return_quantity": np.minimum(
+                rng.integers(1, 20, n), ss["ss_quantity"][idx]),
             "sr_return_amt": rng.integers(100, 50000, n),
             "sr_net_loss": rng.integers(50, 20000, n),
+        }
+
+    def _g_catalog_returns(self):
+        """Returns sample real catalog_sales rows so the
+        (cr_order_number, cr_item_sk) pairs join back (reference dsdgen
+        emits returns for a fraction of sales lines)."""
+        n = self.rows("catalog_returns")
+        rng = self._rng(12)
+        cs = self._sales("catalog_sales")
+        idx = rng.integers(0, len(cs["cs_order_number"]), n)
+        qty = np.minimum(rng.integers(1, 20, n), cs["cs_quantity"][idx])
+        amt = cs["cs_sales_price"][idx] * qty
+        return {
+            "cr_returned_date_sk": np.minimum(
+                cs["cs_ship_date_sk"][idx] + rng.integers(1, 60, n),
+                self.rows("date_dim")),
+            "cr_item_sk": cs["cs_item_sk"][idx],
+            "cr_order_number": cs["cs_order_number"][idx],
+            "cr_returning_customer_sk": cs["cs_bill_customer_sk"][idx],
+            "cr_return_quantity": qty,
+            "cr_return_amount": amt,
+            "cr_refunded_cash": (amt * rng.integers(50, 100, n)) // 100,
+            "cr_net_loss": rng.integers(50, 20000, n),
+        }
+
+    def _g_web_returns(self):
+        n = self.rows("web_returns")
+        rng = self._rng(13)
+        ws = self._sales("web_sales")
+        idx = rng.integers(0, len(ws["ws_order_number"]), n)
+        qty = np.minimum(rng.integers(1, 20, n), ws["ws_quantity"][idx])
+        amt = ws["ws_sales_price"][idx] * qty
+        return {
+            "wr_returned_date_sk": np.minimum(
+                ws["ws_ship_date_sk"][idx] + rng.integers(1, 60, n),
+                self.rows("date_dim")),
+            "wr_item_sk": ws["ws_item_sk"][idx],
+            "wr_order_number": ws["ws_order_number"][idx],
+            "wr_returning_customer_sk": ws["ws_bill_customer_sk"][idx],
+            "wr_return_quantity": qty,
+            "wr_return_amt": amt,
+            "wr_refunded_cash": (amt * rng.integers(50, 100, n)) // 100,
+            "wr_net_loss": rng.integers(50, 20000, n),
+        }
+
+    def _g_web_site(self):
+        n = self.rows("web_site")
+        sk = np.arange(1, n + 1)
+        names = ["pri", "able", "ought", "ese", "anti", "cally"]
+        return {
+            "web_site_sk": sk,
+            "web_site_id": np.array(
+                [f"AAAAAAAA{sk_:08d}" for sk_ in sk], object),
+            "web_name": np.array(
+                [f"site_{sk_ % 8}" for sk_ in sk], object),
+            "web_company_name": np.array(names, object)[sk % len(names)],
+        }
+
+    def _g_web_page(self):
+        n = self.rows("web_page")
+        rng = self._rng(14)
+        sk = np.arange(1, n + 1)
+        return {
+            "wp_web_page_sk": sk,
+            "wp_web_page_id": np.array(
+                [f"AAAAAAAA{sk_:08d}" for sk_ in sk], object),
+            "wp_char_count": rng.integers(100, 8000, n),
+        }
+
+    def _g_time_dim(self):
+        n = self.rows("time_dim")
+        sk = np.arange(1, n + 1)
+        sec = np.arange(n)
+        hour = sec // 3600
+        meal = np.full(n, "", object)
+        meal[(hour >= 6) & (hour < 9)] = "breakfast"
+        meal[(hour >= 11) & (hour < 14)] = "lunch"
+        meal[(hour >= 17) & (hour < 20)] = "dinner"
+        return {
+            "t_time_sk": sk,
+            "t_time_id": np.array(
+                [f"AAAAAAAA{sk_:08d}" for sk_ in sk], object),
+            "t_time": sec, "t_hour": hour,
+            "t_minute": (sec // 60) % 60, "t_second": sec % 60,
+            "t_meal_time": meal,
+        }
+
+    def _g_ship_mode(self):
+        n = self.rows("ship_mode")
+        sk = np.arange(1, n + 1)
+        types = ["EXPRESS", "NEXT DAY", "OVERNIGHT", "REGULAR", "LIBRARY"]
+        carriers = ["UPS", "FEDEX", "AIRBORNE", "USPS", "DHL",
+                    "TBS", "ZHOU", "LATVIAN", "DIAMOND", "ORIENTAL",
+                    "BARIAN", "BOXBUNDLES", "ALLIANCE", "GREAT EASTERN",
+                    "HARMSTORF", "PRIVATECARRIER", "GERMA", "MSC",
+                    "RUPEKSA", "GUARANTEED"]
+        return {
+            "sm_ship_mode_sk": sk,
+            "sm_ship_mode_id": np.array(
+                [f"AAAAAAAA{sk_:08d}" for sk_ in sk], object),
+            "sm_type": np.array(types, object)[(sk - 1) % len(types)],
+            "sm_carrier": np.array(carriers, object)[
+                (sk - 1) % len(carriers)],
+            "sm_code": np.array(["AIR", "SURFACE", "SEA"], object)[
+                (sk - 1) % 3],
         }
 
     def _g_inventory(self):
@@ -507,8 +712,17 @@ class TpcdsConnector(Connector):
 
     def __init__(self, scale: float = 0.001, seed: int = 20030527):
         self.scale = scale
-        self.gen = TpcdsGenerator(scale, seed)
+        self.gen = TpcdsGenerator(scale, seed,
+                                  sales_provider=self._sales_arrays)
         self._tables: dict[str, Table] = {}
+
+    def _sales_arrays(self, name: str) -> dict[str, np.ndarray]:
+        """Numeric sales arrays for the returns generators, served from
+        the Table cache so the big sales tables are resident once (the
+        returns samplers only touch numeric columns, which Tables store
+        unchanged)."""
+        t = self.table(name)
+        return {c: np.asarray(col.data) for c, col in t.columns.items()}
 
     def table_names(self) -> list[str]:
         return list(SCHEMAS)

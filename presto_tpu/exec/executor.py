@@ -266,6 +266,13 @@ class PlanInterpreter:
         self._note_ok(node, ok)
         return out
 
+    def _r_markdistinct(self, node: N.MarkDistinct) -> DTable:
+        src = self.run(node.source)
+        cap = self._capacity(node, next_pow2(min(2 * src.n, 1 << 22)))
+        out, ok = OP.apply_mark_distinct(src, node, cap)
+        self._note_ok(node, ok)
+        return out
+
     def _r_exchange(self, node: N.Exchange) -> DTable:
         # single-device execution: exchanges are no-ops (the sharded
         # executor in parallel/ lowers them to collectives)

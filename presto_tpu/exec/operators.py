@@ -177,6 +177,11 @@ def apply_aggregate(dt: DTable, node: N.Aggregate, capacity: int) -> tuple:
                 weight = live
                 data = jnp.ones((dt.n,), dtype=jnp.int64)
                 arg_type = None
+            if call.mask is not None:
+                mv = dt.cols[call.mask]
+                weight = weight & mv.data
+                if mv.valid is not None:
+                    weight = weight & mv.valid
             states = A.fold(call.fn, data, weight, safe_slots, capacity)
 
         if node.step == N.AggStep.PARTIAL:
@@ -797,6 +802,24 @@ def _segmented_scan(vals, restart, op):
 
     out, _ = jax.lax.associative_scan(combine, (vals, restart))
     return out
+
+
+def apply_mark_distinct(dt: DTable, node: N.MarkDistinct,
+                        capacity: int) -> tuple:
+    """Adds node.mark_symbol: true on the first live row of each
+    distinct key tuple (reference MarkDistinctOperator.java; here one
+    hash-slot assignment + a segment-min race for the first row)."""
+    live = dt.live_mask()
+    rh = _row_hash(dt, node.keys)
+    slots, table, ok = H.group_by_slots(rh, live, capacity)
+    idx = jnp.arange(dt.n, dtype=jnp.int32)
+    big = jnp.asarray(dt.n, jnp.int32)
+    firsts = jax.ops.segment_min(jnp.where(live, idx, big), slots,
+                                 num_segments=capacity)
+    mark = live & (firsts[slots] == idx)
+    cols = dict(dt.cols)
+    cols[node.mark_symbol] = Val(T.BOOLEAN, mark, None, None)
+    return DTable(cols, dt.live, dt.n), ok
 
 
 def apply_distinct(dt: DTable, capacity: int) -> tuple:

@@ -32,11 +32,15 @@ class AggCall:
     arg: ir.Expr | None
     dtype: T.DataType
     distinct: bool = False
+    # boolean column restricting which rows this call folds (reference
+    # Aggregation.mask, fed by MarkDistinct for DISTINCT aggregates)
+    mask: str | None = None
 
     def __str__(self) -> str:
         inner = "*" if self.arg is None else str(self.arg)
         d = "distinct " if self.distinct else ""
-        return f"{self.fn}({d}{inner})"
+        m = f" mask {self.mask}" if self.mask else ""
+        return f"{self.fn}({d}{inner}){m}"
 
 
 def output_type(fn: str, arg_type: T.DataType | None) -> T.DataType:

@@ -368,6 +368,23 @@ class ShardedInterpreter:
         self._note_ok(node, ok)
         return DistTable(out, REPLICATED)
 
+    def _r_markdistinct(self, node: N.MarkDistinct) -> DistTable:
+        src = self.run(node.source)
+        if src.dist == SHARDED:
+            # global mark correctness needs co-located key tuples:
+            # FIXED_HASH repartition by the distinct keys first
+            ex = self._repart(src.dt, node.keys, node, "mark_exch")
+            cap = self._capacity(
+                node, next_pow2(min(2 * ex.n, 1 << 22)))
+            out, ok = OP.apply_mark_distinct(ex, node, cap)
+            self._note_ok(node, ok)
+            return DistTable(out, SHARDED)
+        cap = self._capacity(
+            node, next_pow2(min(2 * src.dt.n, 1 << 22)))
+        out, ok = OP.apply_mark_distinct(src.dt, node, cap)
+        self._note_ok(node, ok)
+        return DistTable(out, REPLICATED)
+
     def _r_window(self, node: N.Window) -> DistTable:
         # window partitions would repartition cleanly by partition key
         # (all_to_all); v1 gathers — windows sit above heavy reductions

@@ -342,6 +342,31 @@ class Distinct(PlanNode):
         return self.source.output_types()
 
 
+@dataclasses.dataclass
+class MarkDistinct(PlanNode):
+    """Adds a boolean column that is true on exactly one row per
+    distinct key tuple — lets DISTINCT aggregates share one Aggregate
+    with plain ones via per-call masks (reference MarkDistinctNode /
+    operator/MarkDistinctOperator.java)."""
+
+    source: PlanNode = None  # type: ignore[assignment]
+    keys: list[str] = dataclasses.field(default_factory=list)
+    mark_symbol: str = ""
+    capacity: int | None = None
+
+    def sources(self):
+        return [self.source]
+
+    @property
+    def output_symbols(self):
+        return list(self.source.output_symbols) + [self.mark_symbol]
+
+    def output_types(self):
+        from presto_tpu import types as T
+        return {**self.source.output_types(),
+                self.mark_symbol: T.BOOLEAN}
+
+
 @dataclasses.dataclass(frozen=True)
 class WindowCall:
     """One planned window function: fn over (args) with the node's

@@ -81,6 +81,7 @@ def prune_columns(node: N.PlanNode,
                 if node.step == N.AggStep.PARTIAL or s in needed}
         child = set(node.group_keys) | _expr_refs(
             *[c.arg for c in aggs.values() if c.arg is not None])
+        child |= {c.mask for c in aggs.values() if c.mask is not None}
         if node.step == N.AggStep.FINAL:
             from presto_tpu.expr import aggregates as AGG
             for s, c in aggs.items():
@@ -137,6 +138,11 @@ def prune_columns(node: N.PlanNode,
                             set(node.source.output_types()))
         return dataclasses.replace(node, source=src)
 
+    if isinstance(node, N.MarkDistinct):
+        src = prune_columns(
+            node.source, (needed - {node.mark_symbol}) | set(node.keys))
+        return dataclasses.replace(node, source=src)
+
     if isinstance(node, N.Union):
         keep = [s for s in node.symbols if s in needed] or node.symbols[:1]
         inputs = []
@@ -166,7 +172,7 @@ def inline_trivial_projects(node: N.PlanNode) -> N.PlanNode:
             rebuilt = dataclasses.replace(node, source=new_kids[0])
         elif isinstance(node, (N.Filter, N.Project, N.Aggregate, N.Sort,
                                N.TopN, N.Limit, N.Distinct, N.Exchange,
-                               N.Window)):
+                               N.Window, N.MarkDistinct)):
             rebuilt = dataclasses.replace(node, source=new_kids[0])
         elif isinstance(node, (N.Join, N.CrossJoin)):
             rebuilt = dataclasses.replace(node, left=new_kids[0],

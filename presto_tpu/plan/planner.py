@@ -854,8 +854,8 @@ class LogicalPlanner:
                         outer: Scope | None) -> RelationPlan:
         if rel.join_type == "full":
             raise SemanticError("FULL OUTER JOIN not supported yet")
-        left = self.plan_relation(rel.left, ctes, outer)
-        right = self.plan_relation(rel.right, ctes, outer)
+        left = self._plan_join_operand(rel.left, ctes, outer)
+        right = self._plan_join_operand(rel.right, ctes, outer)
         # RIGHT join: probe the right side, build the left; the declared
         # field order (left columns first) is preserved either way
         if rel.join_type == "right":
@@ -919,6 +919,21 @@ class LogicalPlanner:
                       else _next_pow2(2 * (probe.est + build.est)))
         est = probe.est if build_unique else probe.est + build.est
         return RelationPlan(node, combined, est, probe.unique)
+
+    def _plan_join_operand(self, rel: A.Relation, ctes, outer
+                           ) -> RelationPlan:
+        """Plan one side of an outer join. An inner-join tree operand
+        (`a join b on ... left join c on ...` is left-associative, so
+        the left operand is the whole preceding chain) must keep its
+        table qualifiers visible — going through _plan_inner_join_tree's
+        SELECT * wrapper would erase them, breaking later references
+        like d1.d_week_seq (TPC-DS Q72)."""
+        if isinstance(rel, A.JoinRelation) and rel.join_type in (
+                "implicit", "cross", "inner") and not rel.using:
+            spec = A.QuerySpec((A.SelectItem(A.Star()),), False, rel)
+            qs = self._plan_from_where(spec, ctes, outer, False)
+            return RelationPlan(qs.node, qs.scope, qs.est, qs.unique)
+        return self.plan_relation(rel, ctes, outer)
 
     def _plan_inner_join_tree(self, rel: A.JoinRelation, ctes, outer):
         spec = A.QuerySpec((A.SelectItem(A.Star()),), False, rel)
@@ -1379,11 +1394,35 @@ class LogicalPlanner:
                                      aggs)
             return ExprCtx(qs.scope, self, outer, agg_syms=agg_syms)
 
-        if distinct_calls:
-            if len(agg_calls) != len(distinct_calls) or len(
-                    distinct_calls) > 1:
-                raise SemanticError(
-                    "mixing DISTINCT and plain aggregates unsupported")
+        if distinct_calls and (len(agg_calls) != len(distinct_calls)
+                               or len(distinct_calls) > 1):
+            # Mixed or multiple DISTINCT aggregates: mark the first row
+            # of every (group keys, argument) tuple and fold the
+            # DISTINCT calls under that mask, sharing one Aggregate with
+            # the plain calls (reference MarkDistinctNode planning in
+            # sql/planner/QueryPlanner + MarkDistinctOperator.java).
+            mark_for_arg: dict[str, str] = {}
+            for call in agg_calls:
+                if not call.distinct:
+                    continue
+                sym, out_t = agg_syms[call]
+                acall = aggs[sym]
+                arg_sym = qs.add_projection(acall.arg, "distinct_arg",
+                                            self)
+                if arg_sym not in mark_for_arg:
+                    mark = self.symbols.fresh("mark")
+                    qs.node = N.MarkDistinct(
+                        qs.node, list(group_syms) + [arg_sym], mark,
+                        _next_pow2(2 * min(qs.est, 1 << 22)))
+                    mark_for_arg[arg_sym] = mark
+                aggs[sym] = AggCall(
+                    acall.fn,
+                    ir.ColumnRef(acall.arg.dtype, arg_sym), out_t,
+                    False, mask=mark_for_arg[arg_sym])
+            agg_node = N.Aggregate(
+                qs.node, group_syms, aggs, N.AggStep.SINGLE,
+                capacity=self._group_capacity(qs.est, group_syms))
+        elif distinct_calls:
             call = distinct_calls[0]
             sym, out_t = agg_syms[call]
             acall = aggs[sym]

@@ -64,6 +64,9 @@ def _describe(node: N.PlanNode) -> str:
         return f"Limit[{node.count}{off}]"
     if isinstance(node, N.Distinct):
         return f"Distinct[cap={node.capacity}]"
+    if isinstance(node, N.MarkDistinct):
+        return (f"MarkDistinct[{node.mark_symbol} := "
+                f"first({', '.join(node.keys)})]")
     if isinstance(node, N.Union):
         return f"Union[{len(node.inputs)} inputs] => {node.symbols}"
     if isinstance(node, N.Exchange):

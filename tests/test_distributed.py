@@ -99,3 +99,23 @@ def test_distributed_topn_partial_final(engine, oracle, mesh):
     want = oracle.query(to_sqlite(parse_statement(sql)))
     ok, msg = rows_equal(got, want, ordered=True)
     assert ok, msg
+
+
+def test_distributed_mixed_distinct_aggregates(engine, oracle, mesh):
+    """Mixed DISTINCT + plain aggregates run through MarkDistinct with
+    a FIXED_HASH repartition by the distinct keys, so marks are
+    globally unique across shards."""
+    from presto_tpu.sql.parser import parse_statement
+    from presto_tpu.sql.sqlite_dialect import to_sqlite
+
+    sql = ("select l_returnflag, count(distinct l_suppkey) as ds, "
+           "sum(l_quantity) as sq, count(distinct l_partkey) as dp, "
+           "count(*) as c from lineitem group by l_returnflag "
+           "order by l_returnflag")
+    got = engine.execute(sql, mesh=mesh)
+    want = oracle.query(to_sqlite(parse_statement(sql)))
+    ok, msg = rows_equal(got, want, ordered=True)
+    assert ok, msg
+    got1 = engine.execute(sql)
+    ok, msg = rows_equal(got1, want, ordered=True)
+    assert ok, msg

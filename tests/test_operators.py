@@ -237,12 +237,19 @@ def test_merge_runs_nan_keys_stay_permutation():
         data = np.array([1.0, 2.0, 3.0, np.nan, 0.5, 1.5, 2.5, 3.5])
         dt = DTable({"x": Val(T.DOUBLE, jnp.asarray(data), None, None)},
                     None, 8)
-        keys = _sort_keys(dt, [N.Ordering("x", asc, None)])
-        k1 = np.array(keys[1])
+        # keys = [live_cls, nan_cls, data]; merge over ALL levels so the
+        # float data level (the one that would carry NaN) is exercised
+        keys = [np.array(k) for k in _sort_keys(
+            dt, [N.Ordering("x", asc, None)])]
+        assert not any(np.isnan(k).any() for k in keys
+                       if np.issubdtype(k.dtype, np.floating))
         for j in range(2):
             sl = slice(j * 4, (j + 1) * 4)
-            k1[sl] = np.sort(k1[sl])
+            order = np.lexsort(tuple(k[sl] for k in reversed(keys)))
+            for k in keys:
+                k[sl] = k[sl][order]
         perm = np.asarray(merge_runs_perm(
-            [keys[0], jnp.asarray(k1)], 2, 4))
+            [jnp.asarray(k) for k in keys], 2, 4))
         assert sorted(perm.tolist()) == list(range(8))
-        assert (k1[perm] == np.sort(k1)).all()
+        merged = [tuple(k[p] for k in keys) for p in perm]
+        assert merged == sorted(zip(*keys))

@@ -92,3 +92,15 @@ def test_ctas_preserves_nulls(eng):
     got = eng.execute(
         "select count(*) from memory.nullable where k is null")
     assert got == [(11,)]
+
+
+def test_multiple_computed_distinct_aggregates(eng, oracle):
+    """Two DISTINCT aggregates over computed args chain two MarkDistinct
+    nodes; column pruning must keep the earlier mark column alive
+    (regression: prune_columns dropped AggCall.mask symbols)."""
+    from presto_tpu.testing.oracle import assert_query
+    assert_query(eng, oracle,
+                 "select l_returnflag, count(distinct l_suppkey + 1), "
+                 "count(distinct l_partkey + 1), count(*), "
+                 "sum(l_quantity) from lineitem group by l_returnflag "
+                 "order by l_returnflag")

@@ -81,6 +81,466 @@ QUERIES = {
           and ws_bill_customer_sk in (
             select c_customer_sk from customer where c_birth_year < 1960)
         """,
+
+    "q06": """
+        select a.ca_state as state, count(*) as cnt
+        from customer_address a, customer c, store_sales s,
+             date_dim d, item i
+        where a.ca_address_sk = c.c_current_addr_sk
+          and c.c_customer_sk = s.ss_customer_sk
+          and s.ss_sold_date_sk = d.d_date_sk
+          and s.ss_item_sk = i.i_item_sk
+          and d.d_month_seq = (select distinct d_month_seq from date_dim
+                               where d_year = 2001 and d_moy = 1)
+          and i.i_current_price > 1.2 * (select avg(j.i_current_price)
+                                         from item j
+                                         where j.i_category = i.i_category)
+        group by a.ca_state
+        having count(*) >= 3
+        order by cnt, state limit 100""",
+    "q12": """
+        select i_item_id, i_item_desc, i_category, i_class,
+               i_current_price,
+               sum(ws_ext_sales_price) as itemrevenue,
+               sum(ws_ext_sales_price) * 100.0 /
+                 sum(sum(ws_ext_sales_price))
+                   over (partition by i_class) as revenueratio
+        from web_sales, item, date_dim
+        where ws_item_sk = i_item_sk
+          and i_category in ('Sports', 'Books', 'Home')
+          and ws_sold_date_sk = d_date_sk
+          and d_date between date '1999-02-22' and date '1999-03-24'
+        group by i_item_id, i_item_desc, i_category, i_class,
+                 i_current_price
+        order by i_category, i_class, i_item_id, i_item_desc,
+                 revenueratio limit 100""",
+    "q13": """
+        select avg(ss_quantity) as a1, avg(ss_ext_sales_price) as a2,
+               avg(ss_ext_wholesale_cost) as a3,
+               sum(ss_ext_wholesale_cost) as s1
+        from store_sales, store, customer_demographics,
+             household_demographics, customer_address, date_dim
+        where s_store_sk = ss_store_sk
+          and ss_sold_date_sk = d_date_sk and d_year = 2001
+          and ss_hdemo_sk = hd_demo_sk and cd_demo_sk = ss_cdemo_sk
+          and ss_addr_sk = ca_address_sk
+          and ca_country = 'United States'
+          and ((cd_marital_status = 'M'
+                and cd_education_status = 'Advanced Degree'
+                and ss_sales_price between 100.00 and 150.00
+                and hd_dep_count = 3)
+            or (cd_marital_status = 'S'
+                and cd_education_status = 'College'
+                and ss_sales_price between 50.00 and 100.00
+                and hd_dep_count = 1)
+            or (cd_marital_status = 'W'
+                and cd_education_status = '2 yr Degree'
+                and ss_sales_price between 150.00 and 200.00
+                and hd_dep_count = 1))
+          and ((ca_state in ('TX', 'OH', 'TN')
+                and ss_net_profit between 100 and 200)
+            or (ca_state in ('OR', 'NM', 'KY')
+                and ss_net_profit between 150 and 300)
+            or (ca_state in ('VA', 'TX', 'MS')
+                and ss_net_profit between 50 and 250))""",
+    "q15": """
+        select ca_zip, sum(cs_sales_price) as total
+        from catalog_sales, customer, customer_address, date_dim
+        where cs_bill_customer_sk = c_customer_sk
+          and c_current_addr_sk = ca_address_sk
+          and (substr(ca_zip, 1, 5) in ('85669', '86197', '88274',
+                 '83405', '86475', '85392', '85460', '80348', '81792')
+               or ca_state in ('CA', 'WA', 'GA')
+               or cs_sales_price > 500)
+          and cs_sold_date_sk = d_date_sk
+          and d_qoy = 2 and d_year = 2001
+        group by ca_zip
+        order by ca_zip limit 100""",
+    "q20": """
+        select i_item_id, i_item_desc, i_category, i_class,
+               i_current_price,
+               sum(cs_ext_sales_price) as itemrevenue,
+               sum(cs_ext_sales_price) * 100.0 /
+                 sum(sum(cs_ext_sales_price))
+                   over (partition by i_class) as revenueratio
+        from catalog_sales, item, date_dim
+        where cs_item_sk = i_item_sk
+          and i_category in ('Sports', 'Books', 'Home')
+          and cs_sold_date_sk = d_date_sk
+          and d_date between date '1999-02-22' and date '1999-03-24'
+        group by i_item_id, i_item_desc, i_category, i_class,
+                 i_current_price
+        order by i_category, i_class, i_item_id, i_item_desc,
+                 revenueratio limit 100""",
+    "q25": """
+        select i_item_id, i_item_desc, s_store_id, s_store_name,
+               sum(ss_net_profit) as store_sales_profit,
+               sum(sr_net_loss) as store_returns_loss,
+               sum(cs_net_profit) as catalog_sales_profit
+        from store_sales, store_returns, catalog_sales,
+             date_dim d1, date_dim d2, date_dim d3, store, item
+        where d1.d_moy = 4 and d1.d_year = 2000
+          and d1.d_date_sk = ss_sold_date_sk
+          and i_item_sk = ss_item_sk and s_store_sk = ss_store_sk
+          and ss_customer_sk = sr_customer_sk
+          and ss_item_sk = sr_item_sk
+          and ss_ticket_number = sr_ticket_number
+          and sr_returned_date_sk = d2.d_date_sk
+          and d2.d_moy between 4 and 10 and d2.d_year = 2000
+          and sr_customer_sk = cs_bill_customer_sk
+          and sr_item_sk = cs_item_sk
+          and cs_sold_date_sk = d3.d_date_sk
+          and d3.d_moy between 4 and 10 and d3.d_year = 2000
+        group by i_item_id, i_item_desc, s_store_id, s_store_name
+        order by i_item_id, i_item_desc, s_store_id, s_store_name
+        limit 100""",
+    "q26": """
+        select i_item_id, avg(cs_quantity) as agg1,
+               avg(cs_list_price) as agg2, avg(cs_coupon_amt) as agg3,
+               avg(cs_sales_price) as agg4
+        from catalog_sales, customer_demographics, date_dim, item,
+             promotion
+        where cs_sold_date_sk = d_date_sk and cs_item_sk = i_item_sk
+          and cs_bill_cdemo_sk = cd_demo_sk and cs_promo_sk = p_promo_sk
+          and cd_gender = 'M' and cd_marital_status = 'S'
+          and cd_education_status = 'College'
+          and (p_channel_email = 'N' or p_channel_tv = 'N')
+          and d_year = 2000
+        group by i_item_id order by i_item_id limit 100""",
+    "q29": """
+        select i_item_id, i_item_desc, s_store_id, s_store_name,
+               sum(ss_quantity) as store_sales_quantity,
+               sum(sr_return_quantity) as store_returns_quantity,
+               sum(cs_quantity) as catalog_sales_quantity
+        from store_sales, store_returns, catalog_sales,
+             date_dim d1, date_dim d2, date_dim d3, store, item
+        where d1.d_moy = 4 and d1.d_year = 1999
+          and d1.d_date_sk = ss_sold_date_sk
+          and i_item_sk = ss_item_sk and s_store_sk = ss_store_sk
+          and ss_customer_sk = sr_customer_sk
+          and ss_item_sk = sr_item_sk
+          and ss_ticket_number = sr_ticket_number
+          and sr_returned_date_sk = d2.d_date_sk
+          and d2.d_moy between 4 and 7 and d2.d_year = 1999
+          and sr_customer_sk = cs_bill_customer_sk
+          and sr_item_sk = cs_item_sk
+          and cs_sold_date_sk = d3.d_date_sk
+          and d3.d_year in (1999, 2000, 2001)
+        group by i_item_id, i_item_desc, s_store_id, s_store_name
+        order by i_item_id, i_item_desc, s_store_id, s_store_name
+        limit 100""",
+    "q32": """
+        select sum(cs_ext_discount_amt) as excess_discount_amount
+        from catalog_sales, item, date_dim
+        where i_manufact_id = 66
+          and i_item_sk = cs_item_sk
+          and d_date between date '2000-01-27' and date '2000-04-26'
+          and d_date_sk = cs_sold_date_sk
+          and cs_ext_discount_amt > (
+            select 1.3 * avg(cs_ext_discount_amt)
+            from catalog_sales, date_dim
+            where cs_item_sk = i_item_sk
+              and d_date between date '2000-01-27' and date '2000-04-26'
+              and d_date_sk = cs_sold_date_sk)
+        limit 100""",
+    "q37": """
+        select i_item_id, i_item_desc, i_current_price
+        from item, inventory, date_dim, catalog_sales
+        where i_current_price between 20.00 and 50.00
+          and inv_item_sk = i_item_sk and d_date_sk = inv_date_sk
+          and d_date between date '2000-02-01' and date '2000-04-01'
+          and i_manufact_id in (129, 270, 821, 423)
+          and inv_quantity_on_hand between 100 and 500
+          and cs_item_sk = i_item_sk
+        group by i_item_id, i_item_desc, i_current_price
+        order by i_item_id limit 100""",
+    "q40": """
+        select w_state, i_item_id,
+               sum(case when d_date < date '2000-03-11'
+                   then cs_sales_price - coalesce(cr_refunded_cash, 0)
+                   else 0 end) as sales_before,
+               sum(case when d_date >= date '2000-03-11'
+                   then cs_sales_price - coalesce(cr_refunded_cash, 0)
+                   else 0 end) as sales_after
+        from catalog_sales
+          left outer join catalog_returns
+            on (cs_order_number = cr_order_number
+                and cs_item_sk = cr_item_sk),
+          warehouse, item, date_dim
+        where i_item_sk = cs_item_sk
+          and cs_warehouse_sk = w_warehouse_sk
+          and cs_sold_date_sk = d_date_sk
+          and d_date between date '2000-02-10' and date '2000-04-10'
+        group by w_state, i_item_id
+        order by w_state, i_item_id limit 100""",
+    "q43": """
+        select s_store_name, s_store_id,
+            sum(case when d_day_name = 'Sunday'
+                then ss_sales_price else null end) as sun_sales,
+            sum(case when d_day_name = 'Monday'
+                then ss_sales_price else null end) as mon_sales,
+            sum(case when d_day_name = 'Tuesday'
+                then ss_sales_price else null end) as tue_sales,
+            sum(case when d_day_name = 'Wednesday'
+                then ss_sales_price else null end) as wed_sales,
+            sum(case when d_day_name = 'Thursday'
+                then ss_sales_price else null end) as thu_sales,
+            sum(case when d_day_name = 'Friday'
+                then ss_sales_price else null end) as fri_sales,
+            sum(case when d_day_name = 'Saturday'
+                then ss_sales_price else null end) as sat_sales
+        from date_dim, store_sales, store
+        where d_date_sk = ss_sold_date_sk and s_store_sk = ss_store_sk
+          and s_gmt_offset = -5 and d_year = 2000
+        group by s_store_name, s_store_id
+        order by s_store_name, s_store_id, sun_sales, mon_sales,
+                 tue_sales, wed_sales, thu_sales, fri_sales, sat_sales
+        limit 100""",
+    "q46": """
+        select c_last_name, c_first_name, ca_city, bought_city,
+               ss_ticket_number, amt, profit
+        from (select ss_ticket_number, ss_customer_sk,
+                     ca_city as bought_city,
+                     sum(ss_coupon_amt) as amt,
+                     sum(ss_net_profit) as profit
+              from store_sales, date_dim, store,
+                   household_demographics, customer_address
+              where ss_sold_date_sk = d_date_sk
+                and ss_store_sk = s_store_sk
+                and ss_hdemo_sk = hd_demo_sk
+                and ss_addr_sk = ca_address_sk
+                and (hd_dep_count = 4 or hd_vehicle_count = 3)
+                and d_dow in (6, 0)
+                and d_year in (1999, 2000, 2001)
+                and s_city in ('Fairview', 'Midway')
+              group by ss_ticket_number, ss_customer_sk, ss_addr_sk,
+                       ca_city) dn,
+             customer, customer_address current_addr
+        where ss_customer_sk = c_customer_sk
+          and customer.c_current_addr_sk = current_addr.ca_address_sk
+          and current_addr.ca_city <> bought_city
+        order by c_last_name, c_first_name, ca_city, bought_city,
+                 ss_ticket_number limit 100""",
+    "q48": """
+        select sum(ss_quantity) as total
+        from store_sales, store, customer_demographics,
+             customer_address, date_dim
+        where s_store_sk = ss_store_sk
+          and ss_sold_date_sk = d_date_sk and d_year = 2000
+          and cd_demo_sk = ss_cdemo_sk
+          and ss_addr_sk = ca_address_sk
+          and ca_country = 'United States'
+          and ((cd_marital_status = 'M'
+                and cd_education_status = '4 yr Degree'
+                and ss_sales_price between 100.00 and 150.00)
+            or (cd_marital_status = 'D'
+                and cd_education_status = '2 yr Degree'
+                and ss_sales_price between 50.00 and 100.00)
+            or (cd_marital_status = 'S'
+                and cd_education_status = 'College'
+                and ss_sales_price between 150.00 and 200.00))
+          and ((ca_state in ('CO', 'OH', 'TX')
+                and ss_net_profit between 0 and 2000)
+            or (ca_state in ('OR', 'MN', 'KY')
+                and ss_net_profit between 150 and 3000)
+            or (ca_state in ('VA', 'CA', 'MS')
+                and ss_net_profit between 50 and 25000))""",
+    "q55": """
+        select i_brand_id as brand_id, i_brand as brand,
+               sum(ss_ext_sales_price) as ext_price
+        from date_dim, store_sales, item
+        where d_date_sk = ss_sold_date_sk and ss_item_sk = i_item_sk
+          and i_manager_id = 28 and d_moy = 11 and d_year = 1999
+        group by i_brand_id, i_brand
+        order by ext_price desc, brand_id limit 100""",
+    "q62": """
+        select w_warehouse_name, sm_type, web_name,
+           sum(case when (ws_ship_date_sk - ws_sold_date_sk <= 30)
+               then 1 else 0 end) as d30,
+           sum(case when (ws_ship_date_sk - ws_sold_date_sk > 30)
+                     and (ws_ship_date_sk - ws_sold_date_sk <= 60)
+               then 1 else 0 end) as d60,
+           sum(case when (ws_ship_date_sk - ws_sold_date_sk > 60)
+               then 1 else 0 end) as d90
+        from web_sales, warehouse, ship_mode, web_site, date_dim
+        where d_month_seq between 24 and 35
+          and ws_ship_date_sk = d_date_sk
+          and ws_warehouse_sk = w_warehouse_sk
+          and ws_ship_mode_sk = sm_ship_mode_sk
+          and ws_web_site_sk = web_site_sk
+        group by w_warehouse_name, sm_type, web_name
+        order by w_warehouse_name, sm_type, web_name limit 100""",
+    "q65": """
+        select s_store_name, i_item_desc, sc.revenue, i_current_price,
+               i_wholesale_cost, i_brand
+        from store, item,
+             (select ss_store_sk, avg(revenue) as ave
+              from (select ss_store_sk, ss_item_sk,
+                           sum(ss_sales_price) as revenue
+                    from store_sales, date_dim
+                    where ss_sold_date_sk = d_date_sk
+                      and d_month_seq between 24 and 35
+                    group by ss_store_sk, ss_item_sk) sa
+              group by ss_store_sk) sb,
+             (select ss_store_sk, ss_item_sk,
+                     sum(ss_sales_price) as revenue
+              from store_sales, date_dim
+              where ss_sold_date_sk = d_date_sk
+                and d_month_seq between 24 and 35
+              group by ss_store_sk, ss_item_sk) sc
+        where sb.ss_store_sk = sc.ss_store_sk
+          and sc.revenue <= 0.1 * sb.ave
+          and s_store_sk = sc.ss_store_sk
+          and i_item_sk = sc.ss_item_sk
+        order by s_store_name, i_item_desc limit 100""",
+    "q72": """
+        select i_item_desc, w_warehouse_name, d1.d_week_seq,
+               sum(case when p_promo_sk is null then 1 else 0 end)
+                 as no_promo,
+               sum(case when p_promo_sk is not null then 1 else 0 end)
+                 as promo,
+               count(*) as total_cnt
+        from catalog_sales
+          join inventory on (cs_item_sk = inv_item_sk)
+          join warehouse on (w_warehouse_sk = inv_warehouse_sk)
+          join item on (i_item_sk = cs_item_sk)
+          join customer_demographics on (cs_bill_cdemo_sk = cd_demo_sk)
+          join household_demographics on (cs_bill_hdemo_sk = hd_demo_sk)
+          join date_dim d1 on (cs_sold_date_sk = d1.d_date_sk)
+          join date_dim d2 on (inv_date_sk = d2.d_date_sk)
+          join date_dim d3 on (cs_ship_date_sk = d3.d_date_sk)
+          left outer join promotion on (cs_promo_sk = p_promo_sk)
+          left outer join catalog_returns
+            on (cr_item_sk = cs_item_sk
+                and cr_order_number = cs_order_number)
+        where d1.d_week_seq = d2.d_week_seq
+          and inv_quantity_on_hand < cs_quantity
+          and d3.d_date > d1.d_date + 5
+          and hd_buy_potential = '>10000'
+          and d1.d_year = 1999
+          and cd_marital_status = 'D'
+        group by i_item_desc, w_warehouse_name, d1.d_week_seq
+        order by total_cnt desc, i_item_desc, w_warehouse_name,
+                 d1.d_week_seq limit 100""",
+    "q79": """
+        select c_last_name, c_first_name,
+               substr(s_city, 1, 30) as city, ss_ticket_number, amt,
+               profit
+        from (select ss_ticket_number, ss_customer_sk, store.s_city,
+                     sum(ss_coupon_amt) as amt,
+                     sum(ss_net_profit) as profit
+              from store_sales, date_dim, store,
+                   household_demographics
+              where store_sales.ss_sold_date_sk = d_date_sk
+                and store_sales.ss_store_sk = store.s_store_sk
+                and ss_hdemo_sk = hd_demo_sk
+                and (hd_dep_count = 6 or hd_vehicle_count > 2)
+                and d_dow = 1
+                and d_year in (1999, 2000, 2001)
+                and store.s_number_employees between 200 and 295
+              group by ss_ticket_number, ss_customer_sk, ss_addr_sk,
+                       store.s_city) ms, customer
+        where ss_customer_sk = c_customer_sk
+        order by c_last_name, c_first_name, city, profit,
+                 ss_ticket_number limit 100""",
+    "q82": """
+        select i_item_id, i_item_desc, i_current_price
+        from item, inventory, date_dim, store_sales
+        where i_current_price between 30.00 and 60.00
+          and inv_item_sk = i_item_sk and d_date_sk = inv_date_sk
+          and d_date between date '2000-05-25' and date '2000-07-24'
+          and i_manufact_id in (437, 129, 727, 663)
+          and inv_quantity_on_hand between 100 and 500
+          and ss_item_sk = i_item_sk
+        group by i_item_id, i_item_desc, i_current_price
+        order by i_item_id limit 100""",
+    "q90": """
+        select cast(amc as double) / cast(pmc as double)
+                 as am_pm_ratio
+        from (select count(*) as amc
+              from web_sales, household_demographics, time_dim,
+                   web_page
+              where ws_sold_time_sk = t_time_sk
+                and ws_ship_hdemo_sk = hd_demo_sk
+                and ws_web_page_sk = wp_web_page_sk
+                and t_hour between 8 and 9
+                and hd_dep_count = 6
+                and wp_char_count between 1000 and 6200) at_,
+             (select count(*) as pmc
+              from web_sales, household_demographics, time_dim,
+                   web_page
+              where ws_sold_time_sk = t_time_sk
+                and ws_ship_hdemo_sk = hd_demo_sk
+                and ws_web_page_sk = wp_web_page_sk
+                and t_hour between 19 and 20
+                and hd_dep_count = 6
+                and wp_char_count between 1000 and 6200) pt_
+        order by am_pm_ratio limit 100""",
+    "q92": """
+        select sum(ws_ext_discount_amt) as excess_discount
+        from web_sales, item, date_dim
+        where i_manufact_id = 350
+          and i_item_sk = ws_item_sk
+          and d_date between date '2000-01-27' and date '2000-04-26'
+          and d_date_sk = ws_sold_date_sk
+          and ws_ext_discount_amt > (
+            select 1.3 * avg(ws_ext_discount_amt)
+            from web_sales, date_dim
+            where ws_item_sk = i_item_sk
+              and d_date between date '2000-01-27'
+                             and date '2000-04-26'
+              and d_date_sk = ws_sold_date_sk)
+        limit 100""",
+    "q95": """
+        with ws_wh as
+          (select ws1.ws_order_number,
+                  ws1.ws_warehouse_sk as wh1,
+                  ws2.ws_warehouse_sk as wh2
+           from web_sales ws1, web_sales ws2
+           where ws1.ws_order_number = ws2.ws_order_number
+             and ws1.ws_warehouse_sk <> ws2.ws_warehouse_sk)
+        select count(distinct ws_order_number) as order_count,
+               sum(ws_ext_ship_cost) as total_shipping_cost,
+               sum(ws_net_profit) as total_net_profit
+        from web_sales ws1, date_dim, customer_address, web_site
+        where d_date between date '1999-02-01' and date '1999-04-01'
+          and ws1.ws_ship_date_sk = d_date_sk
+          and ws1.ws_ship_addr_sk = ca_address_sk
+          and ca_state = 'CA'
+          and ws1.ws_web_site_sk = web_site_sk
+          and web_company_name = 'pri'
+          and ws1.ws_order_number in
+                (select ws_order_number from ws_wh)
+          and ws1.ws_order_number in
+                (select wr_order_number from web_returns, ws_wh
+                 where wr_order_number = ws_wh.ws_order_number)
+        order by order_count limit 100""",
+    "q96": """
+        select count(*) as cnt
+        from store_sales, household_demographics, time_dim, store
+        where ss_sold_time_sk = t_time_sk
+          and ss_hdemo_sk = hd_demo_sk
+          and ss_store_sk = s_store_sk
+          and t_hour = 20 and t_minute >= 30
+          and hd_dep_count = 7
+          and s_store_name = 'ese'
+        order by cnt limit 100""",
+    "q98": """
+        select i_item_id, i_item_desc, i_category, i_class,
+               i_current_price,
+               sum(ss_ext_sales_price) as itemrevenue,
+               sum(ss_ext_sales_price) * 100.0 /
+                 sum(sum(ss_ext_sales_price))
+                   over (partition by i_class) as revenueratio
+        from store_sales, item, date_dim
+        where ss_item_sk = i_item_sk
+          and i_category in ('Sports', 'Books', 'Home')
+          and ss_sold_date_sk = d_date_sk
+          and d_date between date '1999-02-22' and date '1999-03-24'
+        group by i_item_id, i_item_desc, i_category, i_class,
+                 i_current_price
+        order by i_category, i_class, i_item_id, i_item_desc,
+                 revenueratio limit 100""",
     # windowed ranking over aggregates (Q67-style core)
     "q_rank_categories": """
         select * from (
