@@ -24,6 +24,9 @@ class Engine:
     def __init__(self, session: Session | None = None):
         self.session = session or Session()
         self.catalogs: dict[str, Connector] = {}
+        # populated by the spill driver when a query exceeds the memory
+        # budget and runs host-partitioned (exec/spill.py)
+        self.last_spill: dict | None = None
 
     def register_catalog(self, name: str, connector: Connector) -> None:
         self.catalogs[name] = connector
@@ -80,6 +83,7 @@ class Engine:
         return optimize(plan, self)
 
     def _execute_query(self, query, mesh=None) -> Table:
+        self.last_spill = None
         plan = self._plan_query(query)
         if mesh is not None:
             from presto_tpu.parallel.executor import (

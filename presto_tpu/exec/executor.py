@@ -320,10 +320,16 @@ def execute_plan(engine, plan: N.PlanNode) -> Table:
     """Compile + run a logical plan on the local device. Plans whose
     dominant scan exceeds the session block size stream block-wise (the
     split analog) when the plan shape allows it."""
+    from presto_tpu.exec.spill import try_execute_spilled
     from presto_tpu.exec.streaming import try_execute_streamed
+    # streaming first: a block-streamed scan already bounds its working
+    # set, so the memory-budget check must not veto it
     streamed = try_execute_streamed(engine, plan)
     if streamed is not None:
         return streamed
+    spilled = try_execute_spilled(engine, plan)
+    if spilled is not None:
+        return spilled
     scan_inputs = collect_scans(plan, engine)
     return run_plan(engine, plan, scan_inputs)
 

@@ -1,0 +1,211 @@
+"""Host-partitioned spill execution for over-budget hash joins.
+
+Analog of the reference's spill-to-disk join
+(spiller/GenericPartitioningSpiller.java:50,
+operator/join/HashBuilderOperator.java:183-191 spill/unspill state
+machine): when the plan-time memory estimate (presto_tpu/memory.py)
+exceeds the session budget, the dominant join's build AND probe inputs
+are materialized to HOST RAM (the TPU's spill medium), hash-partitioned
+by the join keys on host, and the join runs partition-by-partition on
+device — HBM holds one partition's tables at a time, bounded by
+budget/partitions. The rest of the plan then runs over the concatenated
+join output through the normal compiled path.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from presto_tpu import types as T
+from presto_tpu.block import Column, Table
+from presto_tpu.memory import MemoryLimitExceeded, estimate_plan_memory
+from presto_tpu.ops.hash import next_pow2
+from presto_tpu.plan import nodes as N
+
+
+def _compact(tbl: Table) -> Table:
+    if tbl.mask is None:
+        return tbl
+    m = np.asarray(tbl.mask)
+    cols = {}
+    for s, c in tbl.columns.items():
+        cols[s] = Column(c.dtype, np.asarray(c.data)[m],
+                         None if c.valid is None
+                         else np.asarray(c.valid)[m], c.dictionary)
+    return Table(cols, int(m.sum()), None)
+
+
+def _value_hash(tbl: Table, keys: list[str]) -> tuple:
+    """(uint64 hash per row, all-keys-valid mask) — value-based (strings
+    hash their dictionary text via the cached content hash in ops/hash)
+    so probe and build partition identically even with different
+    dictionaries."""
+    from presto_tpu.ops.hash import hash_string_dictionary
+
+    n = tbl.nrows
+    h = np.full(n, 0x243F6A8885A308D3, np.uint64)
+    valid = np.ones(n, bool)
+    for k in keys:
+        c = tbl.columns[k]
+        if c.dictionary is not None:
+            lut = hash_string_dictionary(c.dictionary)
+            if len(lut) == 0:
+                v = np.zeros(n, np.int64)
+            else:
+                codes = np.clip(np.asarray(c.data).astype(np.int64),
+                                0, len(lut) - 1)
+                v = lut[codes].astype(np.int64)
+        else:
+            v = np.asarray(c.data).astype(np.int64)
+        x = v.astype(np.uint64) + np.uint64(0x9E3779B97F4A7C15)
+        x ^= x >> np.uint64(30)
+        x *= np.uint64(0xBF58476D1CE4E5B9)
+        x ^= x >> np.uint64(27)
+        h = (h ^ x) * np.uint64(0x100000001B3)
+        if c.valid is not None:
+            valid &= np.asarray(c.valid)
+    return h, valid
+
+
+def _slice_table(tbl: Table, idx: np.ndarray) -> Table:
+    cols = {}
+    for s, c in tbl.columns.items():
+        cols[s] = Column(c.dtype, np.asarray(c.data)[idx],
+                         None if c.valid is None
+                         else np.asarray(c.valid)[idx], c.dictionary)
+    return Table(cols, len(idx), None)
+
+
+def _carrier_scan(name: str, tbl: Table) -> tuple:
+    """(TableScan node, ScanInput) serving a host Table verbatim."""
+    from presto_tpu.exec.executor import ScanInput
+
+    types = {s: c.dtype for s, c in tbl.columns.items()}
+    node = N.TableScan("__spill__", name,
+                       {s: s for s in types}, types)
+    arrays: dict[str, np.ndarray] = {}
+    dicts: dict[str, np.ndarray | None] = {}
+    for s, c in tbl.columns.items():
+        arrays[s] = np.asarray(c.data)
+        if c.valid is not None:
+            arrays[f"{s}$valid"] = np.asarray(c.valid)
+        dicts[s] = c.dictionary
+    return node, ScanInput(node, arrays, dicts, types, tbl.nrows)
+
+
+def _concat_tables(parts: list[Table]) -> Table:
+    cols: dict[str, Column] = {}
+    live = np.concatenate([
+        np.asarray(p.mask) if p.mask is not None
+        else np.ones(p.nrows, bool) for p in parts])
+    for s in parts[0].columns:
+        cs = [p.columns[s] for p in parts]
+        data = np.concatenate([np.asarray(c.data) for c in cs])
+        if any(c.valid is not None for c in cs):
+            valid = np.concatenate([
+                np.asarray(c.valid) if c.valid is not None
+                else np.ones(p.nrows, bool)
+                for c, p in zip(cs, parts)])
+        else:
+            valid = None
+        cols[s] = Column(cs[0].dtype, data, valid, cs[0].dictionary)
+    return Table(cols, len(live), live)
+
+
+def try_execute_spilled(engine, plan: N.PlanNode):
+    """Execute with host-partitioned join spill, or return None when the
+    budget (query_max_memory_bytes) is unset or the plan fits.
+
+    Enforcement contract: over budget, the first join on the plan's
+    root chain spills (its subplans re-enter this check recursively, so
+    nested joins cascade); a plan with no spillable join fails with
+    MemoryLimitExceeded — except inside a spill driver's own subplan
+    executions, whose scans materialize to host (the spill medium) by
+    design."""
+    budget = int(engine.session.get("query_max_memory_bytes") or 0)
+    if budget <= 0:
+        return None
+    total, per_node = estimate_plan_memory(plan, engine)
+    if total <= budget:
+        return None
+    if not engine.session.get("spill_enabled"):
+        raise MemoryLimitExceeded(
+            f"query estimated {total} bytes exceeds "
+            f"query_max_memory_bytes={budget} and spill is disabled")
+
+    # first multi-source node on the root chain: a Join spills; any
+    # other shape cannot be bounded by join partitioning
+    node = plan
+    while True:
+        srcs = node.sources()
+        if isinstance(node, N.Join) and node.criteria:
+            join = node
+            break
+        if len(srcs) != 1:
+            if getattr(engine, "_in_spill", False):
+                return None  # host-side subplan: already spilled medium
+            raise MemoryLimitExceeded(
+                f"query estimated {total} bytes exceeds "
+                f"query_max_memory_bytes={budget} and this plan shape "
+                f"has no spillable join on its root chain")
+        node = srcs[0]
+
+    from presto_tpu.exec.executor import execute_plan, run_plan
+
+    in_spill_before = getattr(engine, "_in_spill", False)
+    engine._in_spill = True
+    try:
+        build_tbl = _compact(execute_plan(engine, join.right))
+        probe_tbl = _compact(execute_plan(engine, join.left))
+    finally:
+        engine._in_spill = in_spill_before
+
+    nparts = min(64, max(2, next_pow2(-(-total // budget))))
+    lkeys = [lk for lk, _ in join.criteria]
+    rkeys = [rk for _, rk in join.criteria]
+    ph, pvalid = _value_hash(probe_tbl, lkeys)
+    bh, bvalid = _value_hash(build_tbl, rkeys)
+    ppart = (ph % np.uint64(nparts)).astype(np.int64)
+    bpart = (bh % np.uint64(nparts)).astype(np.int64)
+    outer = join.join_type == N.JoinType.LEFT
+    # NULL-key rows never match: drop from build always, and from the
+    # probe unless the join is outer (those rows still emit)
+    if not outer:
+        ppart[~pvalid] = -1
+    bpart[~bvalid] = -1
+
+    outs: list[Table] = []
+    for p in range(nparts):
+        pp = _slice_table(probe_tbl, np.nonzero(ppart == p)[0])
+        bp = _slice_table(build_tbl, np.nonzero(bpart == p)[0])
+        if pp.nrows == 0:
+            continue
+        pnode, pinput = _carrier_scan(f"probe_p{p}", pp)
+        bnode, binput = _carrier_scan(f"build_p{p}", bp)
+        jp = dataclasses.replace(
+            join, left=pnode, right=bnode,
+            build_rows=max(bp.nrows, 1),
+            capacity=next_pow2(2 * max(bp.nrows, 1)),
+            output_capacity=None if join.build_unique
+            else next_pow2(2 * max(pp.nrows + bp.nrows, 1)))
+        outs.append(run_plan(engine, jp, [pinput, binput]))
+
+    if not outs:
+        merged = Table(
+            {s: Column(t, np.empty(0, t.physical_dtype), None,
+                       np.empty(0, object)
+                       if isinstance(t, T.VarcharType) else None)
+             for s, t in join.output_types().items()}, 0, None)
+    else:
+        merged = _concat_tables(outs)
+    engine.last_spill = {"partitions": nparts,
+                         "build_rows": build_tbl.nrows,
+                         "estimated_bytes": total, "budget": budget}
+
+    carrier_node, carrier_input = _carrier_scan("__joined__",
+                                                _compact(merged))
+    from presto_tpu.exec.streaming import _replace_node
+    rest = _replace_node(plan, join, carrier_node)
+    return run_plan(engine, rest, [carrier_input])

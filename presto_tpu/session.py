@@ -37,6 +37,15 @@ SYSTEM_SESSION_PROPERTIES: dict[str, tuple[Any, type, str]] = {
                                  "prune probe scans with build-side "
                                  "join-key min/max ranges (reference "
                                  "DynamicFilterService)"),
+    "query_max_memory_bytes": (0, int,
+                               "plan-time device-memory budget per query "
+                               "(0 = unlimited); over-budget plans spill "
+                               "or fail (reference query.max-memory + "
+                               "MemoryPool)"),
+    "spill_enabled": (True, bool,
+                      "host-partitioned join spill when the memory "
+                      "budget is exceeded (reference spill-enabled + "
+                      "GenericPartitioningSpiller)"),
     "distributed_sort": (True, bool,
                          "sort sharded inputs per-shard and n-way merge "
                          "the presorted runs (reference MergeOperator) "

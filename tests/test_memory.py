@@ -1,0 +1,117 @@
+"""Memory accounting + spill (reference memory/MemoryPool.java:44,
+spiller/GenericPartitioningSpiller.java:50, and the
+ExceededMemoryLimitException failure mode)."""
+
+import pytest
+
+from presto_tpu.memory import MemoryLimitExceeded
+from presto_tpu.testing.oracle import assert_query
+
+JOIN_SQL = """
+    select o_orderpriority, count(*) as c, sum(l_quantity) as q
+    from orders, lineitem
+    where o_orderkey = l_orderkey and l_shipdate > date '1995-01-01'
+    group by o_orderpriority
+    order by o_orderpriority"""
+
+OUTER_SQL = """
+    select c_mktsegment, count(o_orderkey) as n
+    from customer left outer join orders on c_custkey = o_custkey
+    group by c_mktsegment
+    order by c_mktsegment"""
+
+
+@pytest.fixture()
+def eng(tpch_tiny):
+    from presto_tpu import Engine
+    e = Engine()
+    e.register_catalog("tpch", tpch_tiny)
+    return e
+
+
+def test_plan_memory_estimate_scales_with_tables(eng):
+    from presto_tpu.memory import estimate_plan_memory
+    plan, _ = eng.plan_sql("select sum(l_quantity) from lineitem")
+    total, per_node = estimate_plan_memory(plan, eng)
+    li_rows = eng.catalogs["tpch"].row_count_estimate("lineitem")
+    # at least the scanned column's bytes, at most a plausible multiple
+    assert total >= li_rows * 8
+    assert total <= li_rows * 1000
+    assert any(m.resident > 0 for m in per_node)
+
+
+def test_join_spills_under_budget_and_matches_oracle(eng, oracle):
+    eng.session.set("query_max_memory_bytes", 200_000)  # ~0.2 MB
+    want_spilled = eng.execute(JOIN_SQL)
+    assert eng.last_spill is not None, "expected the join to spill"
+    assert eng.last_spill["partitions"] >= 2
+    eng.session.set("query_max_memory_bytes", 0)
+    assert eng.execute(JOIN_SQL) == want_spilled
+    assert_query(eng, oracle, JOIN_SQL)
+
+
+def test_left_join_spill_keeps_unmatched_probe_rows(eng, oracle):
+    eng.session.set("query_max_memory_bytes", 100_000)
+    got = eng.execute(OUTER_SQL)
+    assert eng.last_spill is not None
+    eng.session.set("query_max_memory_bytes", 0)
+    assert eng.execute(OUTER_SQL) == got
+    assert_query(eng, oracle, OUTER_SQL)
+
+
+def test_memory_limit_without_spill_raises(eng):
+    eng.session.set("query_max_memory_bytes", 10_000)
+    eng.session.set("spill_enabled", False)
+    with pytest.raises(MemoryLimitExceeded):
+        eng.execute(JOIN_SQL)
+
+
+def test_spill_with_empty_probe_side(eng, oracle):
+    """All partitions empty (filter kills the probe): the fallback
+    empty join output must carry dictionaries for VARCHAR columns."""
+    sql = ("select o_orderpriority, count(*) as c from orders, lineitem "
+           "where o_orderkey = l_orderkey "
+           "and l_shipdate > date '2999-01-01' "
+           "group by o_orderpriority order by o_orderpriority")
+    eng.session.set("query_max_memory_bytes", 200_000)
+    got = eng.execute(sql)
+    assert got == []
+    eng.session.set("query_max_memory_bytes", 0)
+    assert_query(eng, oracle, sql)
+
+
+def test_multi_join_spills_top_join(eng, oracle):
+    """The budget is enforced on multi-join plans: the root-chain join
+    spills and its subplans cascade through the same check."""
+    sql = ("select n_name, count(*) as c from customer, orders, nation "
+           "where c_custkey = o_custkey and c_nationkey = n_nationkey "
+           "group by n_name order by n_name")
+    eng.session.set("query_max_memory_bytes", 150_000)
+    got = eng.execute(sql)
+    assert eng.last_spill is not None, "expected multi-join plan to spill"
+    eng.session.set("query_max_memory_bytes", 0)
+    assert eng.execute(sql) == got
+    assert_query(eng, oracle, sql)
+
+
+def test_unspillable_shape_fails_instead_of_running_unbounded(eng):
+    """A plan with no join on its root chain cannot be bounded by join
+    spill: it fails rather than silently ignoring the budget."""
+    eng.session.set("query_max_memory_bytes", 10_000)
+    with pytest.raises(MemoryLimitExceeded):
+        eng.execute("select l_orderkey, l_quantity from lineitem "
+                    "order by l_quantity")
+
+
+def test_streamable_aggregate_runs_under_budget(eng):
+    """Block-streamed scans bound their own working set; the budget
+    check must not veto them."""
+    eng.session.set("query_max_memory_bytes", 300_000)
+    eng.session.set("scan_block_rows", 16384)
+    try:
+        got = eng.execute("select sum(l_quantity) from lineitem")
+        assert eng.last_streamed_blocks >= 2
+    finally:
+        eng.session.set("scan_block_rows", 1 << 24)
+        eng.session.set("query_max_memory_bytes", 0)
+    assert got == eng.execute("select sum(l_quantity) from lineitem")
