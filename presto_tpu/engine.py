@@ -78,9 +78,15 @@ class Engine:
         from presto_tpu.plan.planner import LogicalPlanner
         from presto_tpu.sql import ast as A
 
+        from presto_tpu.plan.sanity import validate_plan
+
         planner = LogicalPlanner(self, None)
         plan = planner.plan(A.QueryStatement(query))
-        return optimize(plan, self)
+        plan = optimize(plan, self)
+        # invariant validation before execution (reference
+        # PlanSanityChecker runs after every optimizer stage)
+        validate_plan(plan)
+        return plan
 
     def _execute_query(self, query, mesh=None) -> Table:
         self.last_spill = None

@@ -169,7 +169,7 @@ def apply_aggregate(dt: DTable, node: N.Aggregate, capacity: int) -> tuple:
             if call.arg is not None:
                 av = c.compile(call.arg)
                 weight = live if av.valid is None else (live & av.valid)
-                data = av.data
+                data = A.prepare_arg(call.fn, av.data, av.dtype)
                 if getattr(data, "ndim", 1) == 0:
                     data = jnp.broadcast_to(data, (dt.n,))
                 arg_type = av.dtype

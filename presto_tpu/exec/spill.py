@@ -78,21 +78,33 @@ def _slice_table(tbl: Table, idx: np.ndarray) -> Table:
     return Table(cols, len(idx), None)
 
 
-def _carrier_scan(name: str, tbl: Table) -> tuple:
-    """(TableScan node, ScanInput) serving a host Table verbatim."""
+def _carrier_scan(name: str, tbl: Table, pad_to: int | None = None
+                  ) -> tuple:
+    """(TableScan node, ScanInput) serving a host Table verbatim.
+    ``pad_to`` pads the arrays to a fixed row count (dead-padded via
+    __live__) so differently-sized partitions share ONE compiled
+    program."""
     from presto_tpu.exec.executor import ScanInput
 
     types = {s: c.dtype for s, c in tbl.columns.items()}
     node = N.TableScan("__spill__", name,
                        {s: s for s in types}, types)
+    n = tbl.nrows
+    # only padded carriers get a minimum row (their __live__ mask kills
+    # the pad); an unpadded 0-row table must stay 0 rows
+    total = max(pad_to, 1) if pad_to is not None else n
     arrays: dict[str, np.ndarray] = {}
     dicts: dict[str, np.ndarray | None] = {}
     for s, c in tbl.columns.items():
-        arrays[s] = np.asarray(c.data)
+        a = np.asarray(c.data)
+        arrays[s] = np.pad(a, [(0, total - n)] + [(0, 0)] * (a.ndim - 1))
         if c.valid is not None:
-            arrays[f"{s}$valid"] = np.asarray(c.valid)
+            arrays[f"{s}$valid"] = np.pad(np.asarray(c.valid),
+                                          (0, total - n))
         dicts[s] = c.dictionary
-    return node, ScanInput(node, arrays, dicts, types, tbl.nrows)
+    if pad_to is not None:
+        arrays["__live__"] = np.arange(total) < n
+    return node, ScanInput(node, arrays, dicts, types, total)
 
 
 def _concat_tables(parts: list[Table]) -> Table:
@@ -112,6 +124,57 @@ def _concat_tables(parts: list[Table]) -> Table:
             valid = None
         cols[s] = Column(cs[0].dtype, data, valid, cs[0].dictionary)
     return Table(cols, len(live), live)
+
+
+def _run_partitions(engine, jp: N.Join, part_inputs: list) -> list[Table]:
+    """Run the per-partition join with ONE compiled program: partitions
+    are padded to identical shapes, so a single jitted trace serves all
+    of them (a fresh run_plan per partition would re-trace and
+    re-compile ~nparts times). Capacity overflow in any partition grows
+    the table and recompiles once for all."""
+    import jax
+
+    from presto_tpu.exec.executor import make_traced
+
+    capacities: dict[tuple, int] = {}
+    for _attempt in range(10):
+        pinput0, binput0 = part_inputs[0]
+        traced_fn, _flat, meta = make_traced(
+            [pinput0, binput0], jp, capacities, engine.session)
+        compiled = jax.jit(traced_fn)
+        results = []
+        overflow = False
+        for pinput, binput in part_inputs:
+            feed = [pinput.arrays[s] for s in pinput0.arrays] + \
+                   [binput.arrays[s] for s in binput0.arrays]
+            res, live, oks = compiled(*feed)
+            if not all(bool(o) for o in oks):
+                for key, okv in zip(meta["ok_keys"], oks):
+                    if not bool(okv):
+                        capacities[key] = 2 * meta["used_capacity"][key]
+                overflow = True
+                break
+            results.append((res, live))
+        if not overflow:
+            break
+    else:
+        raise RuntimeError("spill partition capacity retry limit")
+
+    outs = []
+    for res, live in results:
+        cols: dict[str, Column] = {}
+        i = 0
+        for sym, dtype, dictionary, has_valid in meta["out"]:
+            data = np.asarray(res[i])
+            valid = np.asarray(res[i + 1])
+            i += 2
+            cols[sym] = Column(
+                dtype, data,
+                valid if has_valid or not valid.all() else None,
+                dictionary)
+        live_np = np.asarray(live)
+        outs.append(Table(cols, len(live_np), live_np))
+    return outs
 
 
 def try_execute_spilled(engine, plan: N.PlanNode):
@@ -162,7 +225,12 @@ def try_execute_spilled(engine, plan: N.PlanNode):
     finally:
         engine._in_spill = in_spill_before
 
-    nparts = min(64, max(2, next_pow2(-(-total // budget))))
+    nparts = max(2, next_pow2(-(-total // budget)))
+    if nparts > 64:
+        raise MemoryLimitExceeded(
+            f"query estimated {total} bytes cannot be bounded by "
+            f"query_max_memory_bytes={budget} within 64 spill "
+            f"partitions")
     lkeys = [lk for lk, _ in join.criteria]
     rkeys = [rk for _, rk in join.criteria]
     ph, pvalid = _value_hash(probe_tbl, lkeys)
@@ -176,21 +244,33 @@ def try_execute_spilled(engine, plan: N.PlanNode):
         ppart[~pvalid] = -1
     bpart[~bvalid] = -1
 
-    outs: list[Table] = []
-    for p in range(nparts):
+    # uniform padded partition shapes -> the join compiles ONCE and the
+    # same program runs for every partition (reference unspill replays
+    # one operator pipeline per spilled partition too)
+    live_parts = [p for p in range(nparts)
+                  if int((ppart == p).sum()) > 0]
+    pmax = max((int((ppart == p).sum()) for p in live_parts), default=1)
+    bmax = max(int(np.bincount(bpart[bpart >= 0], minlength=nparts)
+                   .max()), 1)
+    part_inputs = []
+    jp = None
+    for p in live_parts:
         pp = _slice_table(probe_tbl, np.nonzero(ppart == p)[0])
         bp = _slice_table(build_tbl, np.nonzero(bpart == p)[0])
-        if pp.nrows == 0:
-            continue
-        pnode, pinput = _carrier_scan(f"probe_p{p}", pp)
-        bnode, binput = _carrier_scan(f"build_p{p}", bp)
-        jp = dataclasses.replace(
-            join, left=pnode, right=bnode,
-            build_rows=max(bp.nrows, 1),
-            capacity=next_pow2(2 * max(bp.nrows, 1)),
-            output_capacity=None if join.build_unique
-            else next_pow2(2 * max(pp.nrows + bp.nrows, 1)))
-        outs.append(run_plan(engine, jp, [pinput, binput]))
+        pnode, pinput = _carrier_scan("probe_part", pp, pad_to=pmax)
+        bnode, binput = _carrier_scan("build_part", bp, pad_to=bmax)
+        if jp is None:
+            jp = dataclasses.replace(
+                join, left=pnode, right=bnode,
+                build_rows=bmax,
+                capacity=next_pow2(2 * bmax),
+                output_capacity=None if join.build_unique
+                else next_pow2(2 * (pmax + bmax)))
+        else:
+            pinput = dataclasses.replace(pinput, node=jp.left)
+            binput = dataclasses.replace(binput, node=jp.right)
+        part_inputs.append((pinput, binput))
+    outs = _run_partitions(engine, jp, part_inputs) if part_inputs else []
 
     if not outs:
         merged = Table(

@@ -43,9 +43,20 @@ class AggCall:
         return f"{self.fn}({d}{inner}){m}"
 
 
+# sample/population variance family — all DOUBLE-valued (reference
+# operator/aggregation/VarianceAggregation + DoubleSumAggregation kin)
+VAR_FNS = frozenset({"variance", "var_samp", "var_pop",
+                     "stddev", "stddev_samp", "stddev_pop"})
+BOOL_FNS = frozenset({"bool_and", "bool_or", "every"})
+
+
 def output_type(fn: str, arg_type: T.DataType | None) -> T.DataType:
-    if fn in ("count", "count_star"):
+    if fn in ("count", "count_star", "count_if"):
         return T.BIGINT
+    if fn in VAR_FNS or fn == "geometric_mean":
+        return T.DOUBLE
+    if fn in BOOL_FNS:
+        return T.BOOLEAN
     if fn == "sum":
         if isinstance(arg_type, T.DecimalType):
             return T.DecimalType(18, arg_type.scale)
@@ -81,21 +92,43 @@ def state_type(call: "AggCall", field: str) -> T.DataType:
             return T.BIGINT
         return call.dtype
     if field == "val":
+        if call.fn in BOOL_FNS:
+            return T.INTEGER  # bool folded as 0/1 through min/max
         return call.arg.dtype if call.arg is not None else call.dtype
+    if field in ("m2", "sumlog"):
+        return T.DOUBLE
     raise NotImplementedError(field)
 
 
 # state column suffixes per function (partial aggregation schema)
 def state_fields(fn: str) -> list[str]:
-    if fn in ("count", "count_star"):
+    if fn in ("count", "count_star", "count_if"):
         return ["count"]
     if fn == "sum":
         return ["sum", "count"]  # count tracks non-null presence for SQL sum
     if fn == "avg":
         return ["sum", "count"]
-    if fn in ("min", "max", "arbitrary"):
+    if fn in ("min", "max", "arbitrary") or fn in BOOL_FNS:
         return ["val", "count"]
+    if fn in VAR_FNS:
+        return ["count", "sum", "m2"]
+    if fn == "geometric_mean":
+        return ["count", "sumlog"]
     raise NotImplementedError(fn)
+
+
+def prepare_arg(fn: str, data, arg_type: T.DataType | None):
+    """Pre-convert the argument for aggregates that fold in the real
+    domain (variance family, geometric_mean): decimals unscale to
+    float64 so the states are plain doubles."""
+    if fn not in VAR_FNS and fn != "geometric_mean":
+        return data
+    x = data.astype(jnp.float64)
+    if isinstance(arg_type, T.DecimalType):
+        x = x / arg_type.unscale_factor
+    if fn == "geometric_mean":
+        return jnp.log(x)
+    return x
 
 
 def fold(fn: str, data, weight, slots, capacity: int):
@@ -126,6 +159,44 @@ def fold(fn: str, data, weight, slots, capacity: int):
         c = jax.ops.segment_sum(w.astype(jnp.int64), slots,
                                 num_segments=capacity)
         return {"val": v, "count": c}
+    if fn == "count_if":
+        return {"count": jax.ops.segment_sum(
+            (w & data.astype(bool)).astype(jnp.int64), slots,
+            num_segments=capacity)}
+    if fn in BOOL_FNS:
+        b = data.astype(jnp.int32)
+        c = jax.ops.segment_sum(w.astype(jnp.int64), slots,
+                                num_segments=capacity)
+        if fn == "bool_or":
+            v = jax.ops.segment_max(jnp.where(w, b, 0), slots,
+                                    num_segments=capacity)
+        else:
+            v = jax.ops.segment_min(jnp.where(w, b, 1), slots,
+                                    num_segments=capacity)
+        return {"val": v, "count": c}
+    if fn in VAR_FNS:
+        # data pre-converted to float64 by prepare_arg. Two-pass M2
+        # (centered second moment) per slot — the sumsq - mean^2 form
+        # cancels catastrophically for mean >> spread; the reference's
+        # accumulators carry M2 for the same reason (Welford merging)
+        z = jnp.zeros((), jnp.float64)
+        c = jax.ops.segment_sum(w.astype(jnp.int64), slots,
+                                num_segments=capacity)
+        s = jax.ops.segment_sum(jnp.where(w, data, z), slots,
+                                num_segments=capacity)
+        mean = s / jnp.maximum(c, 1).astype(jnp.float64)
+        d = data - mean[slots]
+        m2 = jax.ops.segment_sum(jnp.where(w, d * d, z), slots,
+                                 num_segments=capacity)
+        return {"count": c, "sum": s, "m2": m2}
+    if fn == "geometric_mean":
+        z = jnp.zeros((), jnp.float64)
+        return {
+            "count": jax.ops.segment_sum(w.astype(jnp.int64), slots,
+                                         num_segments=capacity),
+            "sumlog": jax.ops.segment_sum(jnp.where(w, data, z), slots,
+                                          num_segments=capacity),
+        }
     raise NotImplementedError(fn)
 
 
@@ -146,8 +217,9 @@ def merge(fn: str, states: dict, slots, capacity: int, live):
                 jnp.where(w, states["count"], 0), slots,
                 num_segments=capacity),
         }
-    if fn in ("min", "max", "arbitrary"):
-        if fn == "max" or fn == "arbitrary":
+    if fn in ("min", "max", "arbitrary") or fn in BOOL_FNS:
+        seg_max = fn in ("max", "arbitrary", "bool_or")
+        if seg_max:
             sentinel = _min_sentinel(states["val"].dtype)
             v = jax.ops.segment_max(
                 jnp.where(w, states["val"], sentinel), slots,
@@ -159,6 +231,35 @@ def merge(fn: str, states: dict, slots, capacity: int, live):
                 num_segments=capacity)
         return {"val": v, "count": jax.ops.segment_sum(
             jnp.where(w, states["count"], 0), slots, num_segments=capacity)}
+    if fn == "count_if":
+        return {"count": jax.ops.segment_sum(
+            jnp.where(w, states["count"], 0), slots, num_segments=capacity)}
+    if fn in VAR_FNS:
+        # parallel M2 combination (Chan et al.): M2_tot = sum(M2_i) +
+        # sum(n_i * (mean_i - mean_tot)^2), all segment reductions
+        z = jnp.zeros((), jnp.float64)
+        n_i = jnp.where(w, states["count"], 0)
+        s_i = jnp.where(w, states["sum"], z)
+        n = jax.ops.segment_sum(n_i, slots, num_segments=capacity)
+        s = jax.ops.segment_sum(s_i, slots, num_segments=capacity)
+        mean_tot = s / jnp.maximum(n, 1).astype(jnp.float64)
+        mean_i = s_i / jnp.maximum(n_i, 1).astype(jnp.float64)
+        dev = mean_i - mean_tot[slots]
+        m2 = jax.ops.segment_sum(
+            jnp.where(w, states["m2"], z)
+            + n_i.astype(jnp.float64) * dev * dev,
+            slots, num_segments=capacity)
+        return {"count": n, "sum": s, "m2": m2}
+    if fn == "geometric_mean":
+        z = jnp.zeros((), jnp.float64)
+        return {
+            "count": jax.ops.segment_sum(
+                jnp.where(w, states["count"], 0), slots,
+                num_segments=capacity),
+            "sumlog": jax.ops.segment_sum(
+                jnp.where(w, states["sumlog"], z), slots,
+                num_segments=capacity),
+        }
     raise NotImplementedError(fn)
 
 
@@ -185,6 +286,28 @@ def finalize(fn: str, states: dict, out_type: T.DataType,
         return sf / safe.astype(jnp.float64), c > 0
     if fn in ("min", "max", "arbitrary"):
         return states["val"], states["count"] > 0
+    if fn == "count_if":
+        return states["count"], None
+    if fn in BOOL_FNS:
+        return states["val"] > 0, states["count"] > 0
+    if fn in VAR_FNS:
+        c = states["count"]
+        safe = jnp.maximum(c, 1).astype(jnp.float64)
+        m2 = states["m2"]
+        if fn.endswith("_pop"):
+            var = m2 / safe
+            ok = c > 0
+        else:
+            # sample variance: M2/(n-1), undefined for n < 2
+            var = m2 / jnp.maximum(safe - 1.0, 1.0)
+            ok = c > 1
+        if fn.startswith("stddev"):
+            return jnp.sqrt(var), ok
+        return var, ok
+    if fn == "geometric_mean":
+        c = states["count"]
+        safe = jnp.maximum(c, 1).astype(jnp.float64)
+        return jnp.exp(states["sumlog"] / safe), c > 0
     raise NotImplementedError(fn)
 
 

@@ -611,6 +611,9 @@ def _length(e, args):
     return Val(e.dtype, lut[col.data], col.valid)
 
 
+_CONCAT_PRODUCT_MAX = 1 << 16
+
+
 @scalar("concat")
 def _concat(e, args):
     a, b = args
@@ -620,7 +623,77 @@ def _concat(e, args):
     if len(b.dictionary) == 1:
         s = str(b.dictionary[0])
         return _dict_transform(a, lambda d: np.array([x + s for x in d], object))
-    raise NotImplementedError("concat of two non-literal string columns")
+    # two real columns: product dictionary, code = ca * |db| + cb. The
+    # dictionary must be static (host-side), so it enumerates all pairs;
+    # bounded to keep degenerate high-cardinality concats from exploding
+    # (the reference's per-row VarcharConcat has no such table at all —
+    # dictionary encoding is this engine's string substrate).
+    na, nb = len(a.dictionary), len(b.dictionary)
+    if na * nb > _CONCAT_PRODUCT_MAX:
+        raise NotImplementedError(
+            f"concat of string columns with {na}x{nb} dictionary product "
+            f"(> {_CONCAT_PRODUCT_MAX})")
+    d = np.array([str(x) + str(y)
+                  for x in a.dictionary for y in b.dictionary], object)
+    codes = a.data.astype(jnp.int32) * nb + b.data.astype(jnp.int32)
+    return Val(e.dtype, codes, and_valid(a.valid, b.valid), d)
+
+
+@scalar("trim")
+def _trim(e, args):
+    return _dict_transform(
+        args[0], lambda d: np.array([str(s).strip() for s in d], object))
+
+
+@scalar("ltrim")
+def _ltrim(e, args):
+    return _dict_transform(
+        args[0], lambda d: np.array([str(s).lstrip() for s in d], object))
+
+
+@scalar("rtrim")
+def _rtrim(e, args):
+    return _dict_transform(
+        args[0], lambda d: np.array([str(s).rstrip() for s in d], object))
+
+
+@scalar("reverse")
+def _reverse(e, args):
+    return _dict_transform(
+        args[0], lambda d: np.array([str(s)[::-1] for s in d], object))
+
+
+@scalar("replace")
+def _replace(e, args):
+    col = args[0]
+    if not all(isinstance(a, ir.Literal) for a in e.args[1:]):
+        raise NotImplementedError("replace with non-literal patterns")
+    pat = str(e.args[1].value)
+    rep = str(e.args[2].value) if len(e.args) > 2 else ""
+    return _dict_transform(
+        col, lambda d: np.array([str(s).replace(pat, rep) for s in d],
+                                object))
+
+
+@scalar("starts_with")
+def _starts_with(e, args):
+    col = args[0]
+    if not isinstance(e.args[1], ir.Literal):
+        raise NotImplementedError("starts_with with non-literal prefix")
+    prefix = str(e.args[1].value)
+    return _dict_predicate(
+        col, lambda d: np.array([str(s).startswith(prefix) for s in d]))
+
+
+@scalar("strpos")
+def _strpos(e, args):
+    col = args[0]
+    if not isinstance(e.args[1], ir.Literal):
+        raise NotImplementedError("strpos with non-literal needle")
+    needle = str(e.args[1].value)
+    lut = jnp.asarray(np.array(
+        [str(s).find(needle) + 1 for s in col.dictionary], np.int64))
+    return Val(e.dtype, lut[col.data], col.valid)
 
 
 @scalar("coalesce")
@@ -641,6 +714,117 @@ def _coalesce(e, args):
 def _abs(e, args):
     (a,) = args
     return Val(e.dtype, jnp.abs(a.data), a.valid)
+
+
+def _as_f64(v: Val):
+    return cast_val(v, T.DOUBLE).data
+
+
+def _mathfn(name, op, arity=1):
+    """DOUBLE-valued math function (reference MathFunctions.java)."""
+    @scalar(name)
+    def _f(e, args, _op=op, _n=arity):
+        if _n == 1:
+            (a,) = args
+            return Val(e.dtype, _op(_as_f64(a)), a.valid)
+        a, b = args
+        return Val(e.dtype, _op(_as_f64(a), _as_f64(b)),
+                   and_valid(a.valid, b.valid))
+    return _f
+
+
+_mathfn("sqrt", jnp.sqrt)
+_mathfn("cbrt", jnp.cbrt)
+_mathfn("exp", jnp.exp)
+_mathfn("ln", jnp.log)
+_mathfn("log10", jnp.log10)
+_mathfn("log2", jnp.log2)
+_mathfn("floor", jnp.floor)
+_mathfn("ceiling", jnp.ceil)
+_mathfn("ceil", jnp.ceil)
+_mathfn("truncate", jnp.trunc)
+_mathfn("power", jnp.power, arity=2)
+_mathfn("pow", jnp.power, arity=2)
+
+
+@scalar("sign")
+def _sign(e, args):
+    (a,) = args
+    return Val(e.dtype, jnp.sign(a.data).astype(a.data.dtype), a.valid)
+
+
+@scalar("mod")
+def _mod_alias(e, args):
+    return _mod(e, args)
+
+
+@scalar("greatest")
+@scalar("least")
+def _greatest_least(e, args):
+    # NULL if any argument is NULL (reference semantics)
+    op = jnp.maximum if e.fn == "greatest" else jnp.minimum
+    if any(a.is_string for a in args):
+        # merged dictionary is sorted, so codes are collation-ordered
+        out = args[0]
+        valid = out.valid
+        for v in args[1:]:
+            v, out = _merge_dicts(v, out)
+            valid = and_valid(valid, v.valid)
+            out = Val(e.dtype, op(out.data, v.data), None,
+                      out.dictionary)
+        return Val(e.dtype, out.data, valid, out.dictionary)
+    out = cast_val(args[0], e.dtype)
+    valid = out.valid
+    for v in args[1:]:
+        v = cast_val(v, e.dtype)
+        out = Val(e.dtype, op(out.data, v.data), None)
+        valid = and_valid(valid, v.valid)
+    return Val(e.dtype, out.data, valid)
+
+
+@scalar("nullif")
+def _nullif(e, args):
+    a, b = args
+    eqv = _compare(ir.Call(T.BOOLEAN, "eq", e.args), args,
+                   lambda x, y: x == y, lambda x, y: x == y)
+    both = eqv.data if eqv.valid is None else (eqv.data & eqv.valid)
+    valid = (jnp.ones_like(both) if a.valid is None else a.valid) & ~both
+    return Val(e.dtype, a.data, valid, a.dictionary)
+
+
+@scalar("quarter")
+def _quarter(e, args):
+    (a,) = args
+    _, m, _ = _civil_from_days(a.data)
+    return Val(e.dtype, (m - 1) // 3 + 1, a.valid)
+
+
+@scalar("day_of_week")
+def _day_of_week(e, args):
+    # ISO: Monday=1..Sunday=7; epoch 1970-01-01 was a Thursday
+    (a,) = args
+    dow = (a.data.astype(jnp.int64) + 3) % 7 + 1
+    return Val(e.dtype, dow, a.valid)
+
+
+@scalar("day_of_year")
+def _day_of_year(e, args):
+    (a,) = args
+    y, _, _ = _civil_from_days(a.data)
+    jan1 = _days_from_civil(y, jnp.ones_like(y), jnp.ones_like(y))
+    return Val(e.dtype, a.data.astype(jnp.int64) - jan1 + 1, a.valid)
+
+
+@scalar("week")
+def _week(e, args):
+    # ISO week number of the year (reference week_of_year)
+    (a,) = args
+    d = a.data.astype(jnp.int64)
+    # Thursday of this row's ISO week determines the ISO year
+    thursday = d - ((d + 3) % 7) + 3
+    y, _, _ = _civil_from_days(thursday)
+    jan1 = _days_from_civil(y, jnp.ones_like(y), jnp.ones_like(y))
+    return Val(e.dtype, (thursday - jan1) // 7 + 1, a.valid)
 
 
 @scalar("round")

@@ -39,7 +39,11 @@ class SemanticError(Exception):
     pass
 
 
-AGG_FUNCTIONS = {"count", "sum", "avg", "min", "max", "arbitrary"}
+AGG_FUNCTIONS = {"count", "sum", "avg", "min", "max", "arbitrary",
+                 "count_if", "bool_and", "bool_or", "every",
+                 "variance", "var_samp", "var_pop",
+                 "stddev", "stddev_samp", "stddev_pop",
+                 "geometric_mean", "approx_distinct"}
 
 _COMPARISONS = {"=": "eq", "<>": "neq", "<": "lt", "<=": "lte",
                 ">": "gt", ">=": "gte"}
@@ -383,11 +387,32 @@ class ExprPlanner:
                 out_t = T.common_super_type(out_t, a.dtype)
             return ir.Call(out_t, "coalesce", args)
         if name in ("lower", "upper", "substring", "concat", "trim",
-                    "ltrim", "rtrim", "replace"):
+                    "ltrim", "rtrim", "replace", "reverse"):
             return ir.Call(T.VARCHAR, name, args)
-        if name == "length":
+        if name in ("length", "strpos", "quarter", "day_of_week",
+                    "day_of_year", "week", "week_of_year", "dow", "doy"):
+            name = {"week_of_year": "week", "dow": "day_of_week",
+                    "doy": "day_of_year"}.get(name, name)
             return ir.Call(T.BIGINT, name, args)
+        if name == "starts_with":
+            return ir.Call(T.BOOLEAN, name, args)
         if name == "abs":
+            return ir.Call(args[0].dtype, name, args)
+        if name == "sign":
+            # sign of a decimal is a plain integer +-1/0, not a scaled
+            # value in the argument's decimal domain
+            out_t = (T.BIGINT if isinstance(args[0].dtype, T.DecimalType)
+                     else args[0].dtype)
+            return ir.Call(out_t, name, args)
+        if name in ("mod",):
+            out_t = T.common_super_type(args[0].dtype, args[1].dtype)
+            return ir.Call(out_t, "mod", args)
+        if name in ("greatest", "least"):
+            out_t = args[0].dtype
+            for a in args[1:]:
+                out_t = T.common_super_type(out_t, a.dtype)
+            return ir.Call(out_t, name, args)
+        if name == "nullif":
             return ir.Call(args[0].dtype, name, args)
         if name == "round":
             a = args[0]
@@ -398,8 +423,8 @@ class ExprPlanner:
                 out = T.DecimalType(18, min(a.dtype.scale, max(digits, 0)))
                 return ir.Call(out, "round", args)
             return ir.Call(a.dtype, "round", args)
-        if name in ("sqrt", "floor", "ceil", "ceiling", "power", "exp",
-                    "ln", "log10"):
+        if name in ("sqrt", "cbrt", "floor", "ceil", "ceiling", "power",
+                    "pow", "exp", "ln", "log10", "log2", "truncate"):
             return ir.Call(T.DOUBLE, name, args)
         raise SemanticError(f"unknown function {name}")
 
@@ -1367,9 +1392,21 @@ class LogicalPlanner:
 
         aggs: dict[str, AggCall] = {}
         agg_syms: dict[A.FunctionCall, tuple[str, T.DataType]] = {}
-        distinct_calls = [c for c in agg_calls if c.distinct]
+
+        def _is_distinct(c: A.FunctionCall) -> bool:
+            # approx_distinct(x) runs as an EXACT distinct count: the
+            # hash machinery already dedupes exactly, so the "estimate"
+            # has zero error (within the reference's 2.3% default
+            # epsilon, ApproximateCountDistinctAggregation); a sketch
+            # (HLL registers as segment-max states) can replace this
+            # when partial-state width matters
+            return c.distinct or c.name == "approx_distinct"
+
+        distinct_calls = [c for c in agg_calls if _is_distinct(c)]
         for call in agg_calls:
             fn = call.name
+            if fn == "approx_distinct":
+                fn = "count"
             if call.is_star or (fn == "count" and not call.args):
                 fn = "count_star"
                 arg_ir = None
@@ -1382,7 +1419,7 @@ class LogicalPlanner:
                 arg_t = arg_ir.dtype
             out_t = AGG.output_type(fn, arg_t)
             sym = self.symbols.fresh(fn)
-            aggs[sym] = AggCall(fn, arg_ir, out_t, call.distinct)
+            aggs[sym] = AggCall(fn, arg_ir, out_t, _is_distinct(call))
             agg_syms[call] = (sym, out_t)
 
         gsets = self._resolve_grouping_sets(spec)
@@ -1403,7 +1440,7 @@ class LogicalPlanner:
             # sql/planner/QueryPlanner + MarkDistinctOperator.java).
             mark_for_arg: dict[str, str] = {}
             for call in agg_calls:
-                if not call.distinct:
+                if not _is_distinct(call):
                     continue
                 sym, out_t = agg_syms[call]
                 acall = aggs[sym]

@@ -1,0 +1,98 @@
+"""Post-optimization plan invariant validation.
+
+Analog of the reference's PlanSanityChecker pipeline
+(sql/planner/sanity/PlanSanityChecker.java, TypeValidator.java,
+ValidateDependenciesChecker): every optimized plan is walked before
+execution and structural invariants are enforced, so planner/optimizer
+bugs surface as PlanSanityError at plan time instead of as trace-time
+KeyErrors or silently wrong kernels.
+"""
+
+from __future__ import annotations
+
+from presto_tpu.expr import ir
+from presto_tpu.plan import nodes as N
+
+
+class PlanSanityError(RuntimeError):
+    pass
+
+
+def _refs(*exprs) -> set[str]:
+    return ir.referenced_columns([e for e in exprs if e is not None])
+
+
+def validate_plan(plan: N.PlanNode) -> None:
+    """Raise PlanSanityError on the first violated invariant."""
+
+    def fail(node, msg):
+        raise PlanSanityError(f"{type(node).__name__}: {msg}")
+
+    def visit(node: N.PlanNode) -> dict:
+        child_types = [visit(s) for s in node.sources()]
+
+        def need(syms, available, what):
+            missing = set(syms) - set(available)
+            if missing:
+                fail(node, f"{what} references unknown columns "
+                           f"{sorted(missing)}")
+
+        if isinstance(node, N.Filter):
+            need(_refs(node.predicate), child_types[0], "predicate")
+        elif isinstance(node, N.Project):
+            for sym, e in node.assignments.items():
+                need(_refs(e), child_types[0], f"assignment {sym}")
+        elif isinstance(node, N.Aggregate):
+            need(node.group_keys, child_types[0], "group keys")
+            for sym, call in node.aggs.items():
+                if node.step != N.AggStep.FINAL:
+                    need(_refs(call.arg), child_types[0],
+                         f"aggregate {sym}")
+                    if call.mask is not None:
+                        need([call.mask], child_types[0],
+                             f"aggregate mask of {sym}")
+        elif isinstance(node, N.Join):
+            lt, rt = child_types
+            need([a for a, _ in node.criteria], lt, "probe keys")
+            need([b for _, b in node.criteria], rt, "build keys")
+            need(_refs(node.filter), {**lt, **rt}, "join filter")
+            if not node.criteria and node.filter is None:
+                fail(node, "equi-join with no criteria")
+        elif isinstance(node, N.SemiJoin):
+            need(node.source_keys, child_types[0], "source keys")
+            need(node.filter_keys, child_types[1], "filter keys")
+        elif isinstance(node, N.MarkDistinct):
+            need(node.keys, child_types[0], "mark keys")
+        elif isinstance(node, (N.Sort, N.TopN)):
+            need([o.symbol for o in node.orderings], child_types[0],
+                 "orderings")
+        elif isinstance(node, N.Window):
+            need(node.partition_by, child_types[0], "partition keys")
+            need([o.symbol for o in node.orderings], child_types[0],
+                 "window orderings")
+            for sym, call in node.functions.items():
+                need(_refs(*call.args), child_types[0],
+                     f"window function {sym}")
+        elif isinstance(node, N.Exchange):
+            need(node.partition_keys, child_types[0], "partition keys")
+        elif isinstance(node, N.Union):
+            for m, inp_types in zip(node.mappings, child_types):
+                for out_sym, in_sym in m.items():
+                    if in_sym not in inp_types:
+                        fail(node, f"union maps {out_sym} from unknown "
+                                   f"column {in_sym}")
+        elif isinstance(node, N.Output):
+            need(node.symbols, child_types[0], "output columns")
+            if len(node.names) != len(node.symbols):
+                fail(node, "output name/symbol arity mismatch")
+
+        try:
+            types = node.output_types()
+        except Exception as exc:  # malformed node
+            fail(node, f"output_types failed: {exc}")
+        out_syms = list(node.output_symbols)
+        if set(out_syms) - set(types):
+            fail(node, "output_symbols not covered by output_types")
+        return types
+
+    visit(plan)

@@ -206,6 +206,9 @@ def _expr(e: A.Expression) -> str:
         return out + ")"
     if isinstance(e, A.FunctionCall):
         d = "DISTINCT " if e.distinct else ""
+        if e.name == "concat" and not e.is_star:
+            # sqlite spells string concatenation ||
+            return "(" + " || ".join(_expr(a) for a in e.args) + ")"
         args = "*" if e.is_star else ", ".join(_expr(a) for a in e.args)
         name = {"substring": "substr", "arbitrary": "max"}.get(
             e.name, e.name)
