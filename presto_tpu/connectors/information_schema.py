@@ -1,0 +1,146 @@
+"""information_schema + system catalogs: engine metadata as tables.
+
+Analog of the reference's engine-side virtual catalogs
+(connector/informationschema/InformationSchemaMetadata.java +
+connector/system/* — NodeSystemTable, QuerySystemTable, and the
+information_schema page sources). Both connectors reflect the LIVE
+engine state on every scan: registering a catalog or running a query is
+immediately visible in the next SELECT.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from presto_tpu import types as T
+from presto_tpu.block import Table
+from presto_tpu.connectors.base import Connector, TableStats
+
+
+def _make_table(schema: dict, rows: list[tuple]) -> Table:
+    cols = {}
+    for i, (name, dtype) in enumerate(schema.items()):
+        vals = [r[i] for r in rows]
+        if isinstance(dtype, T.VarcharType):
+            cols[name] = np.array(vals, dtype=object)
+        else:
+            cols[name] = np.asarray(vals, dtype=dtype.physical_dtype)
+    return Table.from_numpy(schema, cols)
+
+
+class _ReflectiveConnector(Connector):
+    """Shared plumbing: schemas are static, rows are produced fresh per
+    scan from the engine."""
+
+    SCHEMAS: dict[str, dict[str, T.DataType]] = {}
+
+    def __init__(self, engine):
+        self.engine = engine
+
+    def table_names(self) -> list[str]:
+        return list(self.SCHEMAS)
+
+    def table_schema(self, name: str):
+        return self.SCHEMAS[name]
+
+    def table(self, name: str) -> Table:
+        return _make_table(self.SCHEMAS[name], self._rows(name))
+
+    def row_count_estimate(self, name: str) -> int:
+        return max(len(self._rows(name)), 1)
+
+    def stats(self, name: str) -> TableStats:
+        return TableStats(row_count=self.row_count_estimate(name))
+
+    def _rows(self, name: str) -> list[tuple]:
+        raise NotImplementedError
+
+
+class InformationSchemaConnector(_ReflectiveConnector):
+    """Catalog `information_schema` (reference
+    connector/informationschema; the 2-part name model plays the role
+    of the per-catalog schema)."""
+
+    name = "information_schema"
+
+    SCHEMAS = {
+        "schemata": {
+            "catalog_name": T.VARCHAR, "schema_name": T.VARCHAR,
+        },
+        "tables": {
+            "table_catalog": T.VARCHAR, "table_schema": T.VARCHAR,
+            "table_name": T.VARCHAR, "table_type": T.VARCHAR,
+        },
+        "columns": {
+            "table_catalog": T.VARCHAR, "table_schema": T.VARCHAR,
+            "table_name": T.VARCHAR, "column_name": T.VARCHAR,
+            "ordinal_position": T.BIGINT, "data_type": T.VARCHAR,
+            "is_nullable": T.VARCHAR,
+        },
+    }
+
+    def _user_catalogs(self):
+        return {name: c for name, c in self.engine.catalogs.items()
+                if not isinstance(c, _ReflectiveConnector)}
+
+    def _rows(self, name: str) -> list[tuple]:
+        if name == "schemata":
+            return [(cat, "default")
+                    for cat in sorted(self._user_catalogs())]
+        if name == "tables":
+            return [(cat, "default", t, "BASE TABLE")
+                    for cat, conn in sorted(self._user_catalogs().items())
+                    for t in sorted(conn.table_names())]
+        if name == "columns":
+            rows = []
+            for cat, conn in sorted(self._user_catalogs().items()):
+                for t in sorted(conn.table_names()):
+                    for i, (col, dtype) in enumerate(
+                            conn.table_schema(t).items()):
+                        rows.append((cat, "default", t, col, i + 1,
+                                     str(dtype), "YES"))
+            return rows
+        raise KeyError(name)
+
+
+class SystemConnector(_ReflectiveConnector):
+    """Catalog `system`: runtime tables (reference connector/system
+    NodeSystemTable, QuerySystemTable, and a session-properties table
+    mirroring the jdbc/metadata ones)."""
+
+    name = "system"
+
+    SCHEMAS = {
+        "nodes": {
+            "node_id": T.VARCHAR, "http_uri": T.VARCHAR,
+            "node_version": T.VARCHAR, "coordinator": T.VARCHAR,
+            "state": T.VARCHAR,
+        },
+        "queries": {
+            "query_id": T.VARCHAR, "state": T.VARCHAR,
+            "user": T.VARCHAR, "query": T.VARCHAR,
+            "output_rows": T.BIGINT, "wall_ms": T.BIGINT,
+            "error": T.VARCHAR,
+        },
+        "session_properties": {
+            "name": T.VARCHAR, "value": T.VARCHAR,
+            "default": T.VARCHAR, "type": T.VARCHAR,
+            "description": T.VARCHAR,
+        },
+    }
+
+    def _rows(self, name: str) -> list[tuple]:
+        if name == "nodes":
+            return [("local", "local://0", "presto-tpu", "true",
+                     "active")]
+        if name == "queries":
+            return [(e.query_id, e.state, e.user, e.sql,
+                     e.output_rows, int(e.elapsed_ms), e.error or "")
+                    for e in self.engine.events.history]
+        if name == "session_properties":
+            from presto_tpu.session import SYSTEM_SESSION_PROPERTIES
+            return [(n, str(self.engine.session.get(n)), str(d),
+                     t.__name__, desc)
+                    for n, (d, t, desc) in sorted(
+                        SYSTEM_SESSION_PROPERTIES.items())]
+        raise KeyError(name)

@@ -22,11 +22,22 @@ from presto_tpu.session import SYSTEM_SESSION_PROPERTIES, Session
 
 class Engine:
     def __init__(self, session: Session | None = None):
+        from presto_tpu.connectors.information_schema import (
+            InformationSchemaConnector, SystemConnector)
+        from presto_tpu.events import EventListenerManager
+
         self.session = session or Session()
         self.catalogs: dict[str, Connector] = {}
         # populated by the spill driver when a query exceeds the memory
         # budget and runs host-partitioned (exec/spill.py)
         self.last_spill: dict | None = None
+        # query lifecycle events + history (events.py)
+        self.events = EventListenerManager()
+        # engine-owned virtual catalogs (reference information_schema +
+        # system connectors are engine-side, not plugins)
+        self.catalogs["information_schema"] = \
+            InformationSchemaConnector(self)
+        self.catalogs["system"] = SystemConnector(self)
 
     def register_catalog(self, name: str, connector: Connector) -> None:
         self.catalogs[name] = connector
@@ -40,19 +51,26 @@ class Engine:
         from presto_tpu.sql import ast as A
         from presto_tpu.sql.parser import parse_statement
 
+        from presto_tpu.events import monitored
+
         stmt = parse_statement(sql)
         if isinstance(stmt, A.QueryStatement):
-            return self._execute_query(stmt.query, mesh).to_pylist()
-        return self._execute_statement(stmt, mesh)
+            return monitored(
+                self, sql,
+                lambda: self._execute_query(stmt.query, mesh).to_pylist())
+        return monitored(
+            self, sql, lambda: self._execute_statement(stmt, mesh))
 
     def execute_table(self, sql: str, mesh=None) -> Table:
+        from presto_tpu.events import monitored
         from presto_tpu.sql import ast as A
         from presto_tpu.sql.parser import parse_statement
 
         stmt = parse_statement(sql)
         if not isinstance(stmt, A.QueryStatement):
             raise ValueError("execute_table expects a SELECT query")
-        return self._execute_query(stmt.query, mesh)
+        return monitored(
+            self, sql, lambda: self._execute_query(stmt.query, mesh))
 
     def plan_sql(self, sql: str):
         from presto_tpu.sql.parser import parse_statement

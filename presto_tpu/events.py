@@ -1,0 +1,95 @@
+"""Query lifecycle events + pluggable listeners.
+
+Analog of the reference's event system (event/QueryMonitor.java:134,210
+queryCreatedEvent/queryCompletedEvent -> EventListenerManager -> SPI
+spi/eventlistener/EventListener.java): the engine emits a created event
+when a query is admitted and a completed event with statistics when it
+finishes; listeners are plain callables registered on the engine.
+Recent completed events also back the system.runtime.queries table.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from collections import deque
+from typing import Callable
+
+
+@dataclasses.dataclass
+class QueryCreatedEvent:
+    query_id: str
+    sql: str
+    user: str
+    create_time: float
+
+
+@dataclasses.dataclass
+class QueryCompletedEvent:
+    query_id: str
+    sql: str
+    user: str
+    state: str           # FINISHED | FAILED
+    create_time: float
+    end_time: float
+    output_rows: int
+    error: str | None = None
+
+    @property
+    def elapsed_ms(self) -> float:
+        return (self.end_time - self.create_time) * 1000.0
+
+
+class EventListenerManager:
+    """Dispatches lifecycle events to registered listeners and keeps a
+    bounded history for system.runtime.queries (reference
+    EventListenerManager + QuerySystemTable)."""
+
+    def __init__(self, history: int = 1000):
+        self._listeners: list[Callable] = []
+        self.history: deque = deque(maxlen=history)
+        self._seq = 0
+
+    def add_listener(self, fn: Callable) -> None:
+        self._listeners.append(fn)
+
+    def next_query_id(self) -> str:
+        self._seq += 1
+        return f"q_{self._seq:08d}"
+
+    def query_created(self, event: QueryCreatedEvent) -> None:
+        self._emit(event)
+
+    def query_completed(self, event: QueryCompletedEvent) -> None:
+        self.history.append(event)
+        self._emit(event)
+
+    def _emit(self, event) -> None:
+        for fn in self._listeners:
+            try:
+                fn(event)
+            except Exception:
+                # a broken listener must not fail the query (reference
+                # EventListenerManager swallows listener errors too)
+                pass
+
+
+def monitored(engine, sql: str, run: Callable):
+    """Run ``run()`` under query monitoring: emits created/completed
+    events and records history. Returns run()'s result."""
+    mgr: EventListenerManager = engine.events
+    qid = mgr.next_query_id()
+    t0 = time.time()
+    mgr.query_created(QueryCreatedEvent(qid, sql, engine.session.user, t0))
+    try:
+        result = run()
+    except Exception as exc:
+        mgr.query_completed(QueryCompletedEvent(
+            qid, sql, engine.session.user, "FAILED", t0, time.time(),
+            0, error=f"{type(exc).__name__}: {exc}"))
+        raise
+    rows = len(result) if isinstance(result, list) else \
+        getattr(result, "nrows", 0)
+    mgr.query_completed(QueryCompletedEvent(
+        qid, sql, engine.session.user, "FINISHED", t0, time.time(), rows))
+    return result

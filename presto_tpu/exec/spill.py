@@ -247,9 +247,9 @@ def try_execute_spilled(engine, plan: N.PlanNode):
     # uniform padded partition shapes -> the join compiles ONCE and the
     # same program runs for every partition (reference unspill replays
     # one operator pipeline per spilled partition too)
-    live_parts = [p for p in range(nparts)
-                  if int((ppart == p).sum()) > 0]
-    pmax = max((int((ppart == p).sum()) for p in live_parts), default=1)
+    pcounts = np.bincount(ppart[ppart >= 0], minlength=nparts)
+    live_parts = [p for p in range(nparts) if pcounts[p] > 0]
+    pmax = max(int(pcounts.max()), 1)
     bmax = max(int(np.bincount(bpart[bpart >= 0], minlength=nparts)
                    .max()), 1)
     part_inputs = []

@@ -355,9 +355,19 @@ def _div(e, args):
 @scalar("modulus")
 def _mod(e, args):
     a, b = args
-    safe = jnp.where(b.data == 0, 1, b.data)
-    return Val(e.dtype, a.data % safe,
-               and_valid(a.valid, b.valid, b.data != 0))
+    if isinstance(e.dtype, T.DoubleType):
+        a, b = cast_val(a, T.DOUBLE), cast_val(b, T.DOUBLE)
+    elif isinstance(a.dtype, T.DecimalType) or \
+            isinstance(b.dtype, T.DecimalType):
+        # align scales: (a*f) mod (b*f) = f*(a mod b), so the scaled-
+        # int result is already at the common scale of e.dtype
+        a, b, _ = _decimal_align(a, b)
+    safe = jnp.where(b.data == 0, jnp.ones_like(b.data), b.data)
+    out = a.data % safe
+    nz = b.data != 0
+    if getattr(nz, "ndim", 1) == 0 and getattr(out, "ndim", 0) > 0:
+        nz = jnp.broadcast_to(nz, out.shape)  # literal divisor
+    return Val(e.dtype, out, and_valid(a.valid, b.valid, nz))
 
 
 @scalar("negate")

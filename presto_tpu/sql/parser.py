@@ -128,6 +128,13 @@ class Parser:
                 return A.ExplainStatement(stmt, analyze, fmt)
             if t.value == "show":
                 return self._show_statement()
+            if t.value in ("describe", "desc"):
+                # DESCRIBE t == SHOW COLUMNS FROM t (the reference
+                # desugars it in sql/rewrite/DescribeInputRewrite-land)
+                self.advance()
+                table = self.qualified_name()
+                self.expect_eof()
+                return A.ShowColumns(table)
             if t.value == "set":
                 self.advance()
                 self.expect_keyword("session")

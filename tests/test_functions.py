@@ -129,3 +129,12 @@ def test_variance_numerically_stable_with_large_mean(eng):
     shifted, plain = got[0]
     assert plain > 0
     assert abs(shifted - plain) / plain < 1e-6, (shifted, plain)
+
+
+def test_mod_decimal_alignment(eng):
+    """mod over mixed decimal/integer args must align scales: physical
+    scaled ints modded against raw ints were off by 10^scale."""
+    (row,) = eng.execute(
+        "select mod(l_quantity, 7), l_quantity from lineitem "
+        "where l_orderkey = 1 and l_linenumber = 1")
+    assert abs(row[0] - (row[1] % 7)) < 1e-9

@@ -1,0 +1,80 @@
+"""information_schema + system catalogs, DESCRIBE, and query events
+(reference connector/informationschema, connector/system/*,
+event/QueryMonitor.java:134 + spi/eventlistener)."""
+
+import pytest
+
+from presto_tpu import Engine
+from presto_tpu.events import QueryCompletedEvent, QueryCreatedEvent
+
+
+@pytest.fixture()
+def eng(tpch_tiny):
+    e = Engine()
+    e.register_catalog("tpch", tpch_tiny)
+    return e
+
+
+def test_information_schema_tables(eng):
+    rows = eng.execute(
+        "select table_name from information_schema.tables "
+        "where table_catalog = 'tpch' order by table_name")
+    assert ("lineitem",) in rows and ("region",) in rows
+    assert len(rows) == 8
+
+
+def test_information_schema_columns_joinable(eng):
+    rows = eng.execute(
+        "select t.table_name, count(*) as ncols "
+        "from information_schema.tables t, information_schema.columns c "
+        "where t.table_name = c.table_name and t.table_catalog = 'tpch' "
+        "group by t.table_name order by t.table_name")
+    by_name = dict(rows)
+    assert by_name["region"] == 3
+    assert by_name["lineitem"] == 16
+
+
+def test_describe_matches_show_columns(eng):
+    assert eng.execute("describe region") == \
+        eng.execute("show columns from region")
+    assert eng.execute("desc region")[0][0] == "r_regionkey"
+
+
+def test_system_runtime_queries_records_history(eng):
+    eng.execute("select count(*) from region")
+    with pytest.raises(Exception):
+        eng.execute("select no_such_column from region")
+    rows = eng.execute(
+        "select state, output_rows from system.runtime.queries "
+        "order by query_id")
+    # the failed query and the successful one are both recorded; the
+    # system.runtime.queries scan itself is the running query
+    states = [r[0] for r in rows]
+    assert "FINISHED" in states and "FAILED" in states
+
+
+def test_event_listeners_see_lifecycle(eng):
+    events = []
+    eng.events.add_listener(events.append)
+    eng.execute("select count(*) from region")
+    kinds = [type(e).__name__ for e in events]
+    assert kinds == ["QueryCreatedEvent", "QueryCompletedEvent"]
+    done = events[1]
+    assert isinstance(done, QueryCompletedEvent)
+    assert done.state == "FINISHED" and done.output_rows == 1
+    assert done.elapsed_ms >= 0 and done.query_id == events[0].query_id
+
+
+def test_broken_listener_does_not_fail_query(eng):
+    def bad(_event):
+        raise RuntimeError("boom")
+    eng.events.add_listener(bad)
+    assert eng.execute("select count(*) from region") == [(5,)]
+
+
+def test_session_properties_table_reflects_set_session(eng):
+    eng.execute("set session distributed_sort = false")
+    rows = eng.execute(
+        "select value from system.runtime.session_properties "
+        "where name = 'distributed_sort'")
+    assert rows == [("False",)]

@@ -18,7 +18,8 @@ def eng(tpch_tiny):
 
 
 def test_show_catalogs(eng):
-    assert eng.execute("show catalogs") == [("memory",), ("tpch",)]
+    assert eng.execute("show catalogs") == [
+        ("information_schema",), ("memory",), ("system",), ("tpch",)]
 
 
 def test_show_tables(eng):
