@@ -58,3 +58,16 @@ class Connector:
         the single-match hash-join fast path (reference JoinNode's
         maySkipOutputDuplicates analog)."""
         return []
+
+    def delete_rows(self, name: str, mask) -> int:
+        """Delete rows where mask is true (None = all); returns the
+        deleted count. Analog of spi row-level delete
+        (ConnectorMetadata beginDelete + DeleteOperator)."""
+        raise NotImplementedError(
+            f"connector {self.name} does not support DELETE")
+
+    def update_rows(self, name: str, values, valids, mask) -> int:
+        """Assign values[col] on rows where mask is true (None = all);
+        returns the updated count. Analog of spi UpdateOperator."""
+        raise NotImplementedError(
+            f"connector {self.name} does not support UPDATE")

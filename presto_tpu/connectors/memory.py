@@ -57,6 +57,37 @@ class MemoryConnector(Connector):
                 self._valid[name][c] = np.concatenate(
                     [old_valid, new_valid])
 
+    def delete_rows(self, name: str, mask) -> int:
+        n = len(next(iter(self._data[name].values()), []))
+        if mask is None:
+            mask = np.ones(n, dtype=bool)
+        keep = ~np.asarray(mask)
+        for c in self._schemas[name]:
+            self._data[name][c] = self._data[name][c][keep]
+            v = self._valid[name].get(c)
+            if v is not None:
+                self._valid[name][c] = v[keep]
+        return int(mask.sum())
+
+    def update_rows(self, name: str, values, valids, mask) -> int:
+        n = len(next(iter(self._data[name].values()), []))
+        if mask is None:
+            mask = np.ones(n, dtype=bool)
+        m = np.asarray(mask)
+        for c, new in values.items():
+            is_str = isinstance(self._schemas[name][c], T.VarcharType)
+            arr = self._data[name][c]
+            arr[m] = np.asarray(new, dtype=object if is_str else None)[m]
+            nv = None if valids is None else valids.get(c)
+            old_v = self._valid[name].get(c)
+            if nv is not None or old_v is not None:
+                if old_v is None:
+                    old_v = np.ones(n, dtype=bool)
+                new_v = nv if nv is not None else np.ones(n, dtype=bool)
+                old_v[m] = np.asarray(new_v)[m]
+                self._valid[name][c] = old_v
+        return int(m.sum())
+
     def drop_table(self, name: str) -> None:
         self._schemas.pop(name, None)
         self._data.pop(name, None)

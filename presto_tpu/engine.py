@@ -181,6 +181,43 @@ class Engine:
             conn.insert(table, renamed, revalid)
             return [(len(next(iter(data.values()), [])),)]
 
+        if isinstance(stmt, A.DeleteStatement):
+            # evaluate the predicate per row in table order and hand the
+            # connector a delete mask (reference DeleteOperator +
+            # ConnectorPageSink rowId delete, trimmed to the host-table
+            # connectors this engine mutates in place)
+            catalog, table = self._resolve_table(stmt.table)
+            conn = self._connector(catalog)
+            mask = self._row_mask(stmt.table, stmt.where, mesh)
+            return [(conn.delete_rows(table, mask),)]
+
+        if isinstance(stmt, A.UpdateStatement):
+            import numpy as np
+
+            catalog, table = self._resolve_table(stmt.table)
+            conn = self._connector(catalog)
+            target = conn.table_schema(table)
+            # one scan computes the new values AND the WHERE mask, so
+            # both come from the same row order
+            items = []
+            for col, expr in stmt.assignments:
+                if col not in target:
+                    raise ValueError(f"unknown column {col}")
+                items.append(A.SelectItem(
+                    A.CastExpression(expr, str(target[col])), col))
+            pred = (A.BooleanLiteral(True) if stmt.where is None
+                    else A.FunctionCall(
+                        "coalesce", (stmt.where, A.BooleanLiteral(False))))
+            items.append(A.SelectItem(pred, "__pred__"))
+            q = A.Query(A.QuerySpec(tuple(items), False,
+                                    A.TableRef(stmt.table)))
+            result = self._execute_query(q, mesh)
+            _, data, valid = _table_to_host(result)
+            mask = np.asarray(data["__pred__"], dtype=bool)
+            values = {col: data[col] for col, _ in stmt.assignments}
+            valids = {col: valid[col] for col, _ in stmt.assignments}
+            return [(conn.update_rows(table, values, valids, mask),)]
+
         if isinstance(stmt, A.DropTable):
             catalog, table = self._resolve_table(stmt.table)
             conn = self._connector(catalog)
@@ -193,6 +230,30 @@ class Engine:
 
         raise NotImplementedError(
             f"statement {type(stmt).__name__} not supported")
+
+    def _row_mask(self, table_parts, where, mesh):
+        """bool[n] in table row order: WHERE evaluates TRUE (NULL and
+        FALSE rows are untouched, SQL DELETE/UPDATE semantics); None
+        means every row."""
+        import numpy as np
+
+        from presto_tpu.sql import ast as A
+
+        if where is None:
+            return None
+        pred = A.FunctionCall(
+            "coalesce", (where, A.BooleanLiteral(False)))
+        q = A.Query(A.QuerySpec(
+            (A.SelectItem(pred, "__pred__"),), False,
+            A.TableRef(table_parts)))
+        result = self._execute_query(q, mesh)
+        col = next(iter(result.columns.values()))
+        data = np.asarray(col.data, dtype=bool)
+        if result.mask is not None:
+            # padded execution paths (distributed shards) interleave
+            # dead slots; compact to the real table rows
+            data = data[np.asarray(result.mask)]
+        return data
 
     def _connector(self, catalog: str) -> Connector:
         conn = self.catalogs.get(catalog)

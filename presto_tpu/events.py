@@ -88,8 +88,15 @@ def monitored(engine, sql: str, run: Callable):
             qid, sql, engine.session.user, "FAILED", t0, time.time(),
             0, error=f"{type(exc).__name__}: {exc}"))
         raise
-    rows = len(result) if isinstance(result, list) else \
-        getattr(result, "nrows", 0)
+    if isinstance(result, list):
+        rows = len(result)
+    else:
+        mask = getattr(result, "mask", None)
+        if mask is not None:
+            import numpy as np
+            rows = int(np.asarray(mask).sum())
+        else:
+            rows = getattr(result, "nrows", 0)
     mgr.query_completed(QueryCompletedEvent(
         qid, sql, engine.session.user, "FINISHED", t0, time.time(), rows))
     return result

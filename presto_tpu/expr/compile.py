@@ -363,7 +363,9 @@ def _mod(e, args):
         # int result is already at the common scale of e.dtype
         a, b, _ = _decimal_align(a, b)
     safe = jnp.where(b.data == 0, jnp.ones_like(b.data), b.data)
-    out = a.data % safe
+    # fmod truncates toward zero (result takes the dividend's sign) —
+    # SQL/reference mod semantics; % would floor-mod
+    out = jnp.fmod(a.data, safe)
     nz = b.data != 0
     if getattr(nz, "ndim", 1) == 0 and getattr(out, "ndim", 0) > 0:
         nz = jnp.broadcast_to(nz, out.shape)  # literal divisor

@@ -309,6 +309,19 @@ class QueryStatement(Statement):
 
 
 @dataclasses.dataclass(frozen=True)
+class DeleteStatement(Statement):
+    table: tuple[str, ...]
+    where: Optional[Expression] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class UpdateStatement(Statement):
+    table: tuple[str, ...]
+    assignments: tuple[tuple[str, Expression], ...]
+    where: Optional[Expression] = None
+
+
+@dataclasses.dataclass(frozen=True)
 class ExplainStatement(Statement):
     statement: Statement
     analyze: bool = False

@@ -166,6 +166,31 @@ class Parser:
                 q = self.query()
                 self.expect_eof()
                 return A.InsertStatement(table, columns, q)
+            if t.value == "delete":
+                self.advance()
+                self.expect_keyword("from")
+                table = self.qualified_name()
+                where = None
+                if self.accept_keyword("where"):
+                    where = self.expression()
+                self.expect_eof()
+                return A.DeleteStatement(table, where)
+            if t.value == "update":
+                self.advance()
+                table = self.qualified_name()
+                self.expect_keyword("set")
+                assigns = []
+                while True:
+                    col = self.identifier()
+                    self.expect_op("=")
+                    assigns.append((col, self.expression()))
+                    if not self.accept_op(","):
+                        break
+                where = None
+                if self.accept_keyword("where"):
+                    where = self.expression()
+                self.expect_eof()
+                return A.UpdateStatement(table, tuple(assigns), where)
             if t.value == "drop":
                 self.advance()
                 self.expect_keyword("table")

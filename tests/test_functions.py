@@ -138,3 +138,11 @@ def test_mod_decimal_alignment(eng):
         "select mod(l_quantity, 7), l_quantity from lineitem "
         "where l_orderkey = 1 and l_linenumber = 1")
     assert abs(row[0] - (row[1] % 7)) < 1e-9
+
+
+def test_mod_negative_dividend_truncates(eng):
+    """SQL mod takes the dividend's sign (truncated division), not
+    Python floor-mod."""
+    (row,) = eng.execute(
+        "select mod(-5, 3), mod(5, -3), mod(-5.0, 3.0), -5 % 3")
+    assert row == (-2, 2, -2.0, -2)
