@@ -20,8 +20,12 @@ from presto_tpu.connectors.base import Connector, TableStats
 class BlackholeConnector(Connector):
     name = "blackhole"
 
-    def __init__(self, rows_per_table: int = 0):
+    def __init__(self, rows_per_table: int = 0,
+                 page_processing_delay_s: float = 0.0):
         self.rows_per_table = rows_per_table
+        # synthetic scan latency (reference pageProcessingDelay) —
+        # makes deterministic slow queries for scheduler/admission tests
+        self.page_processing_delay_s = page_processing_delay_s
         self._schemas: dict[str, dict[str, T.DataType]] = {}
         self._rows: dict[str, int] = {}
         self.rows_written: dict[str, int] = {}
@@ -57,6 +61,9 @@ class BlackholeConnector(Connector):
         return self._schemas[name]
 
     def table(self, name: str) -> Table:
+        if self.page_processing_delay_s:
+            import time
+            time.sleep(self.page_processing_delay_s)
         schema = self._schemas[name]
         n = self._rows.get(name, self.rows_per_table)
         cols = {}

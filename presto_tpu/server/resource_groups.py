@@ -1,0 +1,124 @@
+"""Hierarchical resource groups: admission control for query dispatch.
+
+Analog of the reference's resource-group subsystem
+(execution/resourcegroups/InternalResourceGroup.java:77 — tree of
+groups with hardConcurrencyLimit/maxQueued and scheduling policies,
+selected per query by DispatchManager via selectGroup,
+dispatcher/DispatchManager.java:189). Queries over a group's
+concurrency limit queue FIFO ("fair" policy); a full queue rejects the
+query (QUERY_QUEUE_FULL).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+import threading
+from collections import deque
+from typing import Callable
+
+
+class QueryQueueFullError(RuntimeError):
+    """Reference QUERY_QUEUE_FULL error code analog."""
+
+
+@dataclasses.dataclass
+class GroupSpec:
+    """Static configuration of one group (the file-based resource-group
+    manager's JSON entries, plugin/trino-resource-group-managers)."""
+
+    name: str
+    hard_concurrency_limit: int = 16
+    max_queued: int = 1000
+    user_pattern: str | None = None  # selector regex over the user
+
+
+class InternalResourceGroup:
+    """Runtime state of one group: running count + FIFO queue."""
+
+    def __init__(self, spec: GroupSpec):
+        self.spec = spec
+        self.running = 0
+        self.queued: deque[Callable[[], None]] = deque()
+        self.total_admitted = 0
+        self._lock = threading.Lock()
+
+    def submit(self, start: Callable[[], None]) -> str:
+        """Admit or queue ``start``; returns "RUNNING" | "QUEUED".
+        ``start`` must arrange for finish() to be called exactly once
+        when the query leaves the group (admitted queries only)."""
+        with self._lock:
+            if self.running < self.spec.hard_concurrency_limit:
+                self.running += 1
+                self.total_admitted += 1
+                run_now = True
+            elif len(self.queued) >= self.spec.max_queued:
+                raise QueryQueueFullError(
+                    f"resource group '{self.spec.name}' queue is full "
+                    f"({self.spec.max_queued})")
+            else:
+                self.queued.append(start)
+                run_now = False
+        if run_now:
+            start()
+            return "RUNNING"
+        return "QUEUED"
+
+    def cancel_queued(self, start: Callable[[], None]) -> bool:
+        """Remove a still-queued submission so it stops occupying a
+        max_queued slot; returns False if it already started."""
+        with self._lock:
+            try:
+                self.queued.remove(start)
+                return True
+            except ValueError:
+                return False
+
+    def finish(self) -> None:
+        with self._lock:
+            nxt = None
+            if self.queued:
+                nxt = self.queued.popleft()
+                self.total_admitted += 1  # running slot transfers
+            else:
+                self.running -= 1
+        if nxt is not None:
+            nxt()
+
+    def info(self) -> dict:
+        with self._lock:
+            return {
+                "name": self.spec.name,
+                "hardConcurrencyLimit": self.spec.hard_concurrency_limit,
+                "maxQueued": self.spec.max_queued,
+                "running": self.running,
+                "queued": len(self.queued),
+                "totalAdmitted": self.total_admitted,
+            }
+
+
+class NoMatchingGroupError(RuntimeError):
+    """Reference QUERY_REJECTED (no selector matched) analog."""
+
+
+class ResourceGroupManager:
+    """Selects a group per (user, sql) and tracks all groups
+    (InternalResourceGroupManager + selector analog). First matching
+    user_pattern wins; a pattern-less group is a catch-all; a user no
+    group matches is rejected (the reference rejects queries no
+    selector claims)."""
+
+    def __init__(self, specs: list[GroupSpec] | None = None):
+        specs = specs or [GroupSpec("global")]
+        self.groups = [InternalResourceGroup(s) for s in specs]
+
+    def select(self, user: str, sql: str) -> InternalResourceGroup:
+        for g in self.groups:
+            pat = g.spec.user_pattern
+            if pat is None or re.fullmatch(pat, user):
+                return g
+        raise NoMatchingGroupError(
+            f"no resource group selector matches user '{user}'")
+
+    def info(self) -> list[dict]:
+        return [g.info() for g in self.groups]

@@ -81,69 +81,114 @@ def _json_value(v, dtype: T.DataType):
 
 
 class QueryManager:
-    """Dispatch + tracking (DispatchManager + QueryTracker analog)."""
+    """Dispatch + tracking (DispatchManager + QueryTracker analog).
+    Admission goes through resource groups: a query over its group's
+    concurrency limit waits QUEUED until a slot frees
+    (dispatcher/DispatchManager.java:189 selectGroup + submit)."""
 
-    def __init__(self, engine, max_concurrency: int = 4):
+    def __init__(self, engine, max_concurrency: int = 8,
+                 resource_groups=None):
+        from presto_tpu.server.resource_groups import ResourceGroupManager
+
         self.engine = engine
         self.queries: dict[str, QueryInfo] = {}
-        self.pool = ThreadPoolExecutor(max_workers=max_concurrency)
+        self.resource_groups = ResourceGroupManager(resource_groups)
+        # the pool must cover every group's concurrency allowance or
+        # group-admitted queries would serialize behind each other in
+        # the pool FIFO, defeating per-group isolation
+        workers = max(max_concurrency, min(64, sum(
+            g.spec.hard_concurrency_limit
+            for g in self.resource_groups.groups)))
+        self.pool = ThreadPoolExecutor(max_workers=workers)
         self.lock = threading.Lock()
+        self._tickets: dict[str, tuple] = {}  # qid -> (group, start_fn)
 
     def submit(self, sql: str, user: str) -> QueryInfo:
+        from presto_tpu.server.resource_groups import (
+            NoMatchingGroupError, QueryQueueFullError)
+
         qid = f"{time.strftime('%Y%m%d_%H%M%S')}_{uuid.uuid4().hex[:5]}"
         q = QueryInfo(qid, sql, user)
         with self.lock:
             self.queries[qid] = q
-        self.pool.submit(self._run, q)
+        try:
+            group = self.resource_groups.select(user, sql)
+
+            def start():
+                self.pool.submit(self._run, q, group)
+
+            self._tickets[qid] = (group, start)
+            group.submit(start)
+        except (QueryQueueFullError, NoMatchingGroupError) as e:
+            q.error = str(e)
+            q.state = "FAILED"
+            q.finished = time.monotonic()
         return q
 
-    def _run(self, q: QueryInfo) -> None:
-        if q.state == "CANCELED":
-            return
-        q.state = "RUNNING"
-        q.started = time.monotonic()
+    def _run(self, q: QueryInfo, group) -> None:
         try:
-            table_or_rows = self.engine.execute(q.sql)
-            plan_cols = self._result_columns(q.sql, table_or_rows)
-            q.columns = plan_cols[0]
-            dtypes = plan_cols[1]
-            q.rows = [
-                [_json_value(v, t) for v, t in zip(row, dtypes)]
-                for row in table_or_rows]
-            if q.state != "CANCELED":
-                q.state = "FINISHED"
-        except Exception as e:  # noqa: BLE001 - surfaced to the client
-            q.error = f"{type(e).__name__}: {e}"
-            q.state = "FAILED"
+            with self.lock:
+                if q.state == "CANCELED":
+                    return
+                q.state = "RUNNING"
+                q.started = time.monotonic()
+            try:
+                self._execute(q)
+                with self.lock:
+                    if q.state != "CANCELED":
+                        q.state = "FINISHED"
+            except Exception as e:  # noqa: BLE001 - surfaced to client
+                with self.lock:
+                    if q.state != "CANCELED":
+                        q.error = f"{type(e).__name__}: {e}"
+                        q.state = "FAILED"
+            finally:
+                q.finished = time.monotonic()
         finally:
-            q.finished = time.monotonic()
+            group.finish()
 
-    def _result_columns(self, sql: str, rows):
-        from presto_tpu.sql import ast as A
-        from presto_tpu.sql.parser import parse_statement
+    def _execute(self, q: QueryInfo) -> None:
+        """Plan once; queries return typed columns from the result
+        table itself (the old path re-parsed and re-planned after
+        execution just to name the columns)."""
         try:
-            stmt = parse_statement(sql)
-            if isinstance(stmt, A.QueryStatement):
-                plan, _ = self.engine.plan_sql(sql)
-                types = plan.output_types()
-                cols = [{"name": n, "type": str(types[s])}
-                        for n, s in zip(plan.names, plan.symbols)]
-                return cols, [types[s] for s in plan.symbols]
-        except Exception:  # noqa: BLE001
-            pass
-        width = len(rows[0]) if rows else 1
-        cols = [{"name": f"_col{i}", "type": "varchar"}
-                for i in range(width)]
-        return cols, [T.VARCHAR] * width
+            table = self.engine.execute_table(q.sql)
+        except ValueError as e:
+            if "execute_table expects" not in str(e):
+                raise
+            # non-query statement (execute_table rejects before work)
+            rows = self.engine.execute(q.sql)
+            width = len(rows[0]) if rows else 1
+            q.columns = [{"name": f"_col{i}", "type": "varchar"}
+                         for i in range(width)]
+            q.rows = [[_json_value(v, T.VARCHAR) for v in row]
+                      for row in rows]
+            return
+        q.columns = [{"name": n, "type": str(c.dtype)}
+                     for n, c in table.columns.items()]
+        dtypes = [c.dtype for c in table.columns.values()]
+        q.rows = [
+            [_json_value(v, t) for v, t in zip(row, dtypes)]
+            for row in table.to_pylist()]
 
     def get(self, qid: str) -> QueryInfo | None:
         return self.queries.get(qid)
 
     def cancel(self, qid: str) -> None:
         q = self.queries.get(qid)
-        if q is not None and q.state in ("QUEUED", "RUNNING"):
+        if q is None:
+            return
+        with self.lock:
+            if q.state not in ("QUEUED", "RUNNING"):
+                return
             q.state = "CANCELED"
             q.finished = time.monotonic()
+            ticket = self._tickets.get(qid)
+        if ticket is not None:
+            group, start = ticket
+            # a still-group-queued query frees its max_queued slot now;
+            # an admitted one releases via _run's finally
+            group.cancel_queued(start)
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -226,6 +271,9 @@ class _Handler(BaseHTTPRequestHandler):
                 "uptime": f"{time.time() - self.server_start:.0f}s",
             })
             return
+        if self.path == "/v1/resourceGroup":
+            self._send_json(self.manager.resource_groups.info())
+            return
         if self.path == "/v1/query":
             self._send_json([
                 {"queryId": q.query_id, "state": q.state,
@@ -266,9 +314,11 @@ class _Handler(BaseHTTPRequestHandler):
 class CoordinatorServer:
     """Threaded HTTP coordinator over an Engine (Server.java:75 analog)."""
 
-    def __init__(self, engine, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, engine, host: str = "127.0.0.1", port: int = 0,
+                 resource_groups=None):
         handler = type("BoundHandler", (_Handler,), {
-            "manager": QueryManager(engine)})
+            "manager": QueryManager(engine,
+                                    resource_groups=resource_groups)})
         self.httpd = ThreadingHTTPServer((host, port), handler)
         self.port = self.httpd.server_address[1]
         self._thread: threading.Thread | None = None
