@@ -1,0 +1,98 @@
+"""Worker process: executes plan fragments over its table splits.
+
+The multi-host analog of the reference worker runtime
+(server/TaskResource.java:123 POST /v1/task + SqlTaskManager.updateTask
+-> SqlTaskExecution): a task names the ORIGINAL query plus a split
+assignment (shard, nshards); the worker plans the same SQL itself over
+split-view catalogs (connectors/split.py) and returns the PARTIAL
+aggregation state columns — the engine's wire format for partial
+aggregates (the reference ships serialized accumulator state in Pages
+the same way). Planning is deterministic, so worker and coordinator
+agree on fragment shape and symbol names without shipping plan IR.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from presto_tpu.server.httpbase import HttpService, JsonHandler
+
+
+def execute_partial_task(engine_factory, sql: str, shard: int,
+                         nshards: int) -> dict:
+    """Run the partial-aggregate fragment of ``sql`` over split
+    (shard, nshards); returns serialized state columns."""
+    from presto_tpu.exec.executor import collect_scans, run_plan
+    from presto_tpu.exec.streaming import _find_streamable
+    from presto_tpu.plan import nodes as N
+
+    engine = engine_factory(shard, nshards)
+    plan, _ = engine.plan_sql(sql)
+    found = _find_streamable(plan)
+    if found is None:
+        raise ValueError("task SQL is not a partial-aggregatable shape")
+    agg, _scan = found
+    partial = dataclasses.replace(agg, step=N.AggStep.PARTIAL)
+    table = run_plan(engine, partial, collect_scans(partial, engine))
+
+    live = (np.ones(table.nrows, bool) if table.mask is None
+            else np.asarray(table.mask))
+    cols = []
+    for sym, col in table.columns.items():
+        data = np.asarray(col.data)[live]
+        if col.dictionary is not None:
+            values = [str(col.dictionary[c]) for c in data]
+        else:
+            values = data.tolist()
+        valid = (None if col.valid is None
+                 else np.asarray(col.valid)[live].tolist())
+        cols.append({"name": sym, "values": values, "valid": valid})
+    return {"columns": cols, "nrows": int(live.sum())}
+
+
+class WorkerServer(HttpService):
+    """HTTP worker node (WorkerModule / TaskResource analog). Holds a
+    base catalog set; each task re-wraps it in split views."""
+
+    def __init__(self, catalogs: dict, host: str = "127.0.0.1",
+                 port: int = 0, node_id: str = "worker"):
+        self.catalogs = catalogs
+        self.node_id = node_id
+
+        def engine_factory(shard: int, nshards: int):
+            from presto_tpu import Engine
+            from presto_tpu.connectors.split import SplitConnector
+
+            e = Engine()
+            for name, conn in catalogs.items():
+                e.register_catalog(
+                    name, SplitConnector(conn, shard, nshards))
+            return e
+
+        outer = self
+
+        class Handler(JsonHandler):
+            def do_GET(self):  # noqa: N802
+                if self.path == "/v1/status":
+                    self._send_json({"nodeId": outer.node_id,
+                                     "state": "active"})
+                    return
+                self._send_json({"error": "not found"}, 404)
+
+            def do_POST(self):  # noqa: N802
+                if self.path != "/v1/task":
+                    self._send_json({"error": "not found"}, 404)
+                    return
+                req = self._read_json()
+                try:
+                    out = execute_partial_task(
+                        engine_factory, req["sql"],
+                        int(req["shard"]), int(req["nshards"]))
+                    self._send_json(out)
+                except Exception as e:  # noqa: BLE001 - to coordinator
+                    self._send_json(
+                        {"error": f"{type(e).__name__}: {e}"}, 500)
+
+        super().__init__(Handler, host, port)

@@ -1,0 +1,47 @@
+"""Shared HTTP scaffolding for the coordinator and worker servers."""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+class JsonHandler(BaseHTTPRequestHandler):
+    """Quiet request handler with a JSON response helper."""
+
+    def log_message(self, fmt, *args):  # quiet
+        pass
+
+    def _send_json(self, obj, status: int = 200) -> None:
+        body = json.dumps(obj).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _read_json(self) -> dict:
+        length = int(self.headers.get("Content-Length", 0))
+        return json.loads(self.rfile.read(length))
+
+
+class HttpService:
+    """Owns a ThreadingHTTPServer + daemon serve thread lifecycle."""
+
+    def __init__(self, handler_cls, host: str = "127.0.0.1",
+                 port: int = 0):
+        self.httpd = ThreadingHTTPServer((host, port), handler_cls)
+        self.port = self.httpd.server_address[1]
+        self.uri = f"http://{host}:{self.port}"
+        self._thread: threading.Thread | None = None
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()

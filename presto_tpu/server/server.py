@@ -20,16 +20,15 @@ FINISHED|FAILED|CANCELED mirrors execution/QueryState.
 from __future__ import annotations
 
 import dataclasses
-import json
 import threading
 import time
 import uuid
 from concurrent.futures import ThreadPoolExecutor
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import numpy as np
 
 from presto_tpu import types as T
+from presto_tpu.server.httpbase import HttpService, JsonHandler
 
 PAGE_ROWS = 4096
 
@@ -95,11 +94,16 @@ class QueryManager:
         self.resource_groups = ResourceGroupManager(resource_groups)
         # the pool must cover every group's concurrency allowance or
         # group-admitted queries would serialize behind each other in
-        # the pool FIFO, defeating per-group isolation
-        workers = max(max_concurrency, min(64, sum(
-            g.spec.hard_concurrency_limit
-            for g in self.resource_groups.groups)))
-        self.pool = ThreadPoolExecutor(max_workers=workers)
+        # the pool FIFO, defeating per-group isolation; reject configs
+        # the pool cannot honor instead of silently under-providing
+        allowance = sum(g.spec.hard_concurrency_limit
+                        for g in self.resource_groups.groups)
+        if allowance > 256:
+            raise ValueError(
+                f"resource group concurrency allowances sum to "
+                f"{allowance}; the dispatcher pool supports at most 256")
+        self.pool = ThreadPoolExecutor(
+            max_workers=max(max_concurrency, allowance))
         self.lock = threading.Lock()
         self._tickets: dict[str, tuple] = {}  # qid -> (group, start_fn)
 
@@ -123,6 +127,7 @@ class QueryManager:
             q.error = str(e)
             q.state = "FAILED"
             q.finished = time.monotonic()
+            self._tickets.pop(qid, None)
         return q
 
     def _run(self, q: QueryInfo, group) -> None:
@@ -145,18 +150,17 @@ class QueryManager:
             finally:
                 q.finished = time.monotonic()
         finally:
+            self._tickets.pop(q.query_id, None)
             group.finish()
 
     def _execute(self, q: QueryInfo) -> None:
         """Plan once; queries return typed columns from the result
         table itself (the old path re-parsed and re-planned after
         execution just to name the columns)."""
-        try:
-            table = self.engine.execute_table(q.sql)
-        except ValueError as e:
-            if "execute_table expects" not in str(e):
-                raise
-            # non-query statement (execute_table rejects before work)
+        from presto_tpu.sql import ast as A
+        from presto_tpu.sql.parser import parse_statement
+
+        if not isinstance(parse_statement(q.sql), A.QueryStatement):
             rows = self.engine.execute(q.sql)
             width = len(rows[0]) if rows else 1
             q.columns = [{"name": f"_col{i}", "type": "varchar"}
@@ -164,6 +168,7 @@ class QueryManager:
             q.rows = [[_json_value(v, T.VARCHAR) for v in row]
                       for row in rows]
             return
+        table = self.engine.execute_table(q.sql)
         q.columns = [{"name": n, "type": str(c.dtype)}
                      for n, c in table.columns.items()]
         dtypes = [c.dtype for c in table.columns.values()]
@@ -191,22 +196,11 @@ class QueryManager:
             group.cancel_queued(start)
 
 
-class _Handler(BaseHTTPRequestHandler):
+class _Handler(JsonHandler):
     manager: QueryManager = None  # type: ignore[assignment]
     server_start = time.time()
 
-    def log_message(self, fmt, *args):  # quiet
-        pass
-
     # -- helpers ------------------------------------------------------------
-
-    def _send_json(self, obj, status: int = 200) -> None:
-        body = json.dumps(obj).encode()
-        self.send_response(status)
-        self.send_header("Content-Type", "application/json")
-        self.send_header("Content-Length", str(len(body)))
-        self.end_headers()
-        self.wfile.write(body)
 
     def _base_uri(self) -> str:
         host = self.headers.get("Host", "localhost")
@@ -311,7 +305,7 @@ class _Handler(BaseHTTPRequestHandler):
         self._send_json({"error": "not found"}, 404)
 
 
-class CoordinatorServer:
+class CoordinatorServer(HttpService):
     """Threaded HTTP coordinator over an Engine (Server.java:75 analog)."""
 
     def __init__(self, engine, host: str = "127.0.0.1", port: int = 0,
@@ -319,16 +313,4 @@ class CoordinatorServer:
         handler = type("BoundHandler", (_Handler,), {
             "manager": QueryManager(engine,
                                     resource_groups=resource_groups)})
-        self.httpd = ThreadingHTTPServer((host, port), handler)
-        self.port = self.httpd.server_address[1]
-        self._thread: threading.Thread | None = None
-
-    def start(self) -> "CoordinatorServer":
-        self._thread = threading.Thread(
-            target=self.httpd.serve_forever, daemon=True)
-        self._thread.start()
-        return self
-
-    def stop(self) -> None:
-        self.httpd.shutdown()
-        self.httpd.server_close()
+        super().__init__(handler, host, port)
