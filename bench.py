@@ -48,7 +48,56 @@ def numpy_q1_baseline(arrays: dict[str, np.ndarray], cutoff: int) -> float:
     return time.perf_counter() - t0
 
 
+def steady_state_sql(engine, sql: str, reps: int) -> float:
+    """Compile a SQL query once (with capacity retries) and return the best
+    steady-state wall seconds over ``reps`` device-resident runs."""
+    import jax
+
+    from presto_tpu.exec.executor import collect_scans, make_traced
+
+    plan, _ = engine.plan_sql(sql)
+    scan_inputs = collect_scans(plan, engine)
+    capacities: dict[tuple, int] = {}
+    for _ in range(10):
+        traced_fn, flat_arrays, meta = make_traced(
+            scan_inputs, plan, capacities, engine.session)
+        device_args = [jax.device_put(a) for a in flat_arrays]
+        compiled = jax.jit(traced_fn)
+        _res, live, oks = compiled(*device_args)
+        np.asarray(live)  # host materialization = real device sync
+        if all(bool(o) for o in oks):
+            break
+        for key, okv in zip(meta["ok_keys"], oks):
+            if not bool(okv):
+                capacities[key] = 2 * meta["used_capacity"][key]
+    else:
+        raise RuntimeError("capacity retry limit exceeded")
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        np.asarray(compiled(*device_args)[1])
+        times.append(time.perf_counter() - t0)
+    return min(times)
+
+
+def detail_main(name: str) -> None:
+    """Subprocess entry: measure one TPC-H query, print rows/sec."""
+    from presto_tpu import Engine
+    from presto_tpu.connectors.tpch import TpchConnector
+    from tests.tpch_queries import QUERIES
+
+    sf = float(os.environ.get("PRESTO_TPU_BENCH_SF", "1.0"))
+    engine = Engine()
+    engine.register_catalog("tpch", TpchConnector(scale=sf))
+    nrows = engine.catalogs["tpch"].table("lineitem").nrows
+    best = steady_state_sql(engine, QUERIES[name], 3)
+    print(nrows / best)
+
+
 def main() -> None:
+    one = os.environ.get("PRESTO_TPU_BENCH_ONE")
+    if one:
+        return detail_main(one)
     sf = float(os.environ.get("PRESTO_TPU_BENCH_SF", "1.0"))
     reps = int(os.environ.get("PRESTO_TPU_BENCH_REPS", "5"))
 
@@ -69,12 +118,14 @@ def main() -> None:
     traced_fn, flat_arrays, _meta = make_traced(scan_inputs, plan, {})
     device_args = [jax.device_put(a) for a in flat_arrays]
     compiled = jax.jit(traced_fn)
-    jax.block_until_ready(compiled(*device_args))  # compile + warmup
+    # sync by materializing the live mask on host: block_until_ready
+    # does not reliably block on tunneled accelerator platforms
+    np.asarray(compiled(*device_args)[1])  # compile + warmup
 
     times = []
     for _ in range(reps):
         t0 = time.perf_counter()
-        jax.block_until_ready(compiled(*device_args))
+        np.asarray(compiled(*device_args)[1])
         times.append(time.perf_counter() - t0)
     best = min(times)
     rows_per_sec = nrows / best
@@ -86,11 +137,39 @@ def main() -> None:
                   for _ in range(3)]
     base_rows_per_sec = nrows / min(base_times)
 
+    # join/secondary queries through the full SQL frontend (analog of the
+    # reference's BenchmarkSuite covering HandTpchQuery1/6 plus SQL-driven
+    # TPC-H runs) — reported as detail so join-path regressions are
+    # visible. Each runs in a SUBPROCESS: a device OOM / TPU worker crash
+    # in a detail query must not take down the headline measurement.
+    detail = {}
+    budget = float(os.environ.get("PRESTO_TPU_BENCH_BUDGET_S", "240"))
+    t_detail = time.perf_counter()
+    if os.environ.get("PRESTO_TPU_BENCH_Q1_ONLY") != "1":
+        import subprocess
+        for name in ("q06", "q03"):
+            left = budget - (time.perf_counter() - t_detail)
+            if left <= 0:
+                detail[f"{name}_skipped"] = "bench time budget exhausted"
+                continue
+            try:
+                proc = subprocess.run(
+                    [sys.executable, os.path.abspath(__file__)],
+                    env={**os.environ, "PRESTO_TPU_BENCH_ONE": name,
+                         "PRESTO_TPU_BENCH_SF": str(sf)},
+                    capture_output=True, text=True, timeout=left,
+                    cwd=os.path.dirname(os.path.abspath(__file__)))
+                out = proc.stdout.strip().splitlines()
+                detail[f"{name}_rows_per_sec"] = round(float(out[-1]))
+            except Exception as exc:  # never let detail kill the headline
+                detail[f"{name}_error"] = f"{type(exc).__name__}: {exc}"[:200]
+
     print(json.dumps({
         "metric": f"tpch_q1_sf{sf:g}_rows_per_sec",
         "value": round(rows_per_sec),
         "unit": "rows/s",
         "vs_baseline": round(rows_per_sec / base_rows_per_sec, 3),
+        "detail": detail,
     }))
 
 

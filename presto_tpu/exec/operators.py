@@ -109,6 +109,188 @@ def _direct_group_ids(dt: DTable, keys: list[str]):
     return gid, capacity, sizes
 
 
+def _agg_call_inputs(c: ExprCompiler, dt: DTable, call, live):
+    """Prepared (data, weight, data2, data_valid, arg_type) for one
+    aggregate call over the rows of ``dt`` (shared by the segment-op
+    and sorted-scan fold paths)."""
+    data2 = None
+    data_valid = None
+    if call.arg is not None:
+        av = c.compile(call.arg)
+        if call.fn == "checksum":
+            # NULL rows contribute a fixed hash constant instead
+            # of being excluded (checksums must see null counts)
+            weight = live
+        elif call.fn in A.BY_FNS:
+            # min_by/max_by: a NULL x is a legal result; only
+            # NULL comparison keys (arg2) exclude rows
+            weight = live
+            data_valid = av.valid
+        else:
+            weight = live if av.valid is None else (live & av.valid)
+        data = A.prepare_arg(call.fn, av.data, av.dtype)
+        if call.fn == "checksum" and av.valid is not None:
+            data = jnp.where(av.valid, data,
+                             jnp.uint64(0x2545F4914F6CDD1D))
+        if getattr(data, "ndim", 1) == 0:
+            data = jnp.broadcast_to(data, (dt.n,))
+        arg_type = av.dtype
+    else:
+        weight = live
+        data = jnp.ones((dt.n,), dtype=jnp.int64)
+        arg_type = None
+    if call.arg2 is not None:
+        av2 = c.compile(call.arg2)
+        if av2.valid is not None:
+            weight = weight & av2.valid
+        data2 = A.prepare_arg2(call.fn, av2.data, av2.dtype)
+        if getattr(data2, "ndim", 1) == 0:
+            data2 = jnp.broadcast_to(data2, (dt.n,))
+    if call.mask is not None:
+        mv = dt.cols[call.mask]
+        weight = weight & mv.data
+        if mv.valid is not None:
+            weight = weight & mv.valid
+    return data, weight, data2, data_valid, arg_type
+
+
+def _apply_aggregate_sorted(dt: DTable, node: N.Aggregate, capacity: int,
+                            c: ExprCompiler, live) -> tuple:
+    """Grouped aggregation via one hash sort + segmented scans + one
+    compaction sort (no group-table scatters, no random gathers: every
+    per-row array rides the grouping sort as a payload, and the
+    capacity-sized output is produced by a second multi-payload sort —
+    see ops/segscan.py and SortedGroups.compact). Output contract
+    matches the segment-op path: [capacity] rows, ok=False when the
+    group count exceeds capacity."""
+    rh = _row_hash(dt, node.group_keys)
+    is_final = node.step == N.AggStep.FINAL
+
+    # assemble sort payloads: key columns + per-call prepared inputs
+    payloads: list = []
+
+    def _add(arr) -> int:
+        payloads.append(arr)
+        return len(payloads) - 1
+
+    key_refs = []  # (sym, Val, data_idx, valid_idx)
+    for k in node.group_keys:
+        v = dt.cols[k]
+        key_refs.append((k, v, _add(v.data),
+                         None if v.valid is None else _add(v.valid)))
+
+    call_refs: dict[str, tuple] = {}
+    for sym, call in node.aggs.items():
+        scan = call.fn in A.SCAN_FNS
+        if is_final:
+            sum_state = dt.cols.get(f"{sym}$sum")
+            arg_type = sum_state.dtype if sum_state is not None else None
+            if scan:
+                idxs = {f: _add(dt.cols[f"{sym}${f}"].data)
+                        for f in A.state_fields(call.fn)}
+                call_refs[sym] = ("merge", idxs, arg_type)
+            else:
+                call_refs[sym] = ("seg", None, arg_type)
+        else:
+            data, weight, data2, data_valid, arg_type = \
+                _agg_call_inputs(c, dt, call, live)
+            if scan:
+                idxs = (_add(data), _add(weight),
+                        None if data2 is None else _add(data2),
+                        None if data_valid is None else _add(data_valid))
+                call_refs[sym] = ("fold", idxs, arg_type)
+            else:
+                call_refs[sym] = ("seg", (data, weight, data2,
+                                          data_valid), arg_type)
+
+    sg = H.SortedGroups(rh, live, payloads)
+    ok = sg.ngroups <= capacity
+    sp = sg.payloads
+    slots = None  # lazily built for segment-op fallbacks (sketches)
+
+    # per-sorted-row arrays destined for the compaction sort
+    compact_in: list = []
+
+    def _adc(arr) -> int:
+        compact_in.append(arr)
+        return len(compact_in) - 1
+
+    key_out = [(sym, v, _adc(sp[di]),
+                None if vi is None else _adc(sp[vi]))
+               for sym, v, di, vi in key_refs]
+
+    state_out: dict[str, dict] = {}
+    seg_states: dict[str, dict] = {}
+    arg_types: dict[str, object] = {}
+    for sym, call in node.aggs.items():
+        kind, refs, arg_type = call_refs[sym]
+        arg_types[sym] = arg_type
+        if kind == "fold":
+            di, wi, d2i, dvi = refs
+            st = A.scan_fold(
+                call.fn, sp[di], sp[wi], sg,
+                data2=None if d2i is None else sp[d2i],
+                data_valid=None if dvi is None else sp[dvi],
+                param=call.param)
+            state_out[sym] = {f: _adc(arr) for f, arr in st.items()}
+        elif kind == "merge":
+            st = A.scan_merge(
+                call.fn, {f: sp[i] for f, i in refs.items()},
+                sg.live, sg)
+            state_out[sym] = {f: _adc(arr) for f, arr in st.items()}
+        else:  # segment-op fallback (2D sketch states can't ride sorts)
+            if slots is None:
+                slots = sg.slots()
+            if is_final:
+                fields = A.state_fields(call.fn)
+                seg_states[sym] = A.merge(
+                    call.fn,
+                    {f: dt.cols[f"{sym}${f}"].data for f in fields},
+                    slots, capacity, live)
+            else:
+                data, weight, data2, data_valid = refs
+                seg_states[sym] = A.fold(
+                    call.fn, data, weight, slots, capacity,
+                    data2=data2, data_valid=data_valid,
+                    param=call.param)
+
+    compacted, occupied = sg.compact(compact_in, capacity)
+
+    out: dict[str, Val] = {}
+    for sym, v, di, vi in key_out:
+        valid = None if vi is None else compacted[vi]
+        out[sym] = Val(v.dtype, compacted[di], valid, v.dictionary)
+
+    for sym, call in node.aggs.items():
+        states = (seg_states[sym] if sym in seg_states else
+                  {f: compacted[i] for f, i in state_out[sym].items()})
+        out_dictionary = None
+        if is_final:
+            val_state = dt.cols.get(
+                f"{sym}$xval" if call.fn in A.BY_FNS else f"{sym}$val")
+            if val_state is not None:
+                out_dictionary = val_state.dictionary
+        if node.step == N.AggStep.PARTIAL:
+            for f, arr in states.items():
+                dictionary = None
+                if f == "val" and call.arg is not None:
+                    dictionary = _arg_dictionary(
+                        c, call.arg2 if call.fn in A.BY_FNS
+                        else call.arg)
+                elif f == "xval":
+                    dictionary = _arg_dictionary(c, call.arg)
+                out[f"{sym}${f}"] = Val(
+                    A.state_type(call, f), arr, None, dictionary)
+        else:
+            fdata, fvalid = A.finalize(call.fn, states, call.dtype,
+                                       arg_types[sym], param=call.param)
+            if out_dictionary is None and call.arg is not None:
+                out_dictionary = _arg_dictionary(c, call.arg)
+            out[sym] = Val(call.dtype, fdata, fvalid, out_dictionary)
+
+    return DTable(out, occupied, capacity), ok
+
+
 def apply_aggregate(dt: DTable, node: N.Aggregate, capacity: int) -> tuple:
     """Returns (DTable of [capacity] rows, ok flag)."""
     live = dt.live_mask()
@@ -122,9 +304,8 @@ def apply_aggregate(dt: DTable, node: N.Aggregate, capacity: int) -> tuple:
             live.astype(jnp.int32), slots, num_segments=capacity) > 0
         ok = jnp.asarray(True)
     elif node.group_keys:
-        rh = _row_hash(dt, node.group_keys)
-        slots, table, ok = H.group_by_slots(rh, live, capacity)
-        occupancy = table != jnp.uint64(0xFFFFFFFFFFFFFFFF)
+        # hash-grouped path: sort-and-scan, no group-table scatters
+        return _apply_aggregate_sorted(dt, node, capacity, c, live)
     else:
         # global aggregation: one group in slot 0
         slots = jnp.zeros((dt.n,), dtype=jnp.int32)
@@ -137,21 +318,6 @@ def apply_aggregate(dt: DTable, node: N.Aggregate, capacity: int) -> tuple:
     if direct is not None:
         out.update(_decode_direct_keys(dt, node.group_keys, sizes,
                                        capacity))
-    else:
-        for k in node.group_keys:
-            v = dt.cols[k]
-            # scatter key values: all contributors share the slot & value,
-            # so a plain set-scatter is deterministic
-            data = jnp.zeros((capacity,), dtype=v.data.dtype)
-            data = data.at[jnp.where(live, safe_slots, capacity)].set(
-                v.data, mode="drop")
-            if v.valid is not None:
-                valid = jnp.zeros((capacity,), dtype=bool)
-                valid = valid.at[jnp.where(live, safe_slots, capacity)].set(
-                    v.valid, mode="drop")
-            else:
-                valid = None
-            out[k] = Val(v.dtype, data, valid, v.dictionary)
 
     is_final = node.step == N.AggStep.FINAL
     for sym, call in node.aggs.items():
@@ -159,39 +325,34 @@ def apply_aggregate(dt: DTable, node: N.Aggregate, capacity: int) -> tuple:
         if is_final:
             states = {f: dt.cols[f"{sym}${f}"].data
                       for f in A.state_fields(call.fn)}
-            val_state = dt.cols.get(f"{sym}$val")
+            val_state = dt.cols.get(
+                f"{sym}$xval" if call.fn in A.BY_FNS else f"{sym}$val")
             if val_state is not None:
                 out_dictionary = val_state.dictionary
             states = A.merge(call.fn, states, safe_slots, capacity, live)
             sum_state = dt.cols.get(f"{sym}$sum")
             arg_type = sum_state.dtype if sum_state is not None else None
         else:
-            if call.arg is not None:
-                av = c.compile(call.arg)
-                weight = live if av.valid is None else (live & av.valid)
-                data = A.prepare_arg(call.fn, av.data, av.dtype)
-                if getattr(data, "ndim", 1) == 0:
-                    data = jnp.broadcast_to(data, (dt.n,))
-                arg_type = av.dtype
-            else:
-                weight = live
-                data = jnp.ones((dt.n,), dtype=jnp.int64)
-                arg_type = None
-            if call.mask is not None:
-                mv = dt.cols[call.mask]
-                weight = weight & mv.data
-                if mv.valid is not None:
-                    weight = weight & mv.valid
-            states = A.fold(call.fn, data, weight, safe_slots, capacity)
+            data, weight, data2, data_valid, arg_type = \
+                _agg_call_inputs(c, dt, call, live)
+            states = A.fold(call.fn, data, weight, safe_slots, capacity,
+                            data2=data2, data_valid=data_valid,
+                            param=call.param)
 
         if node.step == N.AggStep.PARTIAL:
             for f, arr in states.items():
+                dictionary = None
+                if f == "val" and call.arg is not None:
+                    dictionary = _arg_dictionary(
+                        c, call.arg2 if call.fn in A.BY_FNS
+                        else call.arg)
+                elif f == "xval":
+                    dictionary = _arg_dictionary(c, call.arg)
                 out[f"{sym}${f}"] = Val(
-                    A.state_type(call, f), arr, None,
-                    _arg_dictionary(c, call.arg) if f == "val" and call.arg
-                    is not None else None)
+                    A.state_type(call, f), arr, None, dictionary)
         else:
-            fdata, fvalid = A.finalize(call.fn, states, call.dtype, arg_type)
+            fdata, fvalid = A.finalize(call.fn, states, call.dtype,
+                                       arg_type, param=call.param)
             if out_dictionary is None and call.arg is not None:
                 out_dictionary = _arg_dictionary(c, call.arg)
             out[sym] = Val(call.dtype, fdata, fvalid, out_dictionary)
@@ -262,11 +423,15 @@ def apply_join(left: DTable, right: DTable, node: N.Join,
     probe_live = _and_key_valid(left, lkeys, left.live_mask())
 
     rh = _row_hash(right, rkeys)
-    table, table_row, ok = H.build_join_table(rh, build_live, capacity)
+    _bsh, bsidx = H.sort_build_side(rh, build_live)
     ph = _row_hash(left, lkeys)
-    build_row, found, probe_ok = H.probe_join_table(
-        table, table_row, ph, probe_live)
-    ok = ok & probe_ok
+    lo, count, found = H.probe_runs(rh, build_live, ph, probe_live)
+    # representative on duplicate build keys: the run's last sorted row
+    # = the largest source index (stable sort), matching the previous
+    # open-addressing table's scatter-max choice
+    build_row = jnp.where(
+        found, bsidx[jnp.clip(lo + count - 1, 0, right.n - 1)], -1)
+    ok = jnp.asarray(True)  # sorted build: no table, no overflow
 
     gather = jnp.clip(build_row, 0, right.n - 1)
     found = found & _verify_keys(left, right, node.criteria, None, gather)
@@ -327,12 +492,12 @@ def apply_expand_join(left: DTable, right: DTable, node: N.Join,
         probe_rows_live = probe_live
 
     rh = _row_hash(right, rkeys)
-    table, counts, offsets, build_order, t_ok = H.build_join_multimap(
-        rh, build_live, capacity)
+    _bsh, bsidx = H.sort_build_side(rh, build_live)
     ph = _row_hash(left, lkeys)
-    slot, found, p_ok = H.probe_join_slot(table, ph, probe_live)
+    lo, count, found = H.probe_runs(rh, build_live, ph, probe_live)
+    t_ok = jnp.asarray(True)  # sorted build: no table, no overflow
     probe_idx, build_row, out_live, o_ok = H.expand_matches(
-        counts, offsets, build_order, slot, found & probe_live,
+        lo, count, bsidx, found & probe_live,
         probe_rows_live, out_capacity, left_join)
 
     out: dict[str, Val] = {}
@@ -370,7 +535,7 @@ def apply_expand_join(left: DTable, right: DTable, node: N.Join,
         f_ok = fv.data if fv.valid is None else (fv.data & fv.valid)
         out_live = out_live & f_ok
 
-    return DTable(out, out_live, out_capacity), t_ok & p_ok, o_ok
+    return DTable(out, out_live, out_capacity), t_ok, o_ok
 
 
 def apply_semijoin(dt: DTable, filt: DTable, node: N.SemiJoin,
@@ -378,11 +543,12 @@ def apply_semijoin(dt: DTable, filt: DTable, node: N.SemiJoin,
     build_live = _and_key_valid(filt, node.filter_keys, filt.live_mask())
     probe_live = _and_key_valid(dt, node.source_keys, dt.live_mask())
     fh = _row_hash(filt, node.filter_keys)
-    table, table_row, ok = H.build_join_table(fh, build_live, capacity)
+    _bsh, bsidx = H.sort_build_side(fh, build_live)
     sh = _row_hash(dt, node.source_keys)
-    build_row, found, probe_ok = H.probe_join_table(
-        table, table_row, sh, probe_live)
-    ok = ok & probe_ok
+    lo, count, found = H.probe_runs(fh, build_live, sh, probe_live)
+    build_row = jnp.where(
+        found, bsidx[jnp.clip(lo + count - 1, 0, filt.n - 1)], -1)
+    ok = jnp.asarray(True)  # sorted build: no table, no overflow
     found = found & _verify_keys(
         dt, filt, list(zip(node.source_keys, node.filter_keys)), None,
         jnp.clip(build_row, 0, filt.n - 1))
@@ -811,15 +977,14 @@ def apply_mark_distinct(dt: DTable, node: N.MarkDistinct,
     hash-slot assignment + a segment-min race for the first row)."""
     live = dt.live_mask()
     rh = _row_hash(dt, node.keys)
-    slots, table, ok = H.group_by_slots(rh, live, capacity)
-    idx = jnp.arange(dt.n, dtype=jnp.int32)
-    big = jnp.asarray(dt.n, jnp.int32)
-    firsts = jax.ops.segment_min(jnp.where(live, idx, big), slots,
-                                 num_segments=capacity)
-    mark = live & (firsts[slots] == idx)
+    sg = H.SortedGroups(rh, live)
+    # is_new flags the first sorted row of each key run (stable sort ->
+    # the smallest source index); a second sort keyed by the source row
+    # index inverts the permutation without a scatter
+    _, mark = jax.lax.sort((sg.sidx, sg.is_new), num_keys=1)
     cols = dict(dt.cols)
     cols[node.mark_symbol] = Val(T.BOOLEAN, mark, None, None)
-    return DTable(cols, dt.live, dt.n), ok
+    return DTable(cols, dt.live, dt.n), jnp.asarray(True)
 
 
 def apply_distinct(dt: DTable, capacity: int) -> tuple:
@@ -832,17 +997,19 @@ def apply_distinct(dt: DTable, capacity: int) -> tuple:
         out = _decode_direct_keys(dt, list(dt.cols), sizes, capacity)
         return DTable(out, occupancy, capacity), jnp.asarray(True)
     rh = _row_hash(dt, list(dt.cols))
-    slots, table, ok = H.group_by_slots(rh, live, capacity)
-    occupancy = table != jnp.uint64(0xFFFFFFFFFFFFFFFF)
-    out = {}
+    payloads = []
+    refs = []
     for sym, v in dt.cols.items():
-        data = jnp.zeros((capacity,), dtype=v.data.dtype)
-        data = data.at[jnp.where(live, slots, capacity)].set(
-            v.data, mode="drop")
-        valid = None
+        refs.append((sym, v, len(payloads),
+                     None if v.valid is None else len(payloads) + 1))
+        payloads.append(v.data)
         if v.valid is not None:
-            valid = jnp.zeros((capacity,), dtype=bool)
-            valid = valid.at[jnp.where(live, slots, capacity)].set(
-                v.valid, mode="drop")
-        out[sym] = Val(v.dtype, data, valid, v.dictionary)
-    return DTable(out, occupancy, capacity), ok
+            payloads.append(v.valid)
+    sg = H.SortedGroups(rh, live, payloads)
+    ok = sg.ngroups <= capacity
+    compacted, occupied = sg.compact_first(sg.payloads, capacity)
+    out = {}
+    for sym, v, di, vi in refs:
+        valid = None if vi is None else compacted[vi]
+        out[sym] = Val(v.dtype, compacted[di], valid, v.dictionary)
+    return DTable(out, occupied, capacity), ok

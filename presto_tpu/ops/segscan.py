@@ -1,0 +1,90 @@
+"""Segmented reductions over hash-sorted rows — the scatter-free fold
+layer under aggregation.
+
+After the grouping sort (ops/hash.SortedGroups), rows of one group are
+contiguous, so per-group reductions become segmented scans: additive
+states use one cumsum plus boundary gathers; order states (min/max,
+min_by/max_by) use a Hillis-Steele doubling scan gated by each row's
+run-start position. Every step is a shift, gather, or elementwise op —
+no scatter touches a group-table, which is what makes high-cardinality
+aggregation fast on TPU (a single scatter-fold into a 4M-slot table
+costs ~100x one of these scans; see ops/hash.py design notes).
+
+The reference reaches the same states through per-row accumulator
+updates (operator/aggregation/builder/InMemoryHashAggregationBuilder);
+the math (including Chan et al. M2/co-moment merging) is shared with
+expr/aggregates.py's segment-op fallbacks.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def seg_sum(vals, sg):
+    """Per-row running segmented sum; the value at a run's last row is
+    the run total. ``sg`` is an ops.hash.SortedGroups over the same
+    sorted order as ``vals``."""
+    pref = jnp.cumsum(vals, axis=0)
+    base = jnp.where(
+        (sg.start > 0)[(...,) + (None,) * (vals.ndim - 1)],
+        pref[jnp.clip(sg.start - 1, 0, None)], jnp.zeros_like(pref[:1]))
+    return pref - base
+
+
+def seg_scan(combine, leaves, sg):
+    """Generic inclusive segmented scan by doubling: ``combine(prev,
+    cur)`` merges a tuple of per-row states elementwise. O(log N)
+    shift+select rounds."""
+    n = leaves[0].shape[0]
+    i = jnp.arange(n, dtype=jnp.int32)
+    state = tuple(leaves)
+    k = 1
+    while k < n:
+        take = (i - k) >= sg.start
+        shifted = tuple(
+            jnp.concatenate([leaf[:k], leaf[:-k]]) for leaf in state)
+        merged = combine(shifted, state)
+        state = tuple(
+            jnp.where(take[(...,) + (None,) * (leaf.ndim - 1)], m, leaf)
+            for leaf, m in zip(state, merged))
+        k *= 2
+    return state
+
+
+def seg_max(vals, sg):
+    return seg_scan(
+        lambda a, b: (jnp.maximum(a[0], b[0]),), (vals,), sg)[0]
+
+
+def seg_min(vals, sg):
+    return seg_scan(
+        lambda a, b: (jnp.minimum(a[0], b[0]),), (vals,), sg)[0]
+
+
+def seg_argbest(best, payload, sg, maximize: bool):
+    """Segmented arg-extremum carrying payload leaves: at each run's
+    last row, ``best`` holds the run extremum and the payloads hold the
+    winning row's values (earliest row wins ties, matching an in-order
+    accumulator)."""
+    def combine(a, b):
+        if maximize:
+            take_prev = a[0] >= b[0]  # prev is earlier: wins ties
+        else:
+            take_prev = a[0] <= b[0]
+        return tuple(jnp.where(take_prev, x, y) for x, y in zip(a, b))
+    out = seg_scan(combine, (best,) + tuple(payload), sg)
+    return out[0], out[1:]
+
+
+def broadcast_last(vals, sg):
+    """Broadcast each run's last-row value to every row of the run
+    (reverse cummax over positions + gather) — the second pass of
+    two-pass moments."""
+    n = vals.shape[0]
+    i = jnp.arange(n, dtype=jnp.int32)
+    # nearest is_last at-or-after each row = suffix min of its position
+    lastpos = jnp.flip(jax.lax.cummin(
+        jnp.flip(jnp.where(sg.is_last, i, n))))
+    return vals[jnp.clip(lastpos, 0, n - 1)]

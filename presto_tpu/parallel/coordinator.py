@@ -173,6 +173,7 @@ class ClusterCoordinator:
         dicts: dict[str, np.ndarray | None] = {}
         per_sym_vals: dict[str, list] = {s: [] for s in syms}
         per_sym_valid: dict[str, list] = {s: [] for s in syms}
+        per_sym_dtype: dict[str, str | None] = {s: None for s in syms}
         total = 0
         for res in results:
             got = {c["name"]: c for c in res["columns"]}
@@ -184,6 +185,8 @@ class ClusterCoordinator:
             total += n
             for s in syms:
                 per_sym_vals[s].extend(got[s]["values"])
+                if got[s].get("dtype"):
+                    per_sym_dtype[s] = got[s]["dtype"]
                 v = got[s]["valid"]
                 per_sym_valid[s].extend(
                     v if v is not None else [True] * n)
@@ -196,8 +199,13 @@ class ClusterCoordinator:
                 arrays[s] = codes
                 dicts[s] = d
             else:
-                arrays[s] = np.asarray(per_sym_vals[s],
-                                       dtype=dtype.physical_dtype)
+                # the wire dtype wins over the nominal SQL type: sketch
+                # states (checksum $sum, approx_percentile $rhash) are
+                # uint64 yet declared BIGINT, and int64 parsing would
+                # overflow on values >= 2**63
+                np_dtype = (np.dtype(per_sym_dtype[s])
+                            if per_sym_dtype[s] else dtype.physical_dtype)
+                arrays[s] = np.asarray(per_sym_vals[s], dtype=np_dtype)
                 dicts[s] = None
             if not all(per_sym_valid[s]):
                 arrays[f"{s}$valid"] = np.asarray(per_sym_valid[s],

@@ -45,9 +45,12 @@ def bucket_by_partition(cols: dict, live, part_id, num_parts: int,
         num_parts * capacity)  # out-of-range -> dropped
     out = {}
     for name, a in cols.items():
-        buf = jnp.zeros((num_parts * capacity,), dtype=a.dtype)
+        # rows scatter along axis 0; trailing axes (2D sketch states)
+        # ride along unchanged
+        buf = jnp.zeros((num_parts * capacity,) + a.shape[1:],
+                        dtype=a.dtype)
         buf = buf.at[flat_dest].set(a, mode="drop")
-        out[name] = buf.reshape(num_parts, capacity)
+        out[name] = buf.reshape((num_parts, capacity) + a.shape[1:])
     valid = jnp.zeros((num_parts * capacity,), dtype=bool)
     valid = valid.at[flat_dest].set(live, mode="drop")
     return out, valid.reshape(num_parts, capacity), ok
@@ -59,7 +62,7 @@ def all_to_all_exchange(bucketed: dict, valid, axis_name: str):
     out = {}
     for name, a in bucketed.items():
         ex = jax.lax.all_to_all(a, axis_name, split_axis=0, concat_axis=0)
-        out[name] = ex.reshape(-1)
+        out[name] = ex.reshape((-1,) + a.shape[2:])
     v = jax.lax.all_to_all(valid, axis_name, split_axis=0, concat_axis=0)
     return out, v.reshape(-1)
 

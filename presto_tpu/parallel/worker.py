@@ -48,7 +48,12 @@ def execute_partial_task(engine_factory, sql: str, shard: int,
             values = data.tolist()
         valid = (None if col.valid is None
                  else np.asarray(col.valid)[live].tolist())
-        cols.append({"name": sym, "values": values, "valid": valid})
+        # physical dtype travels with the column: state columns' declared
+        # types are nominal (checksum/approx sketches hold uint64), so
+        # the coordinator must not reconstruct from the SQL type alone
+        cols.append({"name": sym, "values": values, "valid": valid,
+                     "dtype": (None if col.dictionary is not None
+                               else str(data.dtype))})
     return {"columns": cols, "nrows": int(live.sum())}
 
 

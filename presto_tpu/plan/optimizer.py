@@ -80,7 +80,8 @@ def prune_columns(node: N.PlanNode,
         aggs = {s: c for s, c in node.aggs.items()
                 if node.step == N.AggStep.PARTIAL or s in needed}
         child = set(node.group_keys) | _expr_refs(
-            *[c.arg for c in aggs.values() if c.arg is not None])
+            *[c.arg for c in aggs.values() if c.arg is not None],
+            *[c.arg2 for c in aggs.values() if c.arg2 is not None])
         child |= {c.mask for c in aggs.values() if c.mask is not None}
         if node.step == N.AggStep.FINAL:
             from presto_tpu.expr import aggregates as AGG

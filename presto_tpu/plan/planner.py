@@ -43,7 +43,10 @@ AGG_FUNCTIONS = {"count", "sum", "avg", "min", "max", "arbitrary",
                  "count_if", "bool_and", "bool_or", "every",
                  "variance", "var_samp", "var_pop",
                  "stddev", "stddev_samp", "stddev_pop",
-                 "geometric_mean", "approx_distinct"}
+                 "geometric_mean", "approx_distinct", "checksum",
+                 "corr", "covar_samp", "covar_pop",
+                 "regr_slope", "regr_intercept",
+                 "min_by", "max_by", "approx_percentile"}
 
 _COMPARISONS = {"=": "eq", "<>": "neq", "<": "lt", "<=": "lte",
                 ">": "gt", ">=": "gte"}
@@ -1394,23 +1397,42 @@ class LogicalPlanner:
         agg_syms: dict[A.FunctionCall, tuple[str, T.DataType]] = {}
 
         def _is_distinct(c: A.FunctionCall) -> bool:
-            # approx_distinct(x) runs as an EXACT distinct count: the
-            # hash machinery already dedupes exactly, so the "estimate"
-            # has zero error (within the reference's 2.3% default
-            # epsilon, ApproximateCountDistinctAggregation); a sketch
-            # (HLL registers as segment-max states) can replace this
-            # when partial-state width matters
-            return c.distinct or c.name == "approx_distinct"
+            return c.distinct
 
         distinct_calls = [c for c in agg_calls if _is_distinct(c)]
         for call in agg_calls:
             fn = call.name
-            if fn == "approx_distinct":
-                fn = "count"
+            arg2_ir = None
+            param = None
             if call.is_star or (fn == "count" and not call.args):
                 fn = "count_star"
                 arg_ir = None
                 arg_t = None
+            elif fn in AGG.BY_FNS or fn in AGG.COVAR_FNS:
+                # two-argument aggregates: min_by/max_by(x, y) and the
+                # covariance family fn(y, x)
+                if len(call.args) != 2:
+                    raise SemanticError(
+                        f"aggregate {fn} takes two arguments")
+                arg_ir = planner.plan(call.args[0])
+                arg2_ir = planner.plan(call.args[1])
+                arg_t = arg_ir.dtype
+            elif fn == "approx_percentile":
+                if len(call.args) != 2:
+                    raise SemanticError(
+                        "approx_percentile takes (value, percentile)")
+                arg_ir = planner.plan(call.args[0])
+                p_ir = planner.plan(call.args[1])
+                if not isinstance(p_ir, ir.Literal):
+                    raise SemanticError(
+                        "approx_percentile percentile must be a literal")
+                param = float(p_ir.value)
+                if isinstance(p_ir.dtype, T.DecimalType):
+                    param /= p_ir.dtype.unscale_factor
+                if not 0.0 <= param <= 1.0:
+                    raise SemanticError(
+                        "percentile must be between 0 and 1")
+                arg_t = arg_ir.dtype
             else:
                 if len(call.args) != 1:
                     raise SemanticError(
@@ -1419,7 +1441,8 @@ class LogicalPlanner:
                 arg_t = arg_ir.dtype
             out_t = AGG.output_type(fn, arg_t)
             sym = self.symbols.fresh(fn)
-            aggs[sym] = AggCall(fn, arg_ir, out_t, _is_distinct(call))
+            aggs[sym] = AggCall(fn, arg_ir, out_t, _is_distinct(call),
+                                arg2=arg2_ir, param=param)
             agg_syms[call] = (sym, out_t)
 
         gsets = self._resolve_grouping_sets(spec)

@@ -48,6 +48,8 @@ def validate_plan(plan: N.PlanNode) -> None:
                 if node.step != N.AggStep.FINAL:
                     need(_refs(call.arg), child_types[0],
                          f"aggregate {sym}")
+                    need(_refs(call.arg2), child_types[0],
+                         f"aggregate {sym} second argument")
                     if call.mask is not None:
                         need([call.mask], child_types[0],
                              f"aggregate mask of {sym}")

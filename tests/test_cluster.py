@@ -22,6 +22,12 @@ QUERIES = [
     ("select l_shipmode, sum(l_extendedprice * (1 - l_discount)) as rev "
      "from lineitem group by l_shipmode order by rev desc limit 3",
      None),
+    # uint64 sketch/checksum states over the wire: the physical dtype
+    # must survive the HTTP serde (their nominal SQL type is BIGINT,
+    # and int64 parsing overflows on values >= 2**63)
+    ("select l_returnflag, checksum(l_partkey) as ck, "
+     "approx_distinct(l_suppkey) as ad from lineitem "
+     "group by l_returnflag order by l_returnflag", None),
 ]
 
 
