@@ -26,6 +26,7 @@ from presto_tpu.expr import aggregates as A
 from presto_tpu.expr import ir
 from presto_tpu.expr.compile import ExprCompiler, Val, and_valid, cast_val
 from presto_tpu.ops import hash as H
+from presto_tpu.ops import segred
 from presto_tpu.plan import nodes as N
 
 
@@ -300,7 +301,7 @@ def apply_aggregate(dt: DTable, node: N.Aggregate, capacity: int) -> tuple:
 
     if direct is not None:
         slots, capacity, sizes = direct
-        occupancy = jax.ops.segment_sum(
+        occupancy = segred.segment_sum(
             live.astype(jnp.int32), slots, num_segments=capacity) > 0
         ok = jnp.asarray(True)
     elif node.group_keys:
@@ -992,7 +993,7 @@ def apply_distinct(dt: DTable, capacity: int) -> tuple:
     direct = _direct_group_ids(dt, list(dt.cols))
     if direct is not None:
         slots, capacity, sizes = direct
-        occupancy = jax.ops.segment_sum(
+        occupancy = segred.segment_sum(
             live.astype(jnp.int32), slots, num_segments=capacity) > 0
         out = _decode_direct_keys(dt, list(dt.cols), sizes, capacity)
         return DTable(out, occupancy, capacity), jnp.asarray(True)

@@ -21,6 +21,7 @@ import jax.numpy as jnp
 
 from presto_tpu import types as T
 from presto_tpu.expr import ir
+from presto_tpu.ops import segred
 
 
 @dataclasses.dataclass(frozen=True)
@@ -275,7 +276,7 @@ def fold(fn: str, data, weight, slots, capacity: int, *,
         bucket = (data & jnp.uint64(HLL_M - 1)).astype(jnp.int64)
         rank = 54 - _bitlen(data >> jnp.uint64(11))
         seg = slots.astype(jnp.int64) * HLL_M + bucket
-        regs = jax.ops.segment_max(
+        regs = segred.segment_max(
             jnp.where(w, rank, 0), seg, num_segments=capacity * HLL_M)
         return {"regs": regs.reshape(capacity, HLL_M).astype(jnp.uint8)}
     if fn == "checksum":
@@ -284,23 +285,23 @@ def fold(fn: str, data, weight, slots, capacity: int, *,
         # rows were remapped to a fixed constant by the caller.
         # u64 state reassembles to a wrapped int64 at finalize (no
         # 64-bit bitcast on this TPU toolchain)
-        return {"sum": jax.ops.segment_sum(
+        return {"sum": segred.segment_sum(
             jnp.where(w, data, jnp.uint64(0)), slots,
             num_segments=capacity)}
     if fn in COVAR_FNS:
         # two-pass centered co-moments (same cancellation argument as
         # the variance family): y=data, x=data2, both float64
         z = jnp.zeros((), jnp.float64)
-        c = jax.ops.segment_sum(w.astype(jnp.int64), slots,
+        c = segred.segment_sum(w.astype(jnp.int64), slots,
                                 num_segments=capacity)
-        sy = jax.ops.segment_sum(jnp.where(w, data, z), slots,
+        sy = segred.segment_sum(jnp.where(w, data, z), slots,
                                  num_segments=capacity)
-        sx = jax.ops.segment_sum(jnp.where(w, data2, z), slots,
+        sx = segred.segment_sum(jnp.where(w, data2, z), slots,
                                  num_segments=capacity)
         cf = jnp.maximum(c, 1).astype(jnp.float64)
         dy = data - (sy / cf)[slots]
         dx = data2 - (sx / cf)[slots]
-        seg = lambda v: jax.ops.segment_sum(  # noqa: E731
+        seg = lambda v: segred.segment_sum(  # noqa: E731
             jnp.where(w, v, z), slots, num_segments=capacity)
         return {"count": c, "sumx": sx, "sumy": sy, "cxy": seg(dx * dy),
                 "m2x": seg(dx * dx), "m2y": seg(dy * dy)}
@@ -311,16 +312,16 @@ def fold(fn: str, data, weight, slots, capacity: int, *,
         # ignored, x may be NULL)
         if fn == "max_by":
             sentinel = _min_sentinel(data2.dtype)
-            best = jax.ops.segment_max(jnp.where(w, data2, sentinel),
+            best = segred.segment_max(jnp.where(w, data2, sentinel),
                                        slots, num_segments=capacity)
         else:
             sentinel = _max_sentinel(data2.dtype)
-            best = jax.ops.segment_min(jnp.where(w, data2, sentinel),
+            best = segred.segment_min(jnp.where(w, data2, sentinel),
                                        slots, num_segments=capacity)
         winner = w & (data2 == best[slots])
         xval, xok = _winner_scatter(data, data_valid, winner, slots,
                                     capacity)
-        c = jax.ops.segment_sum(w.astype(jnp.int64), slots,
+        c = segred.segment_sum(w.astype(jnp.int64), slots,
                                 num_segments=capacity)
         return {"val": best, "xval": xval, "xok": xok, "count": c}
     if fn == "approx_percentile":
@@ -336,7 +337,7 @@ def fold(fn: str, data, weight, slots, capacity: int, *,
                         ^ (idx * jnp.uint64(0xBF58476D1CE4E5B9)))
         cell = (h % jnp.uint64(PCT_K)).astype(jnp.int64)
         seg = slots.astype(jnp.int64) * PCT_K + cell
-        minh = jax.ops.segment_min(
+        minh = segred.segment_min(
             jnp.where(w, h, _U64_MAX), seg,
             num_segments=capacity * PCT_K)
         winner = w & (h == minh[seg])
@@ -346,42 +347,42 @@ def fold(fn: str, data, weight, slots, capacity: int, *,
         return {"rhash": minh.reshape(capacity, PCT_K),
                 "rval": rval.reshape(capacity, PCT_K)}
     if fn in ("count", "count_star"):
-        return {"count": jax.ops.segment_sum(
+        return {"count": segred.segment_sum(
             w.astype(jnp.int64), slots, num_segments=capacity)}
     if fn in ("sum", "avg"):
         if jnp.issubdtype(data.dtype, jnp.integer):
             data = data.astype(jnp.int64)  # int32 args must not wrap
         zero = jnp.zeros((), dtype=data.dtype)
-        s = jax.ops.segment_sum(
+        s = segred.segment_sum(
             jnp.where(w, data, zero), slots, num_segments=capacity)
-        c = jax.ops.segment_sum(
+        c = segred.segment_sum(
             w.astype(jnp.int64), slots, num_segments=capacity)
         return {"sum": s, "count": c}
     if fn in ("min", "max", "arbitrary"):
         if fn == "max" or fn == "arbitrary":
             sentinel = _min_sentinel(data.dtype)
-            v = jax.ops.segment_max(jnp.where(w, data, sentinel), slots,
+            v = segred.segment_max(jnp.where(w, data, sentinel), slots,
                                     num_segments=capacity)
         else:
             sentinel = _max_sentinel(data.dtype)
-            v = jax.ops.segment_min(jnp.where(w, data, sentinel), slots,
+            v = segred.segment_min(jnp.where(w, data, sentinel), slots,
                                     num_segments=capacity)
-        c = jax.ops.segment_sum(w.astype(jnp.int64), slots,
+        c = segred.segment_sum(w.astype(jnp.int64), slots,
                                 num_segments=capacity)
         return {"val": v, "count": c}
     if fn == "count_if":
-        return {"count": jax.ops.segment_sum(
+        return {"count": segred.segment_sum(
             (w & data.astype(bool)).astype(jnp.int64), slots,
             num_segments=capacity)}
     if fn in BOOL_FNS:
         b = data.astype(jnp.int32)
-        c = jax.ops.segment_sum(w.astype(jnp.int64), slots,
+        c = segred.segment_sum(w.astype(jnp.int64), slots,
                                 num_segments=capacity)
         if fn == "bool_or":
-            v = jax.ops.segment_max(jnp.where(w, b, 0), slots,
+            v = segred.segment_max(jnp.where(w, b, 0), slots,
                                     num_segments=capacity)
         else:
-            v = jax.ops.segment_min(jnp.where(w, b, 1), slots,
+            v = segred.segment_min(jnp.where(w, b, 1), slots,
                                     num_segments=capacity)
         return {"val": v, "count": c}
     if fn in VAR_FNS:
@@ -390,21 +391,21 @@ def fold(fn: str, data, weight, slots, capacity: int, *,
         # cancels catastrophically for mean >> spread; the reference's
         # accumulators carry M2 for the same reason (Welford merging)
         z = jnp.zeros((), jnp.float64)
-        c = jax.ops.segment_sum(w.astype(jnp.int64), slots,
+        c = segred.segment_sum(w.astype(jnp.int64), slots,
                                 num_segments=capacity)
-        s = jax.ops.segment_sum(jnp.where(w, data, z), slots,
+        s = segred.segment_sum(jnp.where(w, data, z), slots,
                                 num_segments=capacity)
         mean = s / jnp.maximum(c, 1).astype(jnp.float64)
         d = data - mean[slots]
-        m2 = jax.ops.segment_sum(jnp.where(w, d * d, z), slots,
+        m2 = segred.segment_sum(jnp.where(w, d * d, z), slots,
                                  num_segments=capacity)
         return {"count": c, "sum": s, "m2": m2}
     if fn == "geometric_mean":
         z = jnp.zeros((), jnp.float64)
         return {
-            "count": jax.ops.segment_sum(w.astype(jnp.int64), slots,
+            "count": segred.segment_sum(w.astype(jnp.int64), slots,
                                          num_segments=capacity),
-            "sumlog": jax.ops.segment_sum(jnp.where(w, data, z), slots,
+            "sumlog": segred.segment_sum(jnp.where(w, data, z), slots,
                                           num_segments=capacity),
         }
     raise NotImplementedError(fn)
@@ -574,11 +575,11 @@ def merge(fn: str, states: dict, slots, capacity: int, live):
         # register-wise max across partials: segment_max broadcasts over
         # the trailing register axis
         regs = states["regs"]
-        return {"regs": jax.ops.segment_max(
+        return {"regs": segred.segment_max(
             jnp.where(w[:, None], regs, jnp.uint8(0)), slots,
             num_segments=capacity)}
     if fn == "checksum":
-        return {"sum": jax.ops.segment_sum(
+        return {"sum": segred.segment_sum(
             jnp.where(w, states["sum"], jnp.uint64(0)), slots,
             num_segments=capacity)}
     if fn in COVAR_FNS:
@@ -588,15 +589,15 @@ def merge(fn: str, states: dict, slots, capacity: int, live):
         n_i = jnp.where(w, states["count"], 0)
         sx_i = jnp.where(w, states["sumx"], z)
         sy_i = jnp.where(w, states["sumy"], z)
-        n = jax.ops.segment_sum(n_i, slots, num_segments=capacity)
-        sx = jax.ops.segment_sum(sx_i, slots, num_segments=capacity)
-        sy = jax.ops.segment_sum(sy_i, slots, num_segments=capacity)
+        n = segred.segment_sum(n_i, slots, num_segments=capacity)
+        sx = segred.segment_sum(sx_i, slots, num_segments=capacity)
+        sy = segred.segment_sum(sy_i, slots, num_segments=capacity)
         nf_i = jnp.maximum(n_i, 1).astype(jnp.float64)
         nf = jnp.maximum(n, 1).astype(jnp.float64)
         dx = sx_i / nf_i - (sx / nf)[slots]
         dy = sy_i / nf_i - (sy / nf)[slots]
         nw = n_i.astype(jnp.float64)
-        seg = lambda v: jax.ops.segment_sum(  # noqa: E731
+        seg = lambda v: segred.segment_sum(  # noqa: E731
             jnp.where(w, v, z), slots, num_segments=capacity)
         return {"count": n, "sumx": sx, "sumy": sy,
                 "cxy": seg(states["cxy"] + nw * dx * dy),
@@ -606,18 +607,18 @@ def merge(fn: str, states: dict, slots, capacity: int, live):
         present = w & (states["count"] > 0)
         if fn == "max_by":
             sentinel = _min_sentinel(states["val"].dtype)
-            best = jax.ops.segment_max(
+            best = segred.segment_max(
                 jnp.where(present, states["val"], sentinel), slots,
                 num_segments=capacity)
         else:
             sentinel = _max_sentinel(states["val"].dtype)
-            best = jax.ops.segment_min(
+            best = segred.segment_min(
                 jnp.where(present, states["val"], sentinel), slots,
                 num_segments=capacity)
         winner = present & (states["val"] == best[slots])
         xval, xok = _winner_scatter(states["xval"], states["xok"],
                                     winner, slots, capacity)
-        c = jax.ops.segment_sum(jnp.where(w, states["count"], 0), slots,
+        c = segred.segment_sum(jnp.where(w, states["count"], 0), slots,
                                 num_segments=capacity)
         return {"val": best, "xval": xval, "xok": xok, "count": c}
     if fn == "approx_percentile":
@@ -627,7 +628,7 @@ def merge(fn: str, states: dict, slots, capacity: int, live):
         seg2 = (slots.astype(jnp.int64)[:, None] * k
                 + jnp.arange(k, dtype=jnp.int64)[None, :])
         flat_seg = seg2.reshape(-1)
-        minh = jax.ops.segment_min(
+        minh = segred.segment_min(
             jnp.where(w[:, None], rhash, _U64_MAX).reshape(-1),
             flat_seg, num_segments=capacity * k)
         winner = w[:, None] & (rhash == minh[seg2])
@@ -637,15 +638,15 @@ def merge(fn: str, states: dict, slots, capacity: int, live):
         return {"rhash": minh.reshape(capacity, k),
                 "rval": out_val.reshape(capacity, k)}
     if fn in ("count", "count_star"):
-        return {"count": jax.ops.segment_sum(
+        return {"count": segred.segment_sum(
             jnp.where(w, states["count"], 0), slots, num_segments=capacity)}
     if fn in ("sum", "avg"):
         zero = jnp.zeros((), dtype=states["sum"].dtype)
         return {
-            "sum": jax.ops.segment_sum(
+            "sum": segred.segment_sum(
                 jnp.where(w, states["sum"], zero), slots,
                 num_segments=capacity),
-            "count": jax.ops.segment_sum(
+            "count": segred.segment_sum(
                 jnp.where(w, states["count"], 0), slots,
                 num_segments=capacity),
         }
@@ -653,18 +654,18 @@ def merge(fn: str, states: dict, slots, capacity: int, live):
         seg_max = fn in ("max", "arbitrary", "bool_or")
         if seg_max:
             sentinel = _min_sentinel(states["val"].dtype)
-            v = jax.ops.segment_max(
+            v = segred.segment_max(
                 jnp.where(w, states["val"], sentinel), slots,
                 num_segments=capacity)
         else:
             sentinel = _max_sentinel(states["val"].dtype)
-            v = jax.ops.segment_min(
+            v = segred.segment_min(
                 jnp.where(w, states["val"], sentinel), slots,
                 num_segments=capacity)
-        return {"val": v, "count": jax.ops.segment_sum(
+        return {"val": v, "count": segred.segment_sum(
             jnp.where(w, states["count"], 0), slots, num_segments=capacity)}
     if fn == "count_if":
-        return {"count": jax.ops.segment_sum(
+        return {"count": segred.segment_sum(
             jnp.where(w, states["count"], 0), slots, num_segments=capacity)}
     if fn in VAR_FNS:
         # parallel M2 combination (Chan et al.): M2_tot = sum(M2_i) +
@@ -672,12 +673,12 @@ def merge(fn: str, states: dict, slots, capacity: int, live):
         z = jnp.zeros((), jnp.float64)
         n_i = jnp.where(w, states["count"], 0)
         s_i = jnp.where(w, states["sum"], z)
-        n = jax.ops.segment_sum(n_i, slots, num_segments=capacity)
-        s = jax.ops.segment_sum(s_i, slots, num_segments=capacity)
+        n = segred.segment_sum(n_i, slots, num_segments=capacity)
+        s = segred.segment_sum(s_i, slots, num_segments=capacity)
         mean_tot = s / jnp.maximum(n, 1).astype(jnp.float64)
         mean_i = s_i / jnp.maximum(n_i, 1).astype(jnp.float64)
         dev = mean_i - mean_tot[slots]
-        m2 = jax.ops.segment_sum(
+        m2 = segred.segment_sum(
             jnp.where(w, states["m2"], z)
             + n_i.astype(jnp.float64) * dev * dev,
             slots, num_segments=capacity)
@@ -685,10 +686,10 @@ def merge(fn: str, states: dict, slots, capacity: int, live):
     if fn == "geometric_mean":
         z = jnp.zeros((), jnp.float64)
         return {
-            "count": jax.ops.segment_sum(
+            "count": segred.segment_sum(
                 jnp.where(w, states["count"], 0), slots,
                 num_segments=capacity),
-            "sumlog": jax.ops.segment_sum(
+            "sumlog": segred.segment_sum(
                 jnp.where(w, states["sumlog"], z), slots,
                 num_segments=capacity),
         }

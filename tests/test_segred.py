@@ -1,0 +1,102 @@
+"""segred exactness: the MXU limb path must be bit-identical to the
+64-bit scatter-add it replaces (jax.ops.segment_sum), including negative
+values, int64 wraparound, and uint64 checksum sums."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from presto_tpu.ops import segred
+
+
+def _ids(rng, n, k):
+    return jnp.asarray(rng.integers(0, k, n).astype(np.int32))
+
+
+@pytest.mark.parametrize("k", [1, 6, 17, 512])
+def test_sum_int64_matches_scatter(k):
+    rng = np.random.default_rng(7)
+    n = 10_000
+    x = rng.integers(-(1 << 40), 1 << 40, n).astype(np.int64)
+    ids = _ids(rng, n, k)
+    got = np.asarray(segred.segment_sum(jnp.asarray(x), ids, k))
+    want = np.zeros(k, np.int64)
+    np.add.at(want, np.asarray(ids), x)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_sum_int64_wraparound():
+    # two near-max values in one segment: scatter-add wraps mod 2^64
+    n = 300  # >= BLOCK so the fast path engages
+    x = np.zeros(n, np.int64)
+    x[0] = x[1] = (1 << 62) + 12345
+    ids = jnp.zeros(n, jnp.int32)
+    got = np.asarray(segred.segment_sum(jnp.asarray(x), ids, 2))
+    want = np.int64((((1 << 62) + 12345) * 2) % (1 << 64) - (1 << 64))
+    assert got[0] == want
+    assert got[1] == 0
+
+
+def test_sum_uint64_checksum_semantics():
+    rng = np.random.default_rng(3)
+    n = 5_000
+    x = rng.integers(0, 1 << 63, n).astype(np.uint64)
+    ids = _ids(rng, n, 9)
+    got = np.asarray(segred.segment_sum(jnp.asarray(x), ids, 9))
+    want = np.zeros(9, np.uint64)
+    for i, g in enumerate(np.asarray(ids)):
+        want[g] += x[i]
+    np.testing.assert_array_equal(got, want)
+
+
+def test_sum_bool_counts():
+    rng = np.random.default_rng(5)
+    n = 4_097
+    w = rng.integers(0, 2, n).astype(bool)
+    ids = _ids(rng, n, 6)
+    got = np.asarray(segred.segment_sum(jnp.asarray(w), ids, 6))
+    want = np.bincount(np.asarray(ids)[w], minlength=6)
+    np.testing.assert_array_equal(got, want)
+    assert got.dtype == np.int64
+
+
+@pytest.mark.parametrize("dtype", [np.int64, np.float64])
+def test_min_max_match(dtype):
+    rng = np.random.default_rng(11)
+    n = 3_000
+    if dtype is np.int64:
+        x = rng.integers(-(1 << 50), 1 << 50, n).astype(dtype)
+    else:
+        x = rng.standard_normal(n).astype(dtype) * 1e12
+    ids = _ids(rng, n, 13)
+    ids_np = np.asarray(ids)
+    gmax = np.asarray(segred.segment_max(jnp.asarray(x), ids, 13))
+    gmin = np.asarray(segred.segment_min(jnp.asarray(x), ids, 13))
+    for g in range(13):
+        sel = x[ids_np == g]
+        assert gmax[g] == sel.max()
+        assert gmin[g] == sel.min()
+
+
+def test_empty_segment_identities():
+    # segment 1 receives no rows: sum=0, max=dtype-min (jax.ops contract)
+    n = 300
+    x = jnp.arange(n, dtype=jnp.int64)
+    ids = jnp.zeros(n, jnp.int32)
+    s = np.asarray(segred.segment_sum(x, ids, 2))
+    assert s[1] == 0
+    mx = np.asarray(segred.segment_max(x, ids, 2))
+    assert mx[1] == np.iinfo(np.int64).min
+
+
+def test_large_k_falls_back():
+    # above MAX_MATMUL_K the scatter path must be used and still correct
+    rng = np.random.default_rng(2)
+    n = 2_000
+    k = segred.MAX_MATMUL_K + 1
+    x = rng.integers(-100, 100, n).astype(np.int64)
+    ids = _ids(rng, n, k)
+    got = np.asarray(segred.segment_sum(jnp.asarray(x), ids, k))
+    want = np.zeros(k, np.int64)
+    np.add.at(want, np.asarray(ids), x)
+    np.testing.assert_array_equal(got, want)
