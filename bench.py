@@ -49,32 +49,23 @@ def numpy_q1_baseline(arrays: dict[str, np.ndarray], cutoff: int) -> float:
 
 
 def steady_state_sql(engine, sql: str, reps: int) -> float:
-    """Compile a SQL query once (with capacity retries) and return the best
-    steady-state wall seconds over ``reps`` device-resident runs."""
+    """Compile a SQL query once (via the engine's program cache, with
+    capacity retries) and return the best steady-state wall seconds over
+    ``reps`` device-resident runs."""
     import jax
 
-    from presto_tpu.exec.executor import collect_scans, make_traced
+    from presto_tpu.exec.executor import collect_scans, prepare_plan
 
     plan, _ = engine.plan_sql(sql)
     scan_inputs = collect_scans(plan, engine)
-    capacities: dict[tuple, int] = {}
-    for _ in range(10):
-        traced_fn, flat_arrays, meta = make_traced(
-            scan_inputs, plan, capacities, engine.session)
-        device_args = [jax.device_put(a) for a in flat_arrays]
-        compiled = jax.jit(traced_fn)
-        _res, live, oks = compiled(*device_args)
-        np.asarray(live)  # host materialization = real device sync
-        if all(bool(o) for o in oks):
-            break
-        for key, okv in zip(meta["ok_keys"], oks):
-            if not bool(okv):
-                capacities[key] = 2 * meta["used_capacity"][key]
-    else:
-        raise RuntimeError("capacity retry limit exceeded")
+    compiled, flat_arrays, _meta, _out = prepare_plan(
+        engine, plan, scan_inputs)
+    device_args = [jax.device_put(a) for a in flat_arrays]
     times = []
     for _ in range(reps):
         t0 = time.perf_counter()
+        # host materialization = real device sync (block_until_ready
+        # does not reliably block on tunneled accelerator platforms)
         np.asarray(compiled(*device_args)[1])
         times.append(time.perf_counter() - t0)
     return min(times)

@@ -21,11 +21,25 @@ compacting, hash tables have planner-chosen capacities with host-side
 retry on overflow, and exchanges pad to fixed per-partition capacities.
 """
 
+import os
+
 import jax
 
 # SQL semantics need 64-bit integers (BIGINT, scaled DECIMAL) and float64.
 # This must run before any array is materialised.
 jax.config.update("jax_enable_x64", True)
+
+# Persistent XLA compilation cache: SQL plans compile to large monolithic
+# programs (tens of seconds for multi-join queries); caching the compiled
+# executables on disk makes repeat processes (test suite, bench driver)
+# pay the compile once per program. Opt out with PRESTO_TPU_XLA_CACHE="".
+_cache_dir = os.environ.get(
+    "PRESTO_TPU_XLA_CACHE",
+    os.path.join(os.path.dirname(__file__), os.pardir, ".xla_cache"))
+if _cache_dir:
+    jax.config.update("jax_compilation_cache_dir",
+                      os.path.abspath(_cache_dir))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
 from presto_tpu.types import (  # noqa: E402
     BIGINT,

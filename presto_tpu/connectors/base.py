@@ -53,6 +53,14 @@ class Connector:
         columns mean unknown."""
         return {}
 
+    def column_range_estimates(
+            self, name: str) -> dict[str, tuple[float, float]]:
+        """Cheap per-column (min, max) physical-value estimates for
+        range-predicate selectivity (must not force data generation;
+        analog of spi/statistics ColumnStatistics range). Missing
+        columns mean unknown."""
+        return {}
+
     def unique_keys(self, name: str) -> list[tuple[str, ...]]:
         """Column sets known unique (primary keys). Lets the planner pick
         the single-match hash-join fast path (reference JoinNode's

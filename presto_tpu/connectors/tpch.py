@@ -499,6 +499,44 @@ class TpchConnector(Connector):
             out[col] = min(self.row_count_estimate(ref), rows)
         return {c: min(n, rows) for c, n in out.items()}
 
+    # Physical-value (min, max) per column for range-predicate
+    # selectivity, from the generator's closed-form distributions above
+    # (analog of the reference tpch connector's shipped column stats,
+    # plugin/trino-tpch src/main/resources JSON). Dates are day numbers,
+    # decimals scaled integers.
+    _RANGE_CONST = {
+        "orders": {"o_orderdate": (STARTDATE, ENDDATE - 151),
+                   "o_totalprice": (90000, 60000000)},
+        "lineitem": {"l_shipdate": (STARTDATE + 1, ENDDATE - 30),
+                     "l_commitdate": (STARTDATE + 30, ENDDATE - 61),
+                     "l_receiptdate": (STARTDATE + 2, ENDDATE),
+                     "l_quantity": (100, 5000),
+                     "l_discount": (0, 10),
+                     "l_tax": (0, 8),
+                     "l_extendedprice": (90000, 11000000),
+                     "l_linenumber": (1, 7)},
+        "part": {"p_size": (1, 50), "p_retailprice": (90000, 210000)},
+        "partsupp": {"ps_supplycost": (100, 100000),
+                     "ps_availqty": (1, 9999)},
+        "customer": {"c_acctbal": (-99999, 999999)},
+        "supplier": {"s_acctbal": (-99999, 999999)},
+        "nation": {"n_nationkey": (0, 24), "n_regionkey": (0, 4)},
+        "region": {"r_regionkey": (0, 4)},
+    }
+
+    def column_range_estimates(self, name: str):
+        out = dict(self._RANGE_CONST.get(name, {}))
+        # primary keys are dense 1..n
+        key_col = {"orders": "o_orderkey", "customer": "c_custkey",
+                   "part": "p_partkey", "supplier": "s_suppkey"}
+        if name in key_col:
+            out[key_col[name]] = (1, self.row_count_estimate(name))
+        if name == "lineitem":
+            out["l_orderkey"] = (1, self.row_count_estimate("orders"))
+            out["l_partkey"] = (1, self.row_count_estimate("part"))
+            out["l_suppkey"] = (1, self.row_count_estimate("supplier"))
+        return out
+
     def stats(self, name: str) -> TableStats:
         raw = self._raw(name)
         nrows = len(next(iter(raw.values())))

@@ -28,6 +28,11 @@ class Engine:
 
         self.session = session or Session()
         self.catalogs: dict[str, Connector] = {}
+        # compiled-program cache + per-plan successful capacity vectors
+        # (exec/executor.py prepare_plan; reference analog:
+        # gen/PageFunctionCompiler.java:101 compiled-artifact caches)
+        self._program_cache: dict = {}
+        self._caps_memory: dict = {}
         # populated by the spill driver when a query exceeds the memory
         # budget and runs host-partitioned (exec/spill.py)
         self.last_spill: dict | None = None

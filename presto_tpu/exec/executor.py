@@ -71,14 +71,31 @@ def _df_hash(v: Val):
     return H.hash_int_column(v.data, v.valid)
 
 
+def preorder_index(plan: N.PlanNode) -> dict[int, int]:
+    """id(node) -> stable preorder position. Capacity-override keys use
+    this instead of id() so a successful capacity vector transfers to a
+    structurally identical re-plan of the same query (program cache)."""
+    order: dict[int, int] = {}
+
+    def visit(node):
+        order[id(node)] = len(order)
+        for s in node.sources():
+            visit(s)
+
+    visit(plan)
+    return order
+
+
 class PlanInterpreter:
     """Walks the plan during trace, building the XLA computation."""
 
     def __init__(self, scans: dict[int, tuple[ScanInput, dict]],
-                 capacities: dict[tuple, int], session=None):
+                 capacities: dict[tuple, int], session=None,
+                 node_order: dict[int, int] | None = None):
         from presto_tpu.session import Session
         self.scans = scans  # id(node) -> (ScanInput, traced arrays)
-        self.capacities = capacities  # (id(node), kind) -> forced capacity
+        self.capacities = capacities  # (node pos, kind) -> forced capacity
+        self.node_order = node_order or {}
         self.session = session or Session()
         self.ok_flags: list = []
         self.ok_keys: list[tuple] = []
@@ -138,11 +155,14 @@ class PlanInterpreter:
             registered.append(lk)
         return registered
 
+    def _node_key(self, node, kind: str) -> tuple:
+        return (self.node_order.get(id(node), id(node)), kind)
+
     def _capacity(self, node, default: int, kind: str = "table",
                   override: int | None = None) -> int:
         """Host retry override > session override > planner hint >
         default."""
-        cap = self.capacities.get((id(node), kind))
+        cap = self.capacities.get(self._node_key(node, kind))
         if cap is None:
             if override:
                 cap = next_pow2(override)
@@ -152,12 +172,12 @@ class PlanInterpreter:
                 cap = getattr(node, "output_capacity", None) or default
             else:
                 cap = default
-        self.used_capacity[(id(node), kind)] = cap
+        self.used_capacity[self._node_key(node, kind)] = cap
         return cap
 
     def _note_ok(self, node, ok, kind: str = "table"):
         self.ok_flags.append(ok)
-        self.ok_keys.append((id(node), kind))
+        self.ok_keys.append(self._node_key(node, kind))
 
     def _r_tablescan(self, node: N.TableScan) -> DTable:
         scan, traced = self.scans[id(node)]
@@ -292,6 +312,7 @@ def make_traced(scan_inputs: list[ScanInput], plan: N.PlanNode,
     flat_arrays = [
         scan.arrays[sym] for scan in scan_inputs for sym in scan.arrays]
     meta: dict[str, object] = {}
+    node_order = preorder_index(plan)
 
     def traced_fn(*args):
         it = iter(args)
@@ -299,7 +320,7 @@ def make_traced(scan_inputs: list[ScanInput], plan: N.PlanNode,
         for scan in scan_inputs:
             traced = {sym: next(it) for sym in scan.arrays}
             scans[id(scan.node)] = (scan, traced)
-        interp = PlanInterpreter(scans, capacities, session)
+        interp = PlanInterpreter(scans, capacities, session, node_order)
         out = interp.run(plan)
         meta["out"] = [
             (sym, v.dtype, v.dictionary, v.valid is not None)
@@ -334,27 +355,69 @@ def execute_plan(engine, plan: N.PlanNode) -> Table:
     return run_plan(engine, plan, scan_inputs)
 
 
+RETRY_GROWTH = 4  # overshoot on overflow to bound recompiles at ~1
+
+
+def _cache_key(engine, plan, scan_inputs, capacities):
+    from presto_tpu.plan.fingerprint import plan_fingerprint
+    fp = plan_fingerprint(plan)
+    shapes = tuple(
+        (sym, a.shape, str(a.dtype))
+        for scan in scan_inputs for sym, a in scan.arrays.items())
+    sess = tuple(sorted(
+        (k, repr(v)) for k, v in engine.session.properties.items()))
+    return (fp, shapes, sess), tuple(sorted(capacities.items()))
+
+
+def prepare_plan(engine, plan: N.PlanNode, scan_inputs: list[ScanInput]):
+    """Resolve hash-table capacities and return
+    (compiled, flat_arrays, meta, (res, live, oks)) for a plan, reusing
+    the engine's compiled-program cache.
+
+    The cache is the analog of the reference's compiled-artifact caches
+    (gen/PageFunctionCompiler.java:101): programs key on
+    (plan fingerprint, input shapes, session, capacity overrides), and
+    the capacity vector that succeeded is remembered per plan so a
+    repeat query goes straight to the right program — zero recompiles.
+    On overflow, EVERY failed capacity grows RETRY_GROWTH x at once
+    (host-side analog of the reference's rehash,
+    MultiChannelGroupByHash.java:140, overshooting to bound the number
+    of recompiles instead of doubling per node)."""
+    base_key, _ = _cache_key(engine, plan, scan_inputs, {})
+    capacities = dict(engine._caps_memory.get(base_key, {}))
+
+    for _attempt in range(6):
+        caps_key = tuple(sorted(capacities.items()))
+        entry = engine._program_cache.get((base_key, caps_key))
+        flat_arrays = [scan.arrays[sym]
+                       for scan in scan_inputs for sym in scan.arrays]
+        if entry is None:
+            traced_fn, flat_arrays, meta = make_traced(
+                scan_inputs, plan, capacities, engine.session)
+            compiled = jax.jit(traced_fn)
+            out = compiled(*flat_arrays)
+            # meta fills during the trace triggered by the first call
+            engine._program_cache[(base_key, caps_key)] = (compiled, meta)
+        else:
+            compiled, meta = entry
+            out = compiled(*flat_arrays)
+        res, live, oks = out
+        if all(bool(o) for o in oks):
+            engine._caps_memory[base_key] = dict(capacities)
+            return compiled, flat_arrays, meta, (res, live, oks)
+        for key, okv in zip(meta["ok_keys"], oks):
+            if not bool(okv):
+                capacities[key] = (RETRY_GROWTH
+                                   * meta["used_capacity"][key])
+    raise RuntimeError("hash table capacity retry limit exceeded")
+
+
 def run_plan(engine, plan: N.PlanNode,
              scan_inputs: list[ScanInput]) -> Table:
     """Compile + run over prepared scan inputs (shared by the whole-table
     and block-streamed paths)."""
-    capacities: dict[tuple, int] = {}
-
-    for _attempt in range(10):
-        traced_fn, flat_arrays, meta = make_traced(
-            scan_inputs, plan, capacities, engine.session)
-        compiled = jax.jit(traced_fn)
-        res, live, oks = compiled(*flat_arrays)
-        if all(bool(o) for o in oks):
-            break
-        # a hash table (or expand-join output) overflowed: double that
-        # node's capacity and recompile (host-side analog of the
-        # reference's rehash, MultiChannelGroupByHash.java:140)
-        for key, okv in zip(meta["ok_keys"], oks):
-            if not bool(okv):
-                capacities[key] = 2 * meta["used_capacity"][key]
-    else:
-        raise RuntimeError("hash table capacity retry limit exceeded")
+    _compiled, _flat, meta, (res, live, _oks) = prepare_plan(
+        engine, plan, scan_inputs)
 
     live_np = np.asarray(live)
     cols: dict[str, Column] = {}

@@ -70,7 +70,7 @@ def explain_analyze(engine, plan: N.PlanNode) -> str:
             break
         for key, okv in zip(meta["ok_keys"], oks):
             if not bool(np.asarray(okv)):
-                capacities[key] = 2 * meta["used_capacity"][key]
+                capacities[key] = 4 * meta["used_capacity"][key]
     else:
         raise RuntimeError("hash table capacity retry limit exceeded")
 

@@ -151,7 +151,7 @@ def _run_partitions(engine, jp: N.Join, part_inputs: list) -> list[Table]:
             if not all(bool(o) for o in oks):
                 for key, okv in zip(meta["ok_keys"], oks):
                     if not bool(okv):
-                        capacities[key] = 2 * meta["used_capacity"][key]
+                        capacities[key] = 4 * meta["used_capacity"][key]
                 overflow = True
                 break
             results.append((res, live))

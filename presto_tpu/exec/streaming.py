@@ -128,7 +128,7 @@ def try_execute_streamed(engine, plan: N.PlanNode):
                 break
             for key, okv in zip(meta["ok_keys"], oks):
                 if not bool(okv):
-                    capacities[key] = 2 * meta["used_capacity"][key]
+                    capacities[key] = 4 * meta["used_capacity"][key]
             compiled = None  # recompile with grown capacity
         else:
             raise RuntimeError("hash table capacity retry limit exceeded")

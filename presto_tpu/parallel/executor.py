@@ -525,7 +525,7 @@ def execute_plan_distributed(engine, plan: N.PlanNode,
             break
         for key, okv in zip(meta["ok_keys"], oks):
             if not bool(np.asarray(okv)):
-                capacities[key] = 2 * meta["used_capacity"][key]
+                capacities[key] = 4 * meta["used_capacity"][key]
     else:
         raise RuntimeError("hash table capacity retry limit exceeded")
 

@@ -572,6 +572,7 @@ def rewrite_subtrees(e: ir.Expr, mapping: dict[ir.Expr, ir.Expr]) -> ir.Expr:
 
 
 from presto_tpu.ops.hash import next_pow2 as _next_pow2  # noqa: E402
+from presto_tpu.plan.stats import selectivity as _selectivity  # noqa: E402
 
 
 def _expr_name(e: A.Expression) -> str:
@@ -594,6 +595,10 @@ class RelationPlan:
     scope: Scope
     est: int  # static cardinality estimate for join ordering
     unique: list[frozenset[str]] = dataclasses.field(default_factory=list)
+    # cumulative filter selectivity applied to this relation: a unique
+    # (PK) build side keeps only this fraction of FK probe rows
+    # (cost/JoinStatsRule.java containment analog)
+    sel: float = 1.0
 
 
 @dataclasses.dataclass
@@ -640,6 +645,9 @@ class LogicalPlanner:
         # are globally unique per planner, so one map serves the whole
         # plan (analog of the reference's SymbolStatsEstimate in cost/)
         self.ndv: dict[str, int] = {}
+        # symbol -> (lo, hi) physical value range for range-predicate
+        # selectivity (cost/FilterStatsCalculator.java analog)
+        self.ranges: dict[str, tuple[float, float]] = {}
 
     # -- entry --------------------------------------------------------------
 
@@ -832,6 +840,9 @@ class LogicalPlanner:
         for col, nd in conn.ndv_estimates(table).items():
             if col in colsyms:
                 self.ndv[colsyms[col]] = nd
+        for col, rng in conn.column_range_estimates(table).items():
+            if col in colsyms:
+                self.ranges[colsyms[col]] = rng
         return RelationPlan(node, Scope(fields), est, unique)
 
     def plan_values(self, rel: A.ValuesRelation) -> RelationPlan:
@@ -1190,8 +1201,11 @@ class LogicalPlanner:
             if len(leg_ids) <= 1:
                 li = leg_ids.pop() if leg_ids else 0
                 leg = legs[li]
+                s = _selectivity(planned, self.ndv, self.ranges)
                 legs[li] = RelationPlan(N.Filter(leg.node, planned),
-                                        leg.scope, leg.est, leg.unique)
+                                        leg.scope,
+                                        max(int(leg.est * s), 1),
+                                        leg.unique, leg.sel * s)
                 continue
             if (len(leg_ids) == 2 and isinstance(planned, ir.Call)
                     and planned.fn == "eq"):
@@ -1298,7 +1312,10 @@ class LogicalPlanner:
                           output_capacity=None if build_unique else
                           _next_pow2(2 * max(est, build.est)))
             if build_unique:
-                pass  # est unchanged; probe-side uniqueness preserved
+                # FK->PK join: a filtered PK side keeps only its
+                # selectivity fraction of probe rows (containment,
+                # cost/JoinStatsRule.java analog)
+                est = max(int(est * build.sel), 1)
             else:
                 est = max(est, build.est) * 2
                 # each output row is a distinct (probe row, build row)

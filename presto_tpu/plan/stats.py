@@ -1,0 +1,130 @@
+"""Filter selectivity estimation over planned IR.
+
+The load-bearing slice of the reference's stats/cost subsystem
+(cost/FilterStatsCalculator.java, cost/StatsCalculator.java): predicate
+conjuncts on a relation scale its cardinality estimate before join
+ordering, hash-table capacity sizing, and broadcast-vs-partitioned
+decisions. Estimates use per-symbol NDV and value ranges from connector
+stats; anything unrecognized falls back to Trino's unknown-filter
+coefficient.
+
+Capacities derived from these estimates are rounded to power-of-two
+buckets by the callers (ops/hash.next_pow2), so similar inputs compile
+identical programs — the compiled-program cache (exec/executor.py)
+depends on estimates being coarse, not exact.
+"""
+
+from __future__ import annotations
+
+from presto_tpu.expr import ir
+
+# reference cost/FilterStatsCalculator.java UNKNOWN_FILTER_COEFFICIENT
+UNKNOWN_FILTER_COEFFICIENT = 0.9
+
+
+def selectivity(expr: ir.Expr, ndv: dict[str, int],
+                ranges: dict[str, tuple[float, float]]) -> float:
+    """Estimated fraction of rows satisfying ``expr`` (0 < f <= 1)."""
+    return max(min(_sel(expr, ndv, ranges), 1.0), 1e-9)
+
+
+def _literal_number(e: ir.Expr):
+    if isinstance(e, ir.Literal) and isinstance(e.value, (int, float)):
+        return float(e.value)
+    return None
+
+
+def _col_and_lit(args):
+    a, b = args
+    if isinstance(a, ir.ColumnRef):
+        lit = _literal_number(b)
+        if lit is not None:
+            return a, lit, False
+    if isinstance(b, ir.ColumnRef):
+        lit = _literal_number(a)
+        if lit is not None:
+            return b, lit, True
+    return None, None, False
+
+
+def _range_fraction(col: str, lit: float, op: str,
+                    ranges: dict[str, tuple[float, float]]):
+    r = ranges.get(col)
+    if r is None:
+        return None
+    lo, hi = float(r[0]), float(r[1])
+    if hi <= lo:
+        return None
+    span = hi - lo
+    if op in ("lt", "lte"):
+        return (lit - lo) / span
+    return (hi - lit) / span  # gt / gte
+
+
+def _sel(expr: ir.Expr, ndv, ranges) -> float:
+    if not isinstance(expr, ir.Call):
+        return UNKNOWN_FILTER_COEFFICIENT
+    fn = expr.fn
+    if fn == "and":
+        out = 1.0
+        for a in expr.args:
+            out *= _sel(a, ndv, ranges)
+        return out
+    if fn == "or":
+        out = 0.0
+        for a in expr.args:
+            s = _sel(a, ndv, ranges)
+            out = out + s - out * s  # independence union
+        return out
+    if fn == "not":
+        return 1.0 - _sel(expr.args[0], ndv, ranges)
+    if fn == "eq" and len(expr.args) == 2:
+        col, lit, _sw = _col_and_lit(expr.args)
+        if col is not None:
+            nd = ndv.get(col.name)
+            if nd:
+                return 1.0 / nd
+        return UNKNOWN_FILTER_COEFFICIENT * 0.5
+    if fn == "neq" and len(expr.args) == 2:
+        col, lit, _sw = _col_and_lit(expr.args)
+        if col is not None:
+            nd = ndv.get(col.name)
+            if nd:
+                return 1.0 - 1.0 / nd
+        return UNKNOWN_FILTER_COEFFICIENT
+    if fn in ("lt", "lte", "gt", "gte") and len(expr.args) == 2:
+        col, lit, swapped = _col_and_lit(expr.args)
+        if col is not None:
+            op = fn
+            if swapped:  # lit < col  ==  col > lit
+                op = {"lt": "gt", "lte": "gte",
+                      "gt": "lt", "gte": "lte"}[fn]
+            f = _range_fraction(col.name, lit, op, ranges)
+            if f is not None:
+                return max(min(f, 1.0), 0.0)
+        return UNKNOWN_FILTER_COEFFICIENT * 0.5
+    if fn == "between" and len(expr.args) == 3:
+        col = expr.args[0]
+        lo = _literal_number(expr.args[1])
+        hi = _literal_number(expr.args[2])
+        if isinstance(col, ir.ColumnRef) and lo is not None \
+                and hi is not None:
+            f_lo = _range_fraction(col.name, lo, "gte", ranges)
+            f_hi = _range_fraction(col.name, hi, "lte", ranges)
+            if f_lo is not None and f_hi is not None:
+                return max(min(f_lo + f_hi - 1.0, 1.0), 0.0)
+        return 0.25
+    if fn == "in" and len(expr.args) >= 2:
+        col = expr.args[0]
+        if isinstance(col, ir.ColumnRef):
+            nd = ndv.get(col.name)
+            if nd:
+                return min(float(len(expr.args) - 1) / nd, 1.0)
+        return 0.25
+    if fn == "like":
+        return 0.25
+    if fn == "is_null":
+        return 0.1
+    if fn == "is_not_null":
+        return 0.9
+    return UNKNOWN_FILTER_COEFFICIENT
