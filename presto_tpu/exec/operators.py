@@ -84,6 +84,23 @@ def _row_hash(dt: DTable, keys: list[str]):
 _DIRECT_GROUP_MAX = 1 << 16
 
 
+def _group_key_operand(v: Val):
+    """Normalize a group-key column for exact key-identity sorting:
+    NULL rows collapse to one value, NaNs to one bit pattern, and
+    +-0.0 unify (SQL grouping equality), so equal keys are equal
+    operands."""
+    data = v.data
+    if jnp.issubdtype(data.dtype, jnp.floating):
+        bits = jnp.where(data == 0, jnp.zeros_like(data), data)
+        bits = bits.view(jnp.int64 if data.dtype == jnp.float64
+                         else jnp.int32)
+        data = jnp.where(jnp.isnan(v.data),
+                         jnp.full_like(bits, -1), bits)
+    if v.valid is not None:
+        data = jnp.where(v.valid, data, jnp.zeros_like(data))
+    return data
+
+
 def _direct_group_ids(dt: DTable, keys: list[str]):
     """Low-cardinality fast path: when every group key is a non-null
     dictionary-encoded column with a small code product, the group id is
@@ -167,7 +184,9 @@ def _apply_aggregate_sorted(dt: DTable, node: N.Aggregate, capacity: int,
     rh = _row_hash(dt, node.group_keys)
     is_final = node.step == N.AggStep.FINAL
 
-    # assemble sort payloads: key columns + per-call prepared inputs
+    # assemble sort payloads: key columns first (they double as
+    # SECONDARY SORT KEYS so group identity is the exact key tuple, not
+    # the 64-bit hash — see SortedGroups), then per-call agg inputs
     payloads: list = []
 
     def _add(arr) -> int:
@@ -175,10 +194,20 @@ def _apply_aggregate_sorted(dt: DTable, node: N.Aggregate, capacity: int,
         return len(payloads) - 1
 
     key_refs = []  # (sym, Val, data_idx, valid_idx)
+    float_keys = []  # float originals ride outside the key section
     for k in node.group_keys:
         v = dt.cols[k]
-        key_refs.append((k, v, _add(v.data),
-                         None if v.valid is None else _add(v.valid)))
+        norm_idx = _add(_group_key_operand(v))
+        valid_idx = None if v.valid is None else _add(v.valid)
+        if jnp.issubdtype(v.data.dtype, jnp.floating):
+            # the normalized operand is a bit view; keep the original
+            # float data as a plain payload for output
+            float_keys.append((k, v, valid_idx))
+        else:
+            key_refs.append((k, v, norm_idx, valid_idx))
+    num_key_payloads = len(payloads)
+    for k, v, valid_idx in float_keys:
+        key_refs.append((k, v, _add(v.data), valid_idx))
 
     call_refs: dict[str, tuple] = {}
     for sym, call in node.aggs.items():
@@ -204,7 +233,7 @@ def _apply_aggregate_sorted(dt: DTable, node: N.Aggregate, capacity: int,
                 call_refs[sym] = ("seg", (data, weight, data2,
                                           data_valid), arg_type)
 
-    sg = H.SortedGroups(rh, live, payloads)
+    sg = H.SortedGroups(rh, live, payloads, num_key_payloads)
     ok = sg.ngroups <= capacity
     sp = sg.payloads
     slots = None  # lazily built for segment-op fallbacks (sketches)
@@ -978,7 +1007,13 @@ def apply_mark_distinct(dt: DTable, node: N.MarkDistinct,
     hash-slot assignment + a segment-min race for the first row)."""
     live = dt.live_mask()
     rh = _row_hash(dt, node.keys)
-    sg = H.SortedGroups(rh, live)
+    key_ops = []
+    for k in node.keys:
+        v = dt.cols[k]
+        key_ops.append(_group_key_operand(v))
+        if v.valid is not None:
+            key_ops.append(v.valid)
+    sg = H.SortedGroups(rh, live, key_ops, len(key_ops))
     # is_new flags the first sorted row of each key run (stable sort ->
     # the smallest source index); a second sort keyed by the source row
     # index inverts the permutation without a scatter
@@ -1000,13 +1035,23 @@ def apply_distinct(dt: DTable, capacity: int) -> tuple:
     rh = _row_hash(dt, list(dt.cols))
     payloads = []
     refs = []
+    float_cols = []
     for sym, v in dt.cols.items():
-        refs.append((sym, v, len(payloads),
-                     None if v.valid is None else len(payloads) + 1))
-        payloads.append(v.data)
+        di = len(payloads)
+        payloads.append(_group_key_operand(v))
+        vi = None
         if v.valid is not None:
+            vi = len(payloads)
             payloads.append(v.valid)
-    sg = H.SortedGroups(rh, live, payloads)
+        if jnp.issubdtype(v.data.dtype, jnp.floating):
+            float_cols.append((sym, v, vi))
+        else:
+            refs.append((sym, v, di, vi))
+    num_key_payloads = len(payloads)
+    for sym, v, vi in float_cols:
+        refs.append((sym, v, len(payloads), vi))
+        payloads.append(v.data)
+    sg = H.SortedGroups(rh, live, payloads, num_key_payloads)
     ok = sg.ngroups <= capacity
     compacted, occupied = sg.compact_first(sg.payloads, capacity)
     out = {}

@@ -143,21 +143,36 @@ class SortedGroups:
     __slots__ = ("sh", "sidx", "payloads", "live", "is_new", "is_last",
                  "start", "gidc", "ngroups")
 
-    def __init__(self, row_hash, live, payloads=()):
+    def __init__(self, row_hash, live, payloads=(), num_key_payloads=0):
+        """``num_key_payloads``: the first K payload arrays are the
+        group-key columns themselves (normalized data + validity). They
+        participate as SECONDARY SORT KEYS, and group boundaries come
+        from hash-or-key changes — group identity is the actual key
+        tuple, not the 64-bit hash, so two distinct keys colliding in
+        64 bits still form two groups (the reference always
+        value-compares after a hash hit, MultiChannelGroupByHash;
+        a probabilistic group identity has no place in a SQL engine)."""
         n = row_hash.shape[0]
         h = jnp.where(live, row_hash, _EMPTY)
         out = jax.lax.sort(
-            (h, jnp.arange(n, dtype=jnp.int32)) + tuple(payloads),
-            num_keys=1, is_stable=True)
-        sh, sidx = out[0], out[1]
-        self.payloads = out[2:]
+            (h,) + tuple(payloads[:num_key_payloads])
+            + (jnp.arange(n, dtype=jnp.int32),)
+            + tuple(payloads[num_key_payloads:]),
+            num_keys=1 + num_key_payloads, is_stable=True)
+        sh = out[0]
+        sidx = out[1 + num_key_payloads]
+        self.payloads = (out[1:1 + num_key_payloads]
+                         + out[2 + num_key_payloads:])
         self.sh, self.sidx = sh, sidx
         self.live = sh != _EMPTY
         i = jnp.arange(n, dtype=jnp.int32)
+        differs = sh[1:] != sh[:-1]
+        for kp in out[1:1 + num_key_payloads]:
+            differs = differs | (kp[1:] != kp[:-1])
         self.is_new = (jnp.concatenate(
-            [jnp.ones((1,), bool), sh[1:] != sh[:-1]]) & self.live)
+            [jnp.ones((1,), bool), differs]) & self.live)
         self.is_last = (jnp.concatenate(
-            [sh[:-1] != sh[1:], jnp.ones((1,), bool)]) & self.live)
+            [differs, jnp.ones((1,), bool)]) & self.live)
         self.start = jnp.clip(
             jax.lax.cummax(jnp.where(self.is_new, i, -1)), 0, None)
         gid = jnp.cumsum(self.is_new.astype(jnp.int32)) - 1

@@ -1,0 +1,84 @@
+"""Group identity must be the actual key tuple, not its 64-bit hash:
+with the row hash sabotaged to collide constantly, group-by / DISTINCT /
+mark-distinct results must still be exact (VERDICT round 2 #5; reference
+behavior: key equality check after every hash hit,
+operator/MultiChannelGroupByHash.java)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from presto_tpu import Engine
+from presto_tpu.connectors.memory import MemoryConnector
+from presto_tpu import types as T
+from presto_tpu.ops import hash as H
+
+
+@pytest.fixture
+def colliding_hash(monkeypatch):
+    # every int column hashes to one of TWO values: massive collisions
+    def bad_hash(data, valid=None):
+        h = (data.astype(jnp.int64) % 2).astype(jnp.uint64)
+        if valid is not None:
+            h = jnp.where(valid, h, H._NULL_KEY_HASH)
+        return h
+
+    monkeypatch.setattr(H, "hash_int_column", bad_hash)
+
+
+@pytest.fixture
+def engine():
+    e = Engine()
+    conn = MemoryConnector()
+    rng = np.random.default_rng(42)
+    n = 5_000
+    keys = rng.integers(0, 50, n).astype(np.int64)
+    vals = rng.integers(0, 1000, n).astype(np.int64)
+    conn.create_table(
+        "t", {"k": T.BIGINT, "v": T.BIGINT},
+        {"k": keys, "v": vals}, {"k": None, "v": None})
+    e.register_catalog("mem", conn)
+    e.session.catalog = "mem"
+    e._ref = (keys, vals)
+    return e
+
+
+def test_group_by_under_collisions(engine, colliding_hash):
+    rows = engine.execute("SELECT k, count(*), sum(v) FROM t GROUP BY k")
+    keys, vals = engine._ref
+    want = {}
+    for k, v in zip(keys.tolist(), vals.tolist()):
+        c, s = want.get(k, (0, 0))
+        want[k] = (c + 1, s + v)
+    got = {r[0]: (r[1], r[2]) for r in rows}
+    assert got == want
+
+
+def test_distinct_under_collisions(engine, colliding_hash):
+    rows = engine.execute("SELECT DISTINCT k FROM t")
+    keys, _ = engine._ref
+    assert sorted(r[0] for r in rows) == sorted(set(keys.tolist()))
+
+
+def test_count_distinct_under_collisions(engine, colliding_hash):
+    # count(DISTINCT v) plans through mark-distinct
+    rows = engine.execute("SELECT count(DISTINCT v) FROM t")
+    _, vals = engine._ref
+    assert rows[0][0] == len(set(vals.tolist()))
+
+
+def test_group_by_nulls_vs_zero_under_collisions(engine, colliding_hash):
+    # NULL keys group together and apart from literal 0 even when the
+    # normalized key operand zeroes NULL rows' data
+    engine.execute(
+        "CREATE TABLE tn AS SELECT "
+        "CASE WHEN k < 10 THEN NULL ELSE k END AS k2, v FROM t")
+    rows = engine.execute(
+        "SELECT k2, count(*) FROM tn GROUP BY k2")
+    keys, _ = engine._ref
+    want: dict = {}
+    for k in keys.tolist():
+        k2 = None if k < 10 else k
+        want[k2] = want.get(k2, 0) + 1
+    got = {r[0]: r[1] for r in rows}
+    assert got == want
