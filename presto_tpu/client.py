@@ -48,6 +48,18 @@ class Client:
                 time.sleep(poll_interval)
             out = self._request("GET", next_uri)
 
+    def submit(self, sql: str) -> tuple[str, dict]:
+        """Fire-and-poll entry: POST the statement, return
+        (query_id, first response) without waiting for completion."""
+        out = self._request("POST", f"{self.base_url}/v1/statement",
+                            sql.encode())
+        return out["id"], out
+
+    def query_state(self, query_id: str) -> str:
+        info = self._request("GET",
+                             f"{self.base_url}/v1/query/{query_id}")
+        return info.get("state", "UNKNOWN")
+
     def cancel(self, query_id: str) -> None:
         self._request(
             "DELETE",

@@ -63,7 +63,14 @@ class BlackholeConnector(Connector):
     def table(self, name: str) -> Table:
         if self.page_processing_delay_s:
             import time
-            time.sleep(self.page_processing_delay_s)
+            from presto_tpu.exec.cancel import checkpoint
+            # sleep in slices so a cancel lands mid-delay (the scan is
+            # the cancellation seam, like Driver yield quanta)
+            deadline = time.monotonic() + self.page_processing_delay_s
+            while time.monotonic() < deadline:
+                checkpoint()
+                time.sleep(min(0.05, max(deadline - time.monotonic(), 0)))
+            checkpoint()
         schema = self._schemas[name]
         n = self._rows.get(name, self.rows_per_table)
         cols = {}

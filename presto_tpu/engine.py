@@ -49,24 +49,30 @@ class Engine:
 
     # -- SQL entry points ---------------------------------------------------
 
-    def execute(self, sql: str, mesh=None) -> list[tuple]:
+    def execute(self, sql: str, mesh=None, cancel_token=None
+                ) -> list[tuple]:
         """Run SQL, return result rows as Python tuples. With ``mesh``
         (a jax.sharding.Mesh) query plans execute data-parallel over
-        every device — scans row-sharded, exchanges as ICI collectives."""
+        every device — scans row-sharded, exchanges as ICI collectives.
+        ``cancel_token`` (exec/cancel.CancelToken) interrupts execution
+        at host-side checkpoints."""
         from presto_tpu.sql import ast as A
         from presto_tpu.sql.parser import parse_statement
 
         from presto_tpu.events import monitored
 
         stmt = parse_statement(sql)
-        if isinstance(stmt, A.QueryStatement):
+        with self._cancel_scope(cancel_token):
+            if isinstance(stmt, A.QueryStatement):
+                return monitored(
+                    self, sql,
+                    lambda: self._execute_query(stmt.query,
+                                                mesh).to_pylist())
             return monitored(
-                self, sql,
-                lambda: self._execute_query(stmt.query, mesh).to_pylist())
-        return monitored(
-            self, sql, lambda: self._execute_statement(stmt, mesh))
+                self, sql, lambda: self._execute_statement(stmt, mesh))
 
-    def execute_table(self, sql: str, mesh=None) -> Table:
+    def execute_table(self, sql: str, mesh=None, cancel_token=None
+                      ) -> Table:
         from presto_tpu.events import monitored
         from presto_tpu.sql import ast as A
         from presto_tpu.sql.parser import parse_statement
@@ -74,8 +80,33 @@ class Engine:
         stmt = parse_statement(sql)
         if not isinstance(stmt, A.QueryStatement):
             raise ValueError("execute_table expects a SELECT query")
-        return monitored(
-            self, sql, lambda: self._execute_query(stmt.query, mesh))
+        with self._cancel_scope(cancel_token):
+            return monitored(
+                self, sql, lambda: self._execute_query(stmt.query, mesh))
+
+    def _cancel_scope(self, token):
+        """Install the cancellation token (plus the session's
+        query_max_run_time deadline) for the duration of one query."""
+        import contextlib
+        import time as _time
+
+        from presto_tpu.exec import cancel as C
+
+        limit = float(self.session.get("query_max_run_time") or 0)
+        if token is None and limit > 0:
+            token = C.CancelToken()
+        if token is not None and limit > 0 and token.deadline is None:
+            token.deadline = _time.monotonic() + limit
+
+        @contextlib.contextmanager
+        def scope():
+            C.install(token)
+            try:
+                yield
+            finally:
+                C.install(None)
+
+        return scope()
 
     def plan_sql(self, sql: str):
         from presto_tpu.sql.parser import parse_statement

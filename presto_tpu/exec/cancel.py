@@ -1,0 +1,55 @@
+"""Cooperative query cancellation + run-time limits.
+
+A monolithic XLA program cannot be interrupted mid-flight, so the
+engine checks a per-thread cancellation token at every host-side
+checkpoint: between capacity-retry compiles, between streamed scan
+blocks, between spill partitions, and inside latency-simulating
+connector scans. The reference reaches the same points through
+QueryStateMachine transitions + Driver yield
+(execution/QueryTracker enforced timeouts, Driver.processFor quanta);
+here the quanta are the host-visible seams of device execution.
+
+The token is thread-local because the server's dispatcher pool runs
+each query wholly on one thread (server/server.py QueryManager).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+
+class QueryCanceled(RuntimeError):
+    """Raised at a checkpoint after cancel() or past the deadline."""
+
+
+_state = threading.local()
+
+
+class CancelToken:
+    def __init__(self, deadline: float | None = None):
+        self._event = threading.Event()
+        self.deadline = deadline
+
+    def cancel(self) -> None:
+        self._event.set()
+
+    def check(self) -> None:
+        if self._event.is_set():
+            raise QueryCanceled("query canceled")
+        if self.deadline is not None and time.monotonic() > self.deadline:
+            raise QueryCanceled("query exceeded query_max_run_time")
+
+
+def install(token: CancelToken | None) -> None:
+    _state.token = token
+
+
+def current() -> CancelToken | None:
+    return getattr(_state, "token", None)
+
+
+def checkpoint() -> None:
+    token = getattr(_state, "token", None)
+    if token is not None:
+        token.check()

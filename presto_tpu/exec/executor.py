@@ -386,7 +386,9 @@ def prepare_plan(engine, plan: N.PlanNode, scan_inputs: list[ScanInput]):
     base_key, _ = _cache_key(engine, plan, scan_inputs, {})
     capacities = dict(engine._caps_memory.get(base_key, {}))
 
+    from presto_tpu.exec.cancel import checkpoint
     for _attempt in range(6):
+        checkpoint()
         caps_key = tuple(sorted(capacities.items()))
         entry = engine._program_cache.get((base_key, caps_key))
         flat_arrays = [scan.arrays[sym]

@@ -142,9 +142,11 @@ def _run_partitions(engine, jp: N.Join, part_inputs: list) -> list[Table]:
         traced_fn, _flat, meta = make_traced(
             [pinput0, binput0], jp, capacities, engine.session)
         compiled = jax.jit(traced_fn)
+        from presto_tpu.exec.cancel import checkpoint
         results = []
         overflow = False
         for pinput, binput in part_inputs:
+            checkpoint()
             feed = [pinput.arrays[s] for s in pinput0.arrays] + \
                    [binput.arrays[s] for s in binput0.arrays]
             res, live, oks = compiled(*feed)

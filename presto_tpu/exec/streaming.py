@@ -110,9 +110,11 @@ def try_execute_streamed(engine, plan: N.PlanNode):
         out["__live__"] = np.arange(block) < (hi - lo)
         return out
 
+    from presto_tpu.exec.cancel import checkpoint
     compiled = None
     meta = None
     for i in range(nblocks):
+        checkpoint()
         arrays = block_input(i)
         for _attempt in range(10):
             if compiled is None:

@@ -46,6 +46,7 @@ class QueryInfo:
     started: float | None = None
     finished: float | None = None
     rows_sent: int = 0
+    cancel_token: object = None  # exec/cancel.CancelToken
 
     def stats(self) -> dict:
         wall = ((self.finished or time.monotonic())
@@ -131,17 +132,22 @@ class QueryManager:
         return q
 
     def _run(self, q: QueryInfo, group) -> None:
+        from presto_tpu.exec.cancel import CancelToken, QueryCanceled
         try:
             with self.lock:
                 if q.state == "CANCELED":
                     return
                 q.state = "RUNNING"
                 q.started = time.monotonic()
+                q.cancel_token = CancelToken()
             try:
                 self._execute(q)
                 with self.lock:
                     if q.state != "CANCELED":
                         q.state = "FINISHED"
+            except QueryCanceled:
+                with self.lock:
+                    q.state = "CANCELED"
             except Exception as e:  # noqa: BLE001 - surfaced to client
                 with self.lock:
                     if q.state != "CANCELED":
@@ -161,14 +167,15 @@ class QueryManager:
         from presto_tpu.sql.parser import parse_statement
 
         if not isinstance(parse_statement(q.sql), A.QueryStatement):
-            rows = self.engine.execute(q.sql)
+            rows = self.engine.execute(q.sql, cancel_token=q.cancel_token)
             width = len(rows[0]) if rows else 1
             q.columns = [{"name": f"_col{i}", "type": "varchar"}
                          for i in range(width)]
             q.rows = [[_json_value(v, T.VARCHAR) for v in row]
                       for row in rows]
             return
-        table = self.engine.execute_table(q.sql)
+        table = self.engine.execute_table(q.sql,
+                                          cancel_token=q.cancel_token)
         q.columns = [{"name": n, "type": str(c.dtype)}
                      for n, c in table.columns.items()]
         dtypes = [c.dtype for c in table.columns.values()]
@@ -189,6 +196,11 @@ class QueryManager:
             q.state = "CANCELED"
             q.finished = time.monotonic()
             ticket = self._tickets.get(qid)
+            if q.cancel_token is not None:
+                # a RUNNING query observes this at its next host-side
+                # checkpoint (between blocks / retries / spill parts)
+                # and aborts, freeing the device
+                q.cancel_token.cancel()
         if ticket is not None:
             group, start = ticket
             # a still-group-queued query frees its max_queued slot now;
@@ -215,6 +227,10 @@ class _Handler(JsonHandler):
         if q.state == "FAILED":
             out["error"] = {"message": q.error,
                             "errorName": "GENERIC_INTERNAL_ERROR"}
+            return out
+        if q.state == "CANCELED":
+            out["error"] = {"message": "Query was canceled",
+                            "errorName": "USER_CANCELED"}
             return out
         if q.state in ("QUEUED", "RUNNING"):
             out["nextUri"] = (f"{self._base_uri()}/v1/statement/executing/"

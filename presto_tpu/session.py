@@ -50,6 +50,11 @@ SYSTEM_SESSION_PROPERTIES: dict[str, tuple[Any, type, str]] = {
                          "sort sharded inputs per-shard and n-way merge "
                          "the presorted runs (reference MergeOperator) "
                          "instead of gathering and fully sorting"),
+    "query_max_run_time": (0.0, float,
+                           "wall-clock limit in seconds per query "
+                           "(0 = unlimited), enforced at host-side "
+                           "checkpoints (reference QueryTracker "
+                           "query.max-run-time)"),
     "scan_block_rows": (1 << 24, int,
                         "stream scans bigger than this in blocks of this "
                         "many rows through a partial-aggregate kernel "
