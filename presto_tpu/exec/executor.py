@@ -55,6 +55,10 @@ def collect_scans(plan: N.PlanNode, engine) -> list[ScanInput]:
                     arrays[f"{sym}$valid"] = np.asarray(col.valid)
                 dicts[sym] = col.dictionary
                 types[sym] = col.dtype
+            if tbl.mask is not None:
+                # table-level row mask (padded exchange buffers ship a
+                # dead row so empty relations keep static shape >= 1)
+                arrays["__live__"] = np.asarray(tbl.mask)
             out.append(ScanInput(node, arrays, dicts, types, tbl.nrows))
         for s in node.sources():
             visit(s)

@@ -59,13 +59,26 @@ class RemoteWorker:
         return self.failure_ratio < self.THRESHOLD
 
     def post_task(self, payload: dict, timeout: float = 300.0) -> dict:
+        out = self.post_task_any(payload, timeout)
+        if isinstance(out, bytes):
+            raise TaskError("unexpected binary task response")
+        return out
+
+    def post_task_any(self, payload: dict,
+                      timeout: float = 300.0) -> dict | bytes:
+        """POST a task; returns parsed JSON or raw bytes for binary
+        (inline fragment result) responses."""
         req = urllib.request.Request(
             f"{self.uri}/v1/task",
             data=json.dumps(payload).encode(), method="POST",
             headers={"Content-Type": "application/json"})
         try:
             with urllib.request.urlopen(req, timeout=timeout) as resp:
-                out = json.loads(resp.read())
+                body = resp.read()
+                if resp.headers.get("Content-Type", "").startswith(
+                        "application/octet-stream"):
+                    return body
+                out = json.loads(body)
         except urllib.error.HTTPError as e:
             # the worker answered: node is up, the TASK failed
             try:
@@ -76,6 +89,15 @@ class RemoteWorker:
         if "error" in out:
             raise TaskError(out["error"])
         return out
+
+    def delete_task(self, prefix: str, timeout: float = 10.0) -> None:
+        req = urllib.request.Request(
+            f"{self.uri}/v1/task/{prefix}", method="DELETE")
+        try:
+            with urllib.request.urlopen(req, timeout=timeout):
+                pass
+        except Exception:  # noqa: BLE001 - cleanup is best-effort
+            pass
 
     def ping(self, timeout: float = 2.0) -> bool:
         try:
@@ -149,8 +171,22 @@ class ClusterCoordinator:
                                                _replace_node)
 
         plan, _ = self.engine.plan_sql(sql)
-        found = _find_streamable(plan)
         workers = self.live_workers()
+        if workers:
+            from presto_tpu.parallel.fragmenter import fragment_join_plan
+            fragged = fragment_join_plan(plan)
+            if fragged is not None:
+                try:
+                    return self._execute_fragmented(plan, fragged,
+                                                    workers)
+                except (NoWorkersError, TaskError):
+                    # node loss mid-stage: buffers are gone, restart
+                    # the whole query locally (the reference fails the
+                    # query outright here, SURVEY §5)
+                    self.last_distribution = None
+                    from presto_tpu.exec.executor import execute_plan
+                    return execute_plan(self.engine, plan).to_pylist()
+        found = _find_streamable(plan)
         if found is None or not workers:
             # single-node fallback: run the plan we already built (the
             # monitored() wrapper above owns the lifecycle events)
@@ -223,6 +259,147 @@ class ClusterCoordinator:
         self.last_distribution = {"nshards": nshards,
                                   "partial_rows": total}
         return run_plan(self.engine, plan2, [carrier_input]).to_pylist()
+
+    def _execute_fragmented(self, plan, fragged,
+                            workers: list[RemoteWorker]) -> list[tuple]:
+        """Run a fragmented join plan: scan stages partition legs into
+        worker buffers, join stages pull co-partitions and join, the
+        coordinator finishes (FINAL agg + sort/limit). See
+        parallel/fragmenter.py."""
+        import dataclasses as DC
+        import uuid
+
+        from presto_tpu import types as T  # noqa: F401
+        from presto_tpu.exec.executor import ScanInput, run_plan
+        from presto_tpu.exec.streaming import _replace_node
+        from presto_tpu.parallel.wire import (bytes_to_columns,
+                                              concat_columns)
+        from presto_tpu.plan import nodes as N
+        from presto_tpu.plan.serde import fragment_to_dict
+
+        qid = uuid.uuid4().hex[:8]
+        W = len(workers)
+
+        def exchange_scan(name: str, types: dict) -> N.TableScan:
+            return N.TableScan("__exchange__", name,
+                               {s: s for s in types}, dict(types))
+
+        def run_stage(payloads: list[dict]) -> list:
+            """One task per worker; any node failure aborts the
+            fragmented attempt (buffers on the dead node are lost)."""
+
+            def run_one(i: int):
+                w = workers[i]
+                if not w.alive:
+                    raise NoWorkersError(f"worker {w.uri} died")
+                try:
+                    out = w.post_task_any(payloads[i])
+                    w.record(False)
+                    return out
+                except TaskError:
+                    raise
+                except Exception as e:  # noqa: BLE001 - node failure
+                    w.record(True)
+                    w.record(True)
+                    raise NoWorkersError(str(e)) from e
+
+            with ThreadPoolExecutor(max_workers=W) as pool:
+                return list(pool.map(run_one, range(W)))
+
+        try:
+            # -- scan stages: leg fragments partition into buffers -----
+            stage_types: dict[str, dict] = {}
+            for st in fragged.scan_stages:
+                stage_types[st.name] = st.fragment.output_types()
+                frag = fragment_to_dict(st.fragment)
+                run_stage([{
+                    "fragment": frag,
+                    "task_id": f"{qid}.{st.name}",
+                    "shard": i, "nshards": W,
+                    "partition": {"nparts": W,
+                                  "keys": st.partition_keys},
+                } for i in range(W)])
+
+            # -- join stages -------------------------------------------
+            inline_results: list[bytes] | None = None
+            for js in fragged.join_stages:
+                probe_scan = exchange_scan("probe",
+                                           stage_types[js.probe_name])
+                build_scan = exchange_scan("build",
+                                           stage_types[js.build_name])
+                root: N.PlanNode = DC.replace(
+                    js.join, left=probe_scan, right=build_scan)
+                for up in js.upper:
+                    root = DC.replace(up, source=root)
+                if js.out_partition_keys is None and \
+                        fragged.agg is not None:
+                    root = DC.replace(fragged.agg, source=root,
+                                      step=N.AggStep.PARTIAL)
+                stage_types[js.name] = root.output_types()
+                frag = fragment_to_dict(root)
+                payloads = []
+                for i in range(W):
+                    sources = {
+                        "probe": [
+                            {"uri": w.uri,
+                             "task_id": f"{qid}.{js.probe_name}",
+                             "part": i} for w in workers],
+                        "build": [
+                            {"uri": w.uri,
+                             "task_id": f"{qid}.{js.build_name}",
+                             "part": i} for w in workers],
+                    }
+                    p: dict = {"fragment": frag, "sources": sources,
+                               "task_id": f"{qid}.{js.name}"}
+                    if js.out_partition_keys is not None:
+                        p["partition"] = {
+                            "nparts": W, "keys": js.out_partition_keys}
+                    payloads.append(p)
+                outs = run_stage(payloads)
+                if js.out_partition_keys is None:
+                    inline_results = outs  # bytes per worker
+
+            # -- coordinator: final over gathered worker results -------
+            assert inline_results is not None
+            parts = [bytes_to_columns(b) for b in inline_results]
+            cols = concat_columns([p[0] for p in parts])
+            total = sum(p[1] for p in parts)
+            boundary = fragged.boundary
+            if fragged.agg is not None:
+                partial = DC.replace(fragged.agg,
+                                     step=N.AggStep.PARTIAL)
+                ctypes = partial.output_types()
+            else:
+                ctypes = boundary.output_types()
+            carrier = N.TableScan("__cluster__", "__partials__",
+                                  {s: s for s in ctypes}, dict(ctypes))
+            if fragged.agg is not None:
+                new_node: N.PlanNode = DC.replace(
+                    fragged.agg, source=carrier, step=N.AggStep.FINAL)
+            else:
+                new_node = carrier
+            plan2 = _replace_node(plan, boundary, new_node)
+            arrays: dict = {}
+            dicts: dict = {}
+            for s in ctypes:
+                col = cols[s]
+                arrays[s] = np.asarray(col.data)
+                if col.valid is not None:
+                    arrays[f"{s}$valid"] = np.asarray(col.valid)
+                dicts[s] = col.dictionary
+            carrier_input = ScanInput(carrier, arrays, dicts,
+                                      dict(ctypes), total)
+            self.last_distribution = {
+                "nshards": W, "mode": "fragments",
+                "stages": len(fragged.scan_stages)
+                + len(fragged.join_stages),
+                "partial_rows": total}
+            return run_plan(self.engine, plan2,
+                            [carrier_input]).to_pylist()
+        finally:
+            for w in workers:
+                if w.alive:
+                    w.delete_task(qid)
 
     def _dispatch_splits(self, payloads: list[dict],
                          workers: list[RemoteWorker]) -> list[dict]:

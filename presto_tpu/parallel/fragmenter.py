@@ -1,0 +1,146 @@
+"""Fragmenter: cut a join plan into multi-host exchange stages.
+
+The analog of the reference's PlanFragmenter + AddExchanges for the
+HTTP control plane (sql/planner/PlanFragmenter.java:108): a left-deep
+inner/left hash-join tree over scan/filter/project legs becomes
+
+  stage 0..L-1 (scan stages)   one task per worker: leg fragment over
+                               the worker's table split, output
+                               hash-partitioned by the leg's join key
+                               into W buffers;
+  stage L..    (join stages)   worker w pulls partition w of its probe
+                               and build inputs from every peer,
+                               joins locally, and either re-partitions
+                               its output by the next join's probe key
+                               or (last stage) applies the partial
+                               aggregate and returns binary columns;
+  coordinator                  FINAL aggregation + sort/limit over the
+                               gathered partials.
+
+Within a stage every worker holds rows of one hash partition of the
+join keys, so the local joins compose to the global join — the same
+argument as FIXED_HASH distribution in the reference
+(SystemPartitioningHandle.java:58, AddExchanges.java:245).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from presto_tpu.plan import nodes as N
+
+
+@dataclasses.dataclass
+class ScanStage:
+    name: str  # exchange table name, stable across queries
+    fragment: N.PlanNode  # scan/filter/project subtree (one TableScan)
+    partition_keys: list[str]
+
+
+@dataclasses.dataclass
+class JoinStage:
+    name: str
+    join: N.Join  # original node; sources replaced at dispatch
+    probe_name: str  # exchange table fed by the previous stage
+    build_name: str
+    # None on the last stage (inline result); else next probe keys
+    out_partition_keys: list[str] | None
+    # applied above the final join on the worker (projects/filters and
+    # the PARTIAL aggregate), bottom-up order
+    upper: list[N.PlanNode] = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
+class FragmentedJoinPlan:
+    scan_stages: list[ScanStage]
+    join_stages: list[JoinStage]
+    # the Aggregate whose FINAL step runs on the coordinator (None =
+    # workers return raw joined rows)
+    agg: N.Aggregate | None
+    # full original plan (coordinator re-roots it onto a carrier scan)
+    plan: N.PlanNode
+    # node in ``plan`` that the carrier replaces (agg or join root)
+    boundary: N.PlanNode
+
+
+def _is_leg(node: N.PlanNode) -> bool:
+    """A leg must be scan/filter/project over exactly one TableScan."""
+    if isinstance(node, N.TableScan):
+        return True
+    if isinstance(node, (N.Filter, N.Project)):
+        return _is_leg(node.source)
+    return False
+
+
+def fragment_join_plan(plan: N.PlanNode) -> FragmentedJoinPlan | None:
+    """Returns the staged decomposition, or None when the plan shape
+    isn't a supported left-deep join pipeline (caller falls back)."""
+    # walk down from the root recording the coordinator-side chain
+    node = plan
+    agg = None
+    upper: list[N.PlanNode] = []  # between agg (exclusive) and join root
+    while True:
+        if isinstance(node, N.Join):
+            break
+        if isinstance(node, N.Aggregate):
+            if agg is not None or node.step != N.AggStep.SINGLE:
+                return None
+            if any(c.distinct for c in node.aggs.values()):
+                return None  # DISTINCT aggs need mark-distinct locality
+            agg = node
+            upper = []
+            node = node.source
+            continue
+        if isinstance(node, (N.Output, N.Sort, N.TopN, N.Limit,
+                             N.Distinct)):
+            if agg is not None:
+                return None  # below-agg sort/limit: unexpected
+            node = node.sources()[0]
+            continue
+        if isinstance(node, (N.Project, N.Filter)):
+            if agg is not None:
+                upper.append(node)
+            node = node.source
+            continue
+        return None
+    join_root = node
+    if agg is None:
+        upper = []
+
+    # decompose the left-deep join chain
+    chain: list[N.Join] = []
+    cur: N.PlanNode = join_root
+    while isinstance(cur, N.Join):
+        if cur.join_type not in (N.JoinType.INNER, N.JoinType.LEFT):
+            return None
+        if not _is_leg(cur.right):
+            return None
+        chain.append(cur)
+        cur = cur.left
+    if not _is_leg(cur) or not chain:
+        return None
+    chain.reverse()  # bottom-up: chain[0].left is the base probe leg
+    probe_leg = cur
+
+    scan_stages = [ScanStage(
+        "probe0", probe_leg, [lk for lk, _ in chain[0].criteria])]
+    for i, j in enumerate(chain):
+        scan_stages.append(ScanStage(
+            f"build{i}", j.right, [rk for _, rk in j.criteria]))
+
+    join_stages = []
+    probe_name = "probe0"
+    for i, j in enumerate(chain):
+        last = i == len(chain) - 1
+        out_keys = None
+        if not last:
+            nxt = chain[i + 1]
+            out_keys = [lk for lk, _ in nxt.criteria]
+        join_stages.append(JoinStage(
+            f"join{i}", j, probe_name, f"build{i}", out_keys,
+            upper=list(reversed(upper)) if last else []))
+        probe_name = f"join{i}"
+
+    boundary = agg if agg is not None else join_root
+    return FragmentedJoinPlan(scan_stages, join_stages, agg, plan,
+                              boundary)

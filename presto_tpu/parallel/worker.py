@@ -1,19 +1,27 @@
-"""Worker process: executes plan fragments over its table splits.
+"""Worker process: executes plan fragments over splits and exchanges.
 
 The multi-host analog of the reference worker runtime
 (server/TaskResource.java:123 POST /v1/task + SqlTaskManager.updateTask
--> SqlTaskExecution): a task names the ORIGINAL query plus a split
-assignment (shard, nshards); the worker plans the same SQL itself over
-split-view catalogs (connectors/split.py) and returns the PARTIAL
-aggregation state columns — the engine's wire format for partial
-aggregates (the reference ships serialized accumulator state in Pages
-the same way). Planning is deterministic, so worker and coordinator
-agree on fragment shape and symbol names without shipping plan IR.
+-> SqlTaskExecution.createSqlTaskExecution). Two task generations:
+
+1. ``{"sql", "shard", "nshards"}`` — the round-2 contract: the worker
+   re-plans the SQL over split-view catalogs and returns the PARTIAL
+   aggregation states (kept for scan->aggregate queries).
+2. ``{"fragment", ...}`` — serialized plan IR (plan/serde.py), the
+   HttpRemoteTask.sendUpdate analog. A fragment may scan base catalogs
+   (split by shard/nshards) and/or ``__exchange__`` tables fed by
+   pulling peer workers' partition buffers (binary npz wire,
+   parallel/wire.py — the ExchangeClient/OutputBuffer pair of the
+   reference, TaskResource.java:261 results endpoints). The fragment's
+   result either hash-partitions into this worker's buffer store for
+   the next stage, or returns inline as binary columns.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import threading
+import urllib.request
 
 import numpy as np
 
@@ -57,32 +65,167 @@ def execute_partial_task(engine_factory, sql: str, shard: int,
     return {"columns": cols, "nrows": int(live.sum())}
 
 
+class BufferConnector:
+    """In-memory ``__exchange__`` catalog over pulled peer partitions."""
+
+    name = "__exchange__"
+
+    def __init__(self):
+        self._tables: dict[str, tuple[dict, int]] = {}
+
+    def add(self, name: str, cols: dict, nrows: int) -> None:
+        self._tables[name] = (cols, nrows)
+
+    def table_names(self):
+        return list(self._tables)
+
+    def table_schema(self, name: str):
+        cols, _ = self._tables[name]
+        return {c: col.dtype for c, col in cols.items()}
+
+    def table(self, name: str):
+        from presto_tpu.block import Column, Table
+        cols, nrows = self._tables[name]
+        if nrows == 0:
+            # one dead pad row: join/group kernels need length >= 1
+            padded = {}
+            for c, col in cols.items():
+                data = np.asarray(col.data)
+                padded[c] = Column(
+                    col.dtype, np.zeros(1, dtype=data.dtype),
+                    np.asarray([False]) if col.valid is not None
+                    else None, col.dictionary)
+            return Table(padded, 1, np.asarray([False]))
+        return Table(cols, nrows, None)
+
+    def row_count_estimate(self, name: str) -> int:
+        return max(self._tables[name][1], 1)
+
+    def ndv_estimates(self, name: str):
+        return {}
+
+    def column_range_estimates(self, name: str):
+        return {}
+
+    def unique_keys(self, name: str):
+        return []
+
+    def stats(self, name: str):
+        from presto_tpu.connectors.base import TableStats
+        return TableStats(row_count=self._tables[name][1])
+
+
+def _fetch_buffer(ref: dict, timeout: float = 120.0) -> bytes:
+    url = f"{ref['uri']}/v1/task/{ref['task_id']}/results/{ref['part']}"
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.read()
+
+
+def execute_fragment_task(engine, req: dict, store: dict) -> object:
+    """Run one fragment task. Returns a dict (JSON response, buffered
+    output) or bytes (inline binary result)."""
+    from presto_tpu.exec.executor import collect_scans, run_plan
+    from presto_tpu.parallel.exchange_host import (partition_ids,
+                                                   slice_columns)
+    from presto_tpu.parallel.wire import (bytes_to_columns,
+                                          columns_to_bytes,
+                                          concat_columns)
+    from presto_tpu.plan.serde import fragment_from_dict
+
+    plan = fragment_from_dict(req["fragment"])
+    sources = req.get("sources") or {}
+    if sources:
+        conn = BufferConnector()
+        for tname, refs in sources.items():
+            parts = [bytes_to_columns(_fetch_buffer(r)) for r in refs]
+            cols = concat_columns([p[0] for p in parts])
+            nrows = sum(p[1] for p in parts)
+            conn.add(tname, cols, nrows)
+        engine.catalogs["__exchange__"] = conn
+
+    table = run_plan(engine, plan, collect_scans(plan, engine))
+    live = (np.ones(table.nrows, bool) if table.mask is None
+            else np.asarray(table.mask))
+    cols = slice_columns(table.columns, live)
+
+    part = req.get("partition")
+    if part is None:
+        return columns_to_bytes(cols)
+    nparts = int(part["nparts"])
+    ids = partition_ids(cols, part["keys"], nparts)
+    bufs = []
+    rows = []
+    for p in range(nparts):
+        sel = ids == p
+        bufs.append(columns_to_bytes(slice_columns(cols, sel)))
+        rows.append(int(sel.sum()))
+    store[req["task_id"]] = bufs
+    return {"rows": rows}
+
+
 class WorkerServer(HttpService):
     """HTTP worker node (WorkerModule / TaskResource analog). Holds a
-    base catalog set; each task re-wraps it in split views."""
+    base catalog set; each task re-wraps it in split views. Engines are
+    cached per (shard, nshards) so the compiled-program cache survives
+    across tasks of repeat queries."""
 
     def __init__(self, catalogs: dict, host: str = "127.0.0.1",
                  port: int = 0, node_id: str = "worker"):
         self.catalogs = catalogs
         self.node_id = node_id
+        self.buffers: dict[str, list[bytes]] = {}
+        self._engines: dict[tuple, object] = {}
+        self._lock = threading.Lock()
+        # fragment tasks mutate the cached engine's __exchange__
+        # catalog; serialize them (one task at a time per worker, the
+        # single-device analog of task_concurrency=1)
+        self._task_lock = threading.Lock()
 
         def engine_factory(shard: int, nshards: int):
             from presto_tpu import Engine
             from presto_tpu.connectors.split import SplitConnector
 
-            e = Engine()
-            for name, conn in catalogs.items():
-                e.register_catalog(
-                    name, SplitConnector(conn, shard, nshards))
+            with self._lock:
+                e = self._engines.get((shard, nshards))
+                if e is None:
+                    e = Engine()
+                    for name, conn in catalogs.items():
+                        e.register_catalog(
+                            name, SplitConnector(conn, shard, nshards))
+                    self._engines[(shard, nshards)] = e
             return e
 
         outer = self
 
         class Handler(JsonHandler):
             def do_GET(self):  # noqa: N802
+                parts = self.path.strip("/").split("/")
                 if self.path == "/v1/status":
                     self._send_json({"nodeId": outer.node_id,
                                      "state": "active"})
+                    return
+                if (len(parts) == 5 and parts[:2] == ["v1", "task"]
+                        and parts[3] == "results"):
+                    bufs = outer.buffers.get(parts[2])
+                    p = int(parts[4])
+                    if bufs is None or p >= len(bufs):
+                        self._send_json({"error": "no such buffer"}, 404)
+                        return
+                    self._send_bytes(bufs[p])
+                    return
+                self._send_json({"error": "not found"}, 404)
+
+            def do_DELETE(self):  # noqa: N802
+                parts = self.path.strip("/").split("/")
+                if len(parts) == 3 and parts[:2] == ["v1", "task"]:
+                    # task-id prefix delete: one query's stages share
+                    # a query-id prefix (ack/cleanup, the reference's
+                    # explicit DELETE on drained buffers)
+                    prefix = parts[2]
+                    for tid in list(outer.buffers):
+                        if tid.startswith(prefix):
+                            outer.buffers.pop(tid, None)
+                    self._send_json({})
                     return
                 self._send_json({"error": "not found"}, 404)
 
@@ -92,6 +235,18 @@ class WorkerServer(HttpService):
                     return
                 req = self._read_json()
                 try:
+                    if "fragment" in req:
+                        engine = engine_factory(
+                            int(req.get("shard", 0)),
+                            int(req.get("nshards", 1)))
+                        with outer._task_lock:
+                            out = execute_fragment_task(
+                                engine, req, outer.buffers)
+                        if isinstance(out, bytes):
+                            self._send_bytes(out)
+                        else:
+                            self._send_json(out)
+                        return
                     out = execute_partial_task(
                         engine_factory, req["sql"],
                         int(req["shard"]), int(req["nshards"]))

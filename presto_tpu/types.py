@@ -208,3 +208,18 @@ def common_super_type(a: DataType, b: DataType) -> DataType:
     if is_string(a) and is_string(b):
         return VARCHAR
     raise TypeError(f"cannot unify types {a} and {b}")
+
+
+def parse_type(s: str) -> DataType:
+    """Inverse of str(DataType) — used by the plan/wire serde."""
+    s = s.strip().lower()
+    if s.startswith("decimal"):
+        p, sc = s[s.index("(") + 1:s.rindex(")")].split(",")
+        return DecimalType(int(p), int(sc))
+    if s.startswith("varchar"):
+        return VARCHAR
+    simple = {"bigint": BIGINT, "integer": INTEGER, "double": DOUBLE,
+              "boolean": BOOLEAN, "date": DATE, "unknown": UNKNOWN}
+    if s in simple:
+        return simple[s]
+    raise ValueError(f"cannot parse type {s!r}")

@@ -61,13 +61,56 @@ def test_cluster_matches_local(sql, _x, cluster):
         coord.live_workers())
 
 
-def test_non_distributable_runs_locally(cluster):
+def test_join_distributes_as_fragments(cluster):
+    """Join queries ship plan fragments to workers: scan stages
+    hash-partition both sides, join stages pull co-partitions from
+    peers and join locally, the coordinator finalizes (VERDICT round 2
+    #3; reference HttpRemoteTask.java:533 fragment shipping)."""
     coord, _workers, local = cluster
     sql = ("select o_orderpriority, count(*) as c from orders, lineitem "
            "where o_orderkey = l_orderkey group by o_orderpriority "
            "order by o_orderpriority")
     assert coord.execute(sql) == local.execute(sql)
-    assert coord.last_distribution is None  # join shape -> local
+    assert coord.last_distribution is not None
+    assert coord.last_distribution["mode"] == "fragments"
+    assert coord.last_distribution["nshards"] == len(
+        coord.live_workers())
+
+
+def test_multi_join_distributes(cluster):
+    """TPC-H Q3 shape: two joins on DIFFERENT keys forces an
+    inter-stage repartition (join0 output re-partitioned by the second
+    join's probe key)."""
+    coord, _workers, local = cluster
+    sql = ("select o_orderdate, o_shippriority, "
+           "sum(l_extendedprice * (1 - l_discount)) as revenue "
+           "from customer, orders, lineitem "
+           "where c_mktsegment = 'BUILDING' "
+           "and c_custkey = o_custkey and l_orderkey = o_orderkey "
+           "and o_orderdate < date '1995-03-15' "
+           "and l_shipdate > date '1995-03-15' "
+           "group by o_orderdate, o_shippriority "
+           "order by revenue desc, o_orderdate limit 10")
+    got = coord.execute(sql)
+    want = local.execute(sql)
+    assert got == want
+    assert coord.last_distribution is not None
+    assert coord.last_distribution["mode"] == "fragments"
+    assert coord.last_distribution["stages"] >= 4
+
+
+def test_join_no_aggregate_distributes(cluster):
+    """Raw join rows return over the binary wire (no partial agg)."""
+    coord, _workers, local = cluster
+    sql = ("select o_orderkey, o_orderdate, l_quantity from orders, "
+           "lineitem where o_orderkey = l_orderkey "
+           "and o_totalprice > 500000 order by o_orderkey, l_quantity "
+           "limit 20")
+    got = coord.execute(sql)
+    want = local.execute(sql)
+    assert got == want
+    assert coord.last_distribution is not None
+    assert coord.last_distribution["mode"] == "fragments"
 
 
 def test_worker_failure_detected_and_split_retried(cluster):

@@ -1,0 +1,47 @@
+"""Plan-IR serde round trips (the fragment wire format,
+plan/serde.py; reference PlanFragment JSON bindings)."""
+
+import pytest
+
+from presto_tpu import Engine
+from presto_tpu.connectors.tpch import TpchConnector
+from presto_tpu.plan.fingerprint import plan_fingerprint
+from presto_tpu.plan.serde import fragment_from_dict, fragment_to_dict
+
+QUERIES = [
+    "select 1",
+    "select l_returnflag, count(*), sum(l_extendedprice) from lineitem "
+    "where l_shipdate <= date '1998-09-02' group by l_returnflag "
+    "order by l_returnflag",
+    "select o_orderpriority, count(*) from orders, lineitem "
+    "where o_orderkey = l_orderkey and o_totalprice > 1000 "
+    "group by o_orderpriority",
+    "select c_name, rank() over (partition by c_nationkey "
+    "order by c_acctbal desc) from customer limit 5",
+    "select distinct l_shipmode from lineitem "
+    "where l_shipmode in ('AIR', 'MAIL')",
+]
+
+
+@pytest.fixture(scope="module")
+def engine():
+    e = Engine()
+    e.register_catalog("tpch", TpchConnector(scale=0.01))
+    return e
+
+
+@pytest.mark.parametrize("sql", QUERIES)
+def test_round_trip(engine, sql):
+    plan, _ = engine.plan_sql(sql)
+    d = fragment_to_dict(plan)
+    import json
+    restored = fragment_from_dict(json.loads(json.dumps(d)))
+    assert plan_fingerprint(restored) == plan_fingerprint(plan)
+
+
+def test_version_check(engine):
+    plan, _ = engine.plan_sql("select 1")
+    d = fragment_to_dict(plan)
+    d["version"] = 99
+    with pytest.raises(ValueError):
+        fragment_from_dict(d)
