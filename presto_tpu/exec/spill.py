@@ -37,11 +37,15 @@ def _compact(tbl: Table) -> Table:
     return Table(cols, int(m.sum()), None)
 
 
-def _value_hash(tbl: Table, keys: list[str]) -> tuple:
+def _value_hash(tbl: Table, keys: list[str],
+                null_canonical: bool = False) -> tuple:
     """(uint64 hash per row, all-keys-valid mask) — value-based (strings
     hash their dictionary text via the cached content hash in ops/hash)
     so probe and build partition identically even with different
-    dictionaries."""
+    dictionaries. ``null_canonical`` replaces NULL rows' values with a
+    fixed sentinel so key tuples that are group-equal (both NULL) hash
+    equal — required by the aggregation spill (joins drop NULL keys
+    instead)."""
     from presto_tpu.ops.hash import hash_string_dictionary
 
     n = tbl.nrows
@@ -59,6 +63,9 @@ def _value_hash(tbl: Table, keys: list[str]) -> tuple:
                 v = lut[codes].astype(np.int64)
         else:
             v = np.asarray(c.data).astype(np.int64)
+        if null_canonical and c.valid is not None:
+            v = np.where(np.asarray(c.valid), v,
+                         np.int64(0x5BD1E995))
         x = v.astype(np.uint64) + np.uint64(0x9E3779B97F4A7C15)
         x ^= x >> np.uint64(30)
         x *= np.uint64(0xBF58476D1CE4E5B9)
@@ -179,6 +186,121 @@ def _run_partitions(engine, jp: N.Join, part_inputs: list) -> list[Table]:
     return outs
 
 
+def _spill_aggregate(engine, plan: N.PlanNode, agg: N.Aggregate,
+                     total: int, budget: int):
+    """Aggregation spill: hash-partition the aggregate's input rows by
+    GROUP KEYS on host and aggregate partition-by-partition — groups
+    cannot span partitions, so per-partition SINGLE aggregation
+    concatenates to the exact global result (the reference's
+    SpillableHashAggregationBuilder reaches the same shape by spilling
+    raw group-by input partitions and merging per partition)."""
+    from presto_tpu.exec.executor import execute_plan, run_plan
+    from presto_tpu.exec.streaming import _replace_node
+
+    in_spill_before = getattr(engine, "_in_spill", False)
+    engine._in_spill = True
+    try:
+        input_tbl = _compact(execute_plan(engine, agg.source))
+    finally:
+        engine._in_spill = in_spill_before
+
+    nparts = max(2, next_pow2(-(-total // budget)))
+    if nparts > 64:
+        raise MemoryLimitExceeded(
+            f"query estimated {total} bytes cannot be bounded by "
+            f"query_max_memory_bytes={budget} within 64 spill "
+            f"partitions")
+    h, _valid = _value_hash(input_tbl, agg.group_keys,
+                            null_canonical=True)
+    part = (h % np.uint64(nparts)).astype(np.int64)
+    counts = np.bincount(part, minlength=nparts)
+    live_parts = [p for p in range(nparts) if counts[p] > 0]
+    pmax = max(int(counts.max()), 1)
+
+    part_inputs = []
+    ap = None
+    pcap = next_pow2(max(2 * min(
+        pmax, (agg.capacity or pmax)), 16))
+    for p in live_parts:
+        tp = _slice_table(input_tbl, np.nonzero(part == p)[0])
+        cnode, cinput = _carrier_scan("agg_part", tp, pad_to=pmax)
+        if ap is None:
+            ap = dataclasses.replace(agg, source=cnode, capacity=pcap)
+        else:
+            cinput = dataclasses.replace(cinput, node=ap.source)
+        part_inputs.append((cinput,))
+    outs = _run_partition_plans(engine, ap, part_inputs) \
+        if part_inputs else []
+
+    if not outs:
+        merged = Table(
+            {s: Column(t, np.empty(0, t.physical_dtype), None,
+                       np.empty(0, object)
+                       if isinstance(t, T.VarcharType) else None)
+             for s, t in agg.output_types().items()}, 0, None)
+    else:
+        merged = _concat_tables(outs)
+    engine.last_spill = {"partitions": nparts, "kind": "aggregate",
+                         "input_rows": input_tbl.nrows,
+                         "estimated_bytes": total, "budget": budget}
+    carrier_node, carrier_input = _carrier_scan("__aggregated__",
+                                                _compact(merged))
+    rest = _replace_node(plan, agg, carrier_node)
+    return run_plan(engine, rest, [carrier_input])
+
+
+def _run_partition_plans(engine, root: N.PlanNode,
+                         part_inputs: list) -> list[Table]:
+    """Generalized _run_partitions: one compiled program over any
+    fragment with N carrier scans, replayed per partition."""
+    import jax
+
+    from presto_tpu.exec.cancel import checkpoint
+    from presto_tpu.exec.executor import make_traced
+
+    capacities: dict[tuple, int] = {}
+    for _attempt in range(10):
+        inputs0 = part_inputs[0]
+        traced_fn, _flat, meta = make_traced(
+            list(inputs0), root, capacities, engine.session)
+        compiled = jax.jit(traced_fn)
+        results = []
+        overflow = False
+        for inputs in part_inputs:
+            checkpoint()
+            feed = []
+            for inp, inp0 in zip(inputs, inputs0):
+                feed.extend(inp.arrays[s] for s in inp0.arrays)
+            res, live, oks = compiled(*feed)
+            if not all(bool(o) for o in oks):
+                for key, okv in zip(meta["ok_keys"], oks):
+                    if not bool(okv):
+                        capacities[key] = 4 * meta["used_capacity"][key]
+                overflow = True
+                break
+            results.append((res, live))
+        if not overflow:
+            break
+    else:
+        raise RuntimeError("spill partition capacity retry limit")
+
+    outs = []
+    for res, live in results:
+        cols: dict[str, Column] = {}
+        i = 0
+        for sym, dtype, dictionary, has_valid in meta["out"]:
+            data = np.asarray(res[i])
+            valid = np.asarray(res[i + 1])
+            i += 2
+            cols[sym] = Column(
+                dtype, data,
+                valid if has_valid or not valid.all() else None,
+                dictionary)
+        live_np = np.asarray(live)
+        outs.append(Table(cols, len(live_np), live_np))
+    return outs
+
+
 def try_execute_spilled(engine, plan: N.PlanNode):
     """Execute with host-partitioned join spill, or return None when the
     budget (query_max_memory_bytes) is unset or the plan fits.
@@ -200,15 +322,25 @@ def try_execute_spilled(engine, plan: N.PlanNode):
             f"query estimated {total} bytes exceeds "
             f"query_max_memory_bytes={budget} and spill is disabled")
 
-    # first multi-source node on the root chain: a Join spills; any
-    # other shape cannot be bounded by join partitioning
+    # first multi-source node on the root chain: a Join spills by join
+    # keys; failing that, a grouped Aggregate spills by group keys
+    # (SpillableHashAggregationBuilder analog); other shapes cannot be
+    # partition-bounded
     node = plan
+    grouped_agg = None
     while True:
         srcs = node.sources()
         if isinstance(node, N.Join) and node.criteria:
             join = node
             break
+        if isinstance(node, N.Aggregate) and node.group_keys \
+                and node.step == N.AggStep.SINGLE \
+                and grouped_agg is None:
+            grouped_agg = node
         if len(srcs) != 1:
+            if grouped_agg is not None:
+                return _spill_aggregate(engine, plan, grouped_agg,
+                                        total, budget)
             if getattr(engine, "_in_spill", False):
                 return None  # host-side subplan: already spilled medium
             raise MemoryLimitExceeded(

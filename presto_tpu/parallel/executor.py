@@ -386,10 +386,17 @@ class ShardedInterpreter:
         return DistTable(out, REPLICATED)
 
     def _r_window(self, node: N.Window) -> DistTable:
-        # window partitions would repartition cleanly by partition key
-        # (all_to_all); v1 gathers — windows sit above heavy reductions
-        # in TPC-DS plans so the gathered input is small
-        dt = self.replicated(node.source)
+        src = self.run(node.source)
+        if src.dist == SHARDED and node.partition_by:
+            # FIXED_HASH repartition by the window partition keys, then
+            # each shard computes its partitions independently and the
+            # output STAYS SHARDED (reference AddExchanges partitioned
+            # WindowNode + operator/WindowOperator.java:70)
+            ex = self._repart(src.dt, node.partition_by, node,
+                              "win_exch")
+            return DistTable(OP.apply_window(ex, node), SHARDED)
+        dt = (src.dt if src.dist == REPLICATED
+              else _gather(src.dt, self.nshards))
         return DistTable(OP.apply_window(dt, node), REPLICATED)
 
     def _r_sort(self, node: N.Sort) -> DistTable:
@@ -425,7 +432,21 @@ class ShardedInterpreter:
                          REPLICATED)
 
     def _r_limit(self, node: N.Limit) -> DistTable:
-        dt = self.replicated(node.source)
+        src = self.run(node.source)
+        take = node.count + node.offset
+        if src.dist == SHARDED and take <= src.dt.n:
+            # per-shard head of `count+offset` live rows (live-first
+            # stable compaction), gather O(nshards*take) candidates,
+            # final limit — the exchange carries O(take) rows instead
+            # of the whole input (reference LimitNode partial/final)
+            local = OP.head(OP.apply_sort(
+                OP.apply_limit(src.dt, take), []), take)
+            gathered = _gather(local, self.nshards)
+            return DistTable(
+                OP.apply_limit(gathered, node.count, node.offset),
+                REPLICATED)
+        dt = (src.dt if src.dist == REPLICATED
+              else _gather(src.dt, self.nshards))
         return DistTable(OP.apply_limit(dt, node.count, node.offset),
                          REPLICATED)
 

@@ -127,3 +127,39 @@ def test_repartition_preserves_all_rows(tpch_tiny, mesh):
         "select count(*) from lineitem, orders "
         "where l_orderkey = o_orderkey")
     assert got == want
+
+
+def test_partitioned_window_uses_all_to_all(tpch_tiny, oracle, mesh):
+    """Distributed windows repartition by partition keys (all_to_all)
+    and stay SHARDED instead of gathering the whole input (VERDICT
+    round 2 #6; reference AddExchanges + WindowOperator.java:70)."""
+    from presto_tpu.sql.parser import parse_statement
+    from presto_tpu.sql.sqlite_dialect import to_sqlite
+
+    sql = ("select c_nationkey, count(*) as c from ("
+           "select c_nationkey, rank() over (partition by c_nationkey "
+           "order by c_acctbal desc, c_custkey) as r from customer) t "
+           "where r <= 5 group by c_nationkey order by c_nationkey")
+    e = make_engine(tpch_tiny, partitioned_agg_min_groups=1)
+    got = e.execute(sql, mesh=mesh)
+    kinds = {k for (_, k) in e.last_dist_meta["used_capacity"]}
+    assert "win_exch" in kinds
+    assert "all_to_all" in e.last_dist_hlo or \
+        "all-to-all" in e.last_dist_hlo
+    want = oracle.query(to_sqlite(parse_statement(sql)))
+    ok, msg = rows_equal(got, want, ordered=True)
+    assert ok, msg
+
+
+def test_sharded_limit_partial(tpch_tiny, oracle, mesh):
+    """LIMIT over a sharded source takes a per-shard head and gathers
+    only O(count) candidate rows (VERDICT round 2 #6)."""
+    sql = "select l_orderkey from lineitem limit 7"
+    e = make_engine(tpch_tiny)
+    got = e.execute(sql, mesh=mesh)
+    assert len(got) == 7
+    # every returned key must exist in the table (any-7 semantics)
+    import numpy as np
+    keys = set(np.asarray(
+        tpch_tiny.table("lineitem").columns["l_orderkey"].data).tolist())
+    assert all(r[0] in keys for r in got)
