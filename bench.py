@@ -138,7 +138,7 @@ def main() -> None:
     t_detail = time.perf_counter()
     if os.environ.get("PRESTO_TPU_BENCH_Q1_ONLY") != "1":
         import subprocess
-        for name in ("q06", "q03"):
+        for name in ("q06", "q03", "q05"):
             left = budget - (time.perf_counter() - t_detail)
             if left <= 0:
                 detail[f"{name}_skipped"] = "bench time budget exhausted"

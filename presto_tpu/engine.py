@@ -158,11 +158,15 @@ class Engine:
 
         if isinstance(stmt, A.ExplainStatement):
             if stmt.analyze:
-                from presto_tpu.exec.profile import explain_analyze
+                from presto_tpu.exec.profile import (
+                    explain_analyze, explain_analyze_distributed)
                 inner = stmt.statement
                 if not isinstance(inner, A.QueryStatement):
                     raise ValueError("EXPLAIN ANALYZE expects a query")
                 plan = self._plan_query(inner.query)
+                if mesh is not None:
+                    return [(explain_analyze_distributed(
+                        self, plan, mesh),)]
                 return [(explain_analyze(self, plan),)]
             inner = stmt.statement
             if isinstance(inner, A.QueryStatement):

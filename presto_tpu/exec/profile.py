@@ -79,3 +79,20 @@ def explain_analyze(engine, plan: N.PlanNode) -> str:
     header = (f"Query plan (compile {compile_s * 1e3:.1f} ms, "
               f"execute {run_s * 1e3:.1f} ms)\n")
     return header + format_plan(plan, annotations=annotations)
+
+
+def explain_analyze_distributed(engine, plan: N.PlanNode, mesh) -> str:
+    """EXPLAIN ANALYZE for the shard_map path: per-node mesh-global row
+    counts + distribution tags + compile/run wall times (VERDICT round 2
+    #10 — the distributed path previously had no profile at all)."""
+    from presto_tpu.parallel.executor import execute_plan_distributed
+
+    profile: dict = {}
+    execute_plan_distributed(engine, plan, mesh, profile=profile)
+    annotations = {
+        nid: f"rows: {rows} [{dist}]"
+        for nid, (rows, dist) in profile["node_rows"].items()}
+    header = (f"Distributed plan over {mesh.devices.size} devices "
+              f"(compile {profile['compile_s'] * 1e3:.1f} ms, "
+              f"execute {profile['run_s'] * 1e3:.1f} ms)\n")
+    return header + format_plan(plan, annotations=annotations)

@@ -92,6 +92,10 @@ class ShardedInterpreter:
         # pruning is consistent across shards
         self.dyn_filters: dict[str, tuple] = {}
         self._df_applied: set[str] = set()
+        # EXPLAIN ANALYZE: (node id, live-row count, distribution) per
+        # plan node, populated when collect_counts is set
+        self.collect_counts = False
+        self.row_counts: list[tuple[int, object, str]] = []
 
     # -- plumbing shared with the local interpreter -------------------------
 
@@ -128,6 +132,17 @@ class ShardedInterpreter:
             dt = PlanInterpreter._apply_dyn_filters(self, out.dt)
             if dt is not out.dt:
                 out = DistTable(dt, out.dist)
+        if self.collect_counts:
+            # mesh-global live rows out of this node: per-shard count
+            # psum'd so the total is replicated (for a REPLICATED
+            # intermediate every shard holds the same rows — divide)
+            c = jnp.sum(out.dt.live_mask().astype(jnp.int64))
+            total = jax.lax.psum(c, AXIS)
+            if out.dist == REPLICATED:
+                total = total // self.nshards
+            self.row_counts.append(
+                (id(node), total,
+                 "sharded" if out.dist == SHARDED else "replicated"))
         return out
 
     def _collect_dyn_filters(self, node: N.Join, build: DTable,
@@ -493,8 +508,12 @@ def _shard_scan_arrays(scan: ScanInput, nshards: int):
 
 
 def execute_plan_distributed(engine, plan: N.PlanNode,
-                             mesh: Mesh) -> Table:
-    """Compile + run a logical plan over every device in ``mesh``."""
+                             mesh: Mesh, profile: dict | None = None
+                             ) -> Table:
+    """Compile + run a logical plan over every device in ``mesh``.
+    ``profile`` (EXPLAIN ANALYZE) is filled with per-node mesh-global
+    row counts and compile/run wall times."""
+    import time as _time
     nshards = mesh.devices.size
     scan_inputs = collect_scans(plan, engine)
     capacities: dict[tuple, int] = {}
@@ -518,29 +537,39 @@ def execute_plan_distributed(engine, plan: N.PlanNode,
                 scans[id(scan.node)] = (scan, per_scan[i])
             interp = ShardedInterpreter(scans, capacities, nshards,
                                         engine.session)
+            interp.collect_counts = profile is not None
             out = interp.run(plan).dt
             meta["out"] = [
                 (sym, v.dtype, v.dictionary, v.valid is not None)
                 for sym, v in out.cols.items()]
             meta["ok_keys"] = interp.ok_keys
             meta["used_capacity"] = interp.used_capacity
+            meta["count_nodes"] = [
+                (nid, dist) for nid, _, dist in interp.row_counts]
             res = []
             for sym, v in out.cols.items():
                 res.append(v.data)
                 res.append(v.valid if v.valid is not None
                            else jnp.ones((out.n,), dtype=bool))
-            return tuple(res), out.live_mask(), tuple(interp.ok_flags)
+            counts = tuple(c for _, c, _ in interp.row_counts)
+            return (tuple(res), out.live_mask(),
+                    tuple(interp.ok_flags), counts)
 
         n_out = None  # resolved after trace
         sharded = jax.shard_map(
             traced_fn, mesh=mesh,
             in_specs=tuple(P(AXIS) for _ in flat_arrays),
-            out_specs=(P(), P(), P()),
+            out_specs=(P(), P(), P(), P()),
             check_vma=False)
+        t0 = _time.perf_counter()
         lowered = jax.jit(sharded).lower(*flat_arrays)
         compiled = lowered.compile()
+        compile_s = _time.perf_counter() - t0
+        t0 = _time.perf_counter()
         with mesh:
-            res, live, oks = compiled(*flat_arrays)
+            res, live, oks, node_counts = compiled(*flat_arrays)
+        jax.block_until_ready(live)
+        run_s = _time.perf_counter() - t0
         del n_out
         if all(bool(np.asarray(o)) for o in oks):
             break
@@ -555,6 +584,12 @@ def execute_plan_distributed(engine, plan: N.PlanNode,
     # the distribution strategy is visible as collectives in the program
     engine.last_dist_hlo = lowered.as_text()
     engine.last_dist_meta = {"used_capacity": dict(meta["used_capacity"])}
+    if profile is not None:
+        profile["compile_s"] = compile_s
+        profile["run_s"] = run_s
+        profile["node_rows"] = {
+            nid: (int(np.asarray(c)), dist)
+            for (nid, dist), c in zip(meta["count_nodes"], node_counts)}
 
     live_np = np.asarray(live)
     cols: dict[str, Column] = {}

@@ -163,3 +163,16 @@ def test_sharded_limit_partial(tpch_tiny, oracle, mesh):
     keys = set(np.asarray(
         tpch_tiny.table("lineitem").columns["l_orderkey"].data).tolist())
     assert all(r[0] in keys for r in got)
+
+
+def test_distributed_explain_analyze(tpch_tiny, mesh):
+    """EXPLAIN ANALYZE over a mesh reports per-node mesh-global row
+    counts and distribution tags (VERDICT round 2 #10)."""
+    e = make_engine(tpch_tiny, partitioned_agg_min_groups=1)
+    rows = e.execute(
+        "explain analyze select l_returnflag, count(*) from lineitem "
+        "group by l_returnflag order by l_returnflag", mesh=mesh)
+    text = rows[0][0]
+    assert "Distributed plan over 8 devices" in text
+    assert "rows:" in text and "[sharded]" in text
+    assert "execute" in text and "compile" in text
