@@ -127,6 +127,7 @@ SCHEMAS: dict[str, dict[str, T.DataType]] = {
         "cr_returned_date_sk": T.BIGINT, "cr_item_sk": T.BIGINT,
         "cr_order_number": T.BIGINT,
         "cr_returning_customer_sk": T.BIGINT,
+        "cr_call_center_sk": T.BIGINT,
         "cr_return_quantity": T.BIGINT, "cr_return_amount": DEC2,
         "cr_refunded_cash": DEC2, "cr_net_loss": DEC2,
     },
@@ -159,6 +160,7 @@ SCHEMAS: dict[str, dict[str, T.DataType]] = {
     "store_returns": {
         "sr_returned_date_sk": T.BIGINT, "sr_item_sk": T.BIGINT,
         "sr_customer_sk": T.BIGINT, "sr_ticket_number": T.BIGINT,
+        "sr_reason_sk": T.BIGINT,
         "sr_return_quantity": T.BIGINT, "sr_return_amt": DEC2,
         "sr_net_loss": DEC2,
     },
@@ -166,6 +168,25 @@ SCHEMAS: dict[str, dict[str, T.DataType]] = {
         "inv_date_sk": T.BIGINT, "inv_item_sk": T.BIGINT,
         "inv_warehouse_sk": T.BIGINT,
         "inv_quantity_on_hand": T.BIGINT,
+    },
+    "reason": {
+        "r_reason_sk": T.BIGINT, "r_reason_id": T.VARCHAR,
+        "r_reason_desc": T.VARCHAR,
+    },
+    "income_band": {
+        "ib_income_band_sk": T.BIGINT, "ib_lower_bound": T.BIGINT,
+        "ib_upper_bound": T.BIGINT,
+    },
+    "call_center": {
+        "cc_call_center_sk": T.BIGINT, "cc_call_center_id": T.VARCHAR,
+        "cc_name": T.VARCHAR, "cc_class": T.VARCHAR,
+        "cc_employees": T.BIGINT, "cc_manager": T.VARCHAR,
+        "cc_county": T.VARCHAR,
+    },
+    "catalog_page": {
+        "cp_catalog_page_sk": T.BIGINT, "cp_catalog_page_id": T.VARCHAR,
+        "cp_department": T.VARCHAR, "cp_catalog_number": T.BIGINT,
+        "cp_catalog_page_number": T.BIGINT, "cp_type": T.VARCHAR,
     },
 }
 
@@ -180,6 +201,8 @@ _BASE_ROWS = {
     "inventory": 783_000,
     "web_site": 30, "web_page": 60, "time_dim": 86_400,
     "ship_mode": 20,
+    "reason": 35, "income_band": 20, "call_center": 6,
+    "catalog_page": 11_718,
 }
 
 _UNIQUE = {
@@ -192,6 +215,10 @@ _UNIQUE = {
     "promotion": [("p_promo_sk",)],
     "web_site": [("web_site_sk",)], "web_page": [("wp_web_page_sk",)],
     "time_dim": [("t_time_sk",)], "ship_mode": [("sm_ship_mode_sk",)],
+    "reason": [("r_reason_sk",)],
+    "income_band": [("ib_income_band_sk",)],
+    "call_center": [("cc_call_center_sk",)],
+    "catalog_page": [("cp_catalog_page_sk",)],
 }
 
 _CATEGORIES = ["Home", "Books", "Electronics", "Shoes", "Women", "Men",
@@ -226,7 +253,9 @@ class TpcdsGenerator:
         base = _BASE_ROWS[name]
         if name in ("date_dim", "store", "warehouse", "promotion",
                     "customer_demographics", "household_demographics",
-                    "web_site", "web_page", "time_dim", "ship_mode"):
+                    "web_site", "web_page", "time_dim", "ship_mode",
+                    "reason", "income_band", "call_center",
+                    "catalog_page"):
             return base
         return max(10, int(base * self.scale))
 
@@ -582,6 +611,8 @@ class TpcdsGenerator:
             "sr_item_sk": ss["ss_item_sk"][idx],
             "sr_customer_sk": ss["ss_customer_sk"][idx],
             "sr_ticket_number": ss["ss_ticket_number"][idx],
+            "sr_reason_sk": rng.integers(
+                1, self.rows("reason") + 1, n),
             "sr_return_quantity": np.minimum(
                 rng.integers(1, 20, n), ss["ss_quantity"][idx]),
             "sr_return_amt": rng.integers(100, 50000, n),
@@ -605,6 +636,8 @@ class TpcdsGenerator:
             "cr_item_sk": cs["cs_item_sk"][idx],
             "cr_order_number": cs["cs_order_number"][idx],
             "cr_returning_customer_sk": cs["cs_bill_customer_sk"][idx],
+            "cr_call_center_sk": rng.integers(
+                1, self.rows("call_center") + 1, n),
             "cr_return_quantity": qty,
             "cr_return_amount": amt,
             "cr_refunded_cash": (amt * rng.integers(50, 100, n)) // 100,
@@ -671,6 +704,70 @@ class TpcdsGenerator:
             "t_time": sec, "t_hour": hour,
             "t_minute": (sec // 60) % 60, "t_second": sec % 60,
             "t_meal_time": meal,
+        }
+
+    def _g_reason(self):
+        n = self.rows("reason")
+        sk = np.arange(1, n + 1)
+        descs = ["Package was damaged", "Stopped working",
+                 "Did not get it on time", "Not the product that "
+                 "was ordred", "Parts missing", "Does not work with "
+                 "a product that I have", "Gift exchange",
+                 "Did not like the color", "Did not like the model",
+                 "Did not fit", "Wrong size", "Lost my job",
+                 "Found a better price in a store",
+                 "Found a better extension in a store",
+                 "No service location in my area",
+                 "Duplicate purchase", "Its is a boring color",
+                 "Reason 18", "Reason 19", "unknown"]
+        return {
+            "r_reason_sk": sk,
+            "r_reason_id": np.array(
+                [f"AAAAAAAA{sk_:08d}" for sk_ in sk], object),
+            "r_reason_desc": np.array(descs, object)[
+                (sk - 1) % len(descs)],
+        }
+
+    def _g_income_band(self):
+        n = self.rows("income_band")
+        sk = np.arange(1, n + 1)
+        return {
+            "ib_income_band_sk": sk,
+            "ib_lower_bound": (sk - 1) * 10_000,
+            "ib_upper_bound": sk * 10_000,
+        }
+
+    def _g_call_center(self):
+        n = self.rows("call_center")
+        sk = np.arange(1, n + 1)
+        names = ["NY Metro", "Mid Atlantic", "Hawaii/Alaska",
+                 "North Midwest", "California", "Pacific Northwest"]
+        classes = ["large", "medium", "small"]
+        return {
+            "cc_call_center_sk": sk,
+            "cc_call_center_id": np.array(
+                [f"AAAAAAAA{sk_:08d}" for sk_ in sk], object),
+            "cc_name": np.array(names, object)[(sk - 1) % len(names)],
+            "cc_class": np.array(classes, object)[
+                (sk - 1) % len(classes)],
+            "cc_employees": sk * 1000 % 7 * 100 + 100,
+            "cc_manager": np.array(_FIRST, object)[(sk - 1) % len(_FIRST)],
+            "cc_county": np.array(_CITIES, object)[(sk - 1) % len(_CITIES)],
+        }
+
+    def _g_catalog_page(self):
+        n = self.rows("catalog_page")
+        sk = np.arange(1, n + 1)
+        depts = ["DEPARTMENT"]
+        types = ["bi-annual", "quarterly", "monthly"]
+        return {
+            "cp_catalog_page_sk": sk,
+            "cp_catalog_page_id": np.array(
+                [f"AAAAAAAA{sk_:08d}" for sk_ in sk], object),
+            "cp_department": np.array(depts, object)[np.zeros(n, int)],
+            "cp_catalog_number": (sk - 1) // 108 + 1,
+            "cp_catalog_page_number": (sk - 1) % 108 + 1,
+            "cp_type": np.array(types, object)[(sk - 1) % len(types)],
         }
 
     def _g_ship_mode(self):

@@ -502,6 +502,106 @@ def _like(e, args):
         col, lambda d: np.array([rx.fullmatch(s) is not None for s in d]))
 
 
+@scalar("regexp_like")
+def _regexp_like(e, args):
+    col, pat = args[0], args[1]
+    if not isinstance(e.args[1], ir.Literal):
+        raise NotImplementedError("regexp_like with non-literal pattern")
+    rx = re.compile(str(pat.dictionary[0]))
+    return _dict_predicate(
+        col, lambda d: np.array([rx.search(s) is not None for s in d]))
+
+
+@scalar("regexp_replace")
+def _regexp_replace(e, args):
+    col = args[0]
+    if not all(isinstance(a, ir.Literal) for a in e.args[1:]):
+        raise NotImplementedError(
+            "regexp_replace with non-literal pattern")
+    rx = re.compile(str(args[1].dictionary[0]))
+    repl = str(args[2].dictionary[0]) if len(args) > 2 else ""
+    # SQL replacement groups use $1; python re uses \1
+    repl_py = re.sub(r"\$(\d+)", r"\\\1", repl)
+    return _dict_transform(
+        col, lambda d: np.array([rx.sub(repl_py, s) for s in d], object))
+
+
+@scalar("regexp_extract")
+def _regexp_extract(e, args):
+    col = args[0]
+    if not all(isinstance(a, ir.Literal) for a in e.args[1:]):
+        raise NotImplementedError(
+            "regexp_extract with non-literal pattern")
+    rx = re.compile(str(args[1].dictionary[0]))
+    group = int(e.args[2].value) if len(e.args) > 2 else 0
+
+    def f(d):
+        out = []
+        for s in d:
+            m = rx.search(s)
+            out.append("" if m is None else (m.group(group) or ""))
+        return np.array(out, object)
+
+    # NULL result for non-matching rows (reference regexp_extract
+    # returns NULL when the pattern does not match)
+    matched = _dict_predicate(
+        col, lambda d: np.array([rx.search(s) is not None for s in d]))
+    v = _dict_transform(col, f)
+    valid = (matched.data if v.valid is None
+             else (v.valid & matched.data))
+    return Val(v.dtype, v.data, valid, v.dictionary)
+
+
+@scalar("contains")
+def _contains(e, args):
+    col = args[0]
+    if not isinstance(e.args[1], ir.Literal):
+        raise NotImplementedError("contains with non-literal needle")
+    needle = str(args[1].dictionary[0])
+    return _dict_predicate(
+        col, lambda d: np.array([needle in s for s in d]))
+
+
+@scalar("lpad")
+def _lpad(e, args):
+    col = args[0]
+    if not all(isinstance(a, ir.Literal) for a in e.args[1:]):
+        raise NotImplementedError("lpad with non-literal arguments")
+    n = int(e.args[1].value)
+    fill = str(args[2].dictionary[0]) if len(args) > 2 else " "
+    return _dict_transform(col, lambda d: np.array(
+        [s.rjust(n, fill)[:n] for s in d], object))
+
+
+@scalar("rpad")
+def _rpad(e, args):
+    col = args[0]
+    if not all(isinstance(a, ir.Literal) for a in e.args[1:]):
+        raise NotImplementedError("rpad with non-literal arguments")
+    n = int(e.args[1].value)
+    fill = str(args[2].dictionary[0]) if len(args) > 2 else " "
+    return _dict_transform(col, lambda d: np.array(
+        [s.ljust(n, fill)[:n] for s in d], object))
+
+
+@scalar("split_part")
+def _split_part(e, args):
+    col = args[0]
+    if not all(isinstance(a, ir.Literal) for a in e.args[1:]):
+        raise NotImplementedError("split_part with non-literal arguments")
+    sep = str(args[1].dictionary[0])
+    idx = int(e.args[2].value)  # 1-based
+
+    def f(d):
+        out = []
+        for s in d:
+            parts = s.split(sep)
+            out.append(parts[idx - 1] if 0 < idx <= len(parts) else "")
+        return np.array(out, object)
+
+    return _dict_transform(col, f)
+
+
 @scalar("between")
 def _between(e, args):
     v, lo, hi = args

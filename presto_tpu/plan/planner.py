@@ -397,8 +397,11 @@ class ExprPlanner:
             name = {"week_of_year": "week", "dow": "day_of_week",
                     "doy": "day_of_year"}.get(name, name)
             return ir.Call(T.BIGINT, name, args)
-        if name == "starts_with":
+        if name in ("starts_with", "regexp_like", "contains"):
             return ir.Call(T.BOOLEAN, name, args)
+        if name in ("regexp_replace", "regexp_extract", "lpad", "rpad",
+                    "split_part"):
+            return ir.Call(T.VARCHAR, name, args)
         if name == "abs":
             return ir.Call(args[0].dtype, name, args)
         if name == "sign":

@@ -260,3 +260,35 @@ def test_approx_percentile_grouped_median(eng, tpch_tiny):
         "from lineitem group by l_returnflag order by l_returnflag")
     for _, med in rows:
         assert 20 <= med <= 30  # uniform 1..50 per group
+
+
+def test_regexp_family(eng):
+    """regexp_like / regexp_replace / regexp_extract / split_part /
+    lpad / rpad over dictionary strings (reference operator/scalar
+    regexp functions via joni; here host-evaluated per dictionary
+    entry)."""
+    import re as _re
+    import numpy as np
+
+    engine = eng
+    tbl = engine.catalogs["tpch"].table("customer")
+    phones = [str(tbl.columns["c_phone"].dictionary[c])
+              for c in np.asarray(tbl.columns["c_phone"].data)]
+    got = engine.execute(
+        "SELECT count(*) FROM customer WHERE "
+        "regexp_like(c_phone, '^[12]')")
+    want = sum(1 for p in phones if _re.search("^[12]", p))
+    assert got[0][0] == want
+
+    got = engine.execute(
+        "SELECT c_phone, regexp_replace(c_phone, '-', ''), "
+        "regexp_extract(c_phone, '([0-9]+)-', 1), "
+        "split_part(c_phone, '-', 2), lpad(c_phone, 20, '*'), "
+        "rpad(c_phone, 4) FROM customer LIMIT 50")
+    for phone, repl, ext, part2, lp, rp in got:
+        assert repl == phone.replace("-", "")
+        m = _re.search("([0-9]+)-", phone)
+        assert ext == (m.group(1) if m else None)
+        assert part2 == phone.split("-")[1]
+        assert lp == phone.rjust(20, "*")[:20]
+        assert rp == phone.ljust(4)[:4]

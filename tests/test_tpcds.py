@@ -541,6 +541,64 @@ QUERIES = {
                  i_current_price
         order by i_category, i_class, i_item_id, i_item_desc,
                  revenueratio limit 100""",
+    # Q93 (official): returned-quantity-adjusted sales via reason
+    "q93": """
+        select ss_customer_sk, sum(act_sales) as sumsales
+        from (select ss_item_sk, ss_ticket_number, ss_customer_sk,
+                     case when sr_return_quantity is not null
+                          then (ss_quantity - sr_return_quantity)
+                               * ss_sales_price
+                          else (ss_quantity * ss_sales_price)
+                     end as act_sales
+              from store_sales
+              left outer join store_returns
+                on (sr_item_sk = ss_item_sk
+                    and sr_ticket_number = ss_ticket_number),
+                   reason
+              where sr_reason_sk = r_reason_sk
+                and r_reason_desc = 'Package was damaged') t
+        group by ss_customer_sk
+        order by sumsales, ss_customer_sk
+        limit 100""",
+    # Q91 (official shape): call-center returns by demographics
+    "q91": """
+        select cc_call_center_id as call_center,
+               cc_name as call_center_name,
+               cc_manager as manager,
+               sum(cr_net_loss) as returns_loss
+        from call_center, catalog_returns, date_dim, customer,
+             customer_demographics, household_demographics,
+             customer_address
+        where cr_call_center_sk = cc_call_center_sk
+          and cr_returned_date_sk = d_date_sk
+          and cr_returning_customer_sk = c_customer_sk
+          and cd_demo_sk = c_current_cdemo_sk
+          and hd_demo_sk = c_current_hdemo_sk
+          and ca_address_sk = c_current_addr_sk
+          and d_year = 1998 and d_moy = 11
+          and cd_marital_status = 'M'
+          and hd_buy_potential like 'Unknown%'
+          and ca_gmt_offset = -7
+        group by cc_call_center_id, cc_name, cc_manager
+        order by returns_loss desc, call_center""",
+    # Q84 (official): income-band customer lookup
+    "q84": """
+        select c_customer_id as customer_id,
+               concat(coalesce(c_last_name, ''),
+                      concat(', ', coalesce(c_first_name, '')))
+                   as customername
+        from customer, customer_address, customer_demographics,
+             household_demographics, income_band, store_returns
+        where ca_city = 'Midway'
+          and c_current_addr_sk = ca_address_sk
+          and ib_lower_bound >= 30000
+          and ib_upper_bound <= 80000
+          and ib_income_band_sk = hd_income_band_sk
+          and cd_demo_sk = c_current_cdemo_sk
+          and hd_demo_sk = c_current_hdemo_sk
+          and sr_customer_sk = c_customer_sk
+        order by c_customer_id
+        limit 100""",
     # windowed ranking over aggregates (Q67-style core)
     "q_rank_categories": """
         select * from (
