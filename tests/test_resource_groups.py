@@ -13,8 +13,9 @@ from presto_tpu.server.resource_groups import (GroupSpec,
 
 
 def test_group_admits_queues_and_transfers_slots():
-    g = InternalResourceGroup(GroupSpec("g", hard_concurrency_limit=2,
-                                        max_queued=2))
+    g = ResourceGroupManager(
+        [GroupSpec("g", hard_concurrency_limit=2,
+                   max_queued=2)]).select("u", "q")
     started = []
     assert g.submit(lambda: started.append("a")) == "RUNNING"
     assert g.submit(lambda: started.append("b")) == "RUNNING"
@@ -30,8 +31,9 @@ def test_group_admits_queues_and_transfers_slots():
 
 
 def test_group_rejects_when_queue_full():
-    g = InternalResourceGroup(GroupSpec("g", hard_concurrency_limit=1,
-                                        max_queued=1))
+    g = ResourceGroupManager(
+        [GroupSpec("g", hard_concurrency_limit=1,
+                   max_queued=1)]).select("u", "q")
     g.submit(lambda: None)
     g.submit(lambda: None)  # queued
     with pytest.raises(QueryQueueFullError):
@@ -107,8 +109,9 @@ def test_server_enforces_concurrency_limit(tpch_tiny):
 
 
 def test_cancel_queued_frees_queue_slot():
-    g = InternalResourceGroup(GroupSpec("g", hard_concurrency_limit=1,
-                                        max_queued=1))
+    g = ResourceGroupManager(
+        [GroupSpec("g", hard_concurrency_limit=1,
+                   max_queued=1)]).select("u", "q")
     g.submit(lambda: None)
     queued = lambda: None  # noqa: E731
     g.submit(queued)
@@ -125,3 +128,76 @@ def test_no_matching_selector_rejects():
         GroupSpec("svc", user_pattern="svc_.*")])
     with pytest.raises(NoMatchingGroupError):
         mgr.select("alice", "select 1")
+
+
+def test_hierarchy_parent_limit_gates_children():
+    """A child admission needs free slots in EVERY ancestor (reference
+    InternalResourceGroup.java canRunMore walks up)."""
+    from presto_tpu.server.resource_groups import (GroupSpec,
+                                                   ResourceGroupManager)
+
+    mgr = ResourceGroupManager([
+        GroupSpec("global", hard_concurrency_limit=2),
+        GroupSpec("global.a", hard_concurrency_limit=2,
+                  user_pattern="a.*"),
+        GroupSpec("global.b", hard_concurrency_limit=2,
+                  user_pattern="b.*"),
+    ])
+    ran = []
+    a = mgr.select("alice", "q")
+    b = mgr.select("bob", "q")
+    assert a.spec.name == "global.a" and b.spec.name == "global.b"
+    assert a.submit(lambda: ran.append("a1")) == "RUNNING"
+    assert b.submit(lambda: ran.append("b1")) == "RUNNING"
+    # parent 'global' is now at its limit of 2: children must queue
+    assert a.submit(lambda: ran.append("a2")) == "QUEUED"
+    assert ran == ["a1", "b1"]
+    a.finish()  # frees a slot; queued a2 dequeues through the root
+    assert ran == ["a1", "b1", "a2"]
+
+
+def test_weighted_fair_dequeue_order():
+    """weighted_fair picks the child with the lowest running/weight
+    ratio when a slot frees."""
+    from presto_tpu.server.resource_groups import (GroupSpec,
+                                                   ResourceGroupManager)
+
+    mgr = ResourceGroupManager([
+        GroupSpec("g", hard_concurrency_limit=1,
+                  scheduling_policy="weighted_fair"),
+        GroupSpec("g.heavy", hard_concurrency_limit=8,
+                  scheduling_weight=3, user_pattern="h.*"),
+        GroupSpec("g.light", hard_concurrency_limit=8,
+                  scheduling_weight=1, user_pattern="l.*"),
+    ])
+    heavy = mgr.select("h1", "q")
+    light = mgr.select("l1", "q")
+    ran = []
+    assert heavy.submit(lambda: ran.append("h1")) == "RUNNING"
+    assert light.submit(lambda: ran.append("l1")) == "QUEUED"
+    assert heavy.submit(lambda: ran.append("h2")) == "QUEUED"
+    # slot frees: both children idle (running 0) -> ratio ties at 0,
+    # FIFO breaks the tie -> l1; next free admits h2
+    heavy.finish()
+    assert ran == ["h1", "l1"]
+    light.finish()
+    assert ran == ["h1", "l1", "h2"]
+
+
+def test_query_priority_policy():
+    from presto_tpu.server.resource_groups import (GroupSpec,
+                                                   ResourceGroupManager)
+
+    mgr = ResourceGroupManager([
+        GroupSpec("p", hard_concurrency_limit=1,
+                  scheduling_policy="query_priority"),
+    ])
+    g = mgr.select("u", "q")
+    ran = []
+    assert g.submit(lambda: ran.append("first")) == "RUNNING"
+    assert g.submit(lambda: ran.append("low"), priority=1) == "QUEUED"
+    assert g.submit(lambda: ran.append("high"), priority=9) == "QUEUED"
+    g.finish()
+    assert ran == ["first", "high"]
+    g.finish()
+    assert ran == ["first", "high", "low"]
