@@ -264,6 +264,26 @@ class _Handler(JsonHandler):
 
     def do_GET(self):  # noqa: N802
         parts = self.path.strip("/").split("/")
+        if self.path in ("/", "/ui", "/ui/"):
+            body = _UI_HTML.encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "text/html; charset=utf-8")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
+        if self.path == "/v1/cluster":
+            qs = list(self.manager.queries.values())
+            self._send_json({
+                "runningQueries": sum(q.state == "RUNNING" for q in qs),
+                "queuedQueries": sum(q.state == "QUEUED" for q in qs),
+                "finishedQueries": sum(q.state == "FINISHED"
+                                       for q in qs),
+                "failedQueries": sum(q.state in ("FAILED", "CANCELED")
+                                     for q in qs),
+                "totalQueries": len(qs),
+            })
+            return
         if self.path == "/v1/info":
             self._send_json({
                 "nodeVersion": {"version": "presto-tpu-0.1"},
@@ -319,6 +339,55 @@ class _Handler(JsonHandler):
             self.end_headers()
             return
         self._send_json({"error": "not found"}, 404)
+
+
+# Minimal cluster/query dashboard (reference Web UI, server/ui/ +
+# webapp React app, reduced to one self-contained page polling the
+# JSON APIs this coordinator already serves).
+_UI_HTML = """<!doctype html>
+<html><head><title>presto-tpu</title><style>
+body{font-family:system-ui,sans-serif;margin:2em;background:#111;
+color:#eee}
+h1{font-size:1.3em} h2{font-size:1.05em;margin-top:1.4em}
+table{border-collapse:collapse;width:100%;font-size:.85em}
+td,th{border:1px solid #333;padding:.35em .6em;text-align:left}
+th{background:#1c2733} .st-RUNNING{color:#6cf} .st-FINISHED{color:#6f6}
+.st-FAILED{color:#f66} .st-QUEUED{color:#fc6} .st-CANCELED{color:#999}
+.cards{display:flex;gap:1em} .card{background:#1c2733;padding:.8em
+1.2em;border-radius:6px;min-width:7em}
+.card b{font-size:1.6em;display:block}
+</style></head><body>
+<h1>presto-tpu coordinator</h1>
+<div class="cards" id="cards"></div>
+<h2>Queries</h2><table id="queries"><thead><tr><th>id</th><th>state
+</th><th>user</th><th>query</th></tr></thead><tbody></tbody></table>
+<h2>Resource groups</h2><table id="groups"><thead><tr><th>group</th>
+<th>policy</th><th>running</th><th>queued</th><th>limit</th>
+</tr></thead><tbody></tbody></table>
+<script>
+async function j(u){return (await fetch(u)).json()}
+function esc(s){const d=document.createElement('span');
+d.textContent=s;return d.innerHTML}
+function groupRows(gs,prefix){let out='';for(const g of gs){
+out+=`<tr><td>${esc(g.name)}</td><td>${esc(g.schedulingPolicy||'fair')}
+</td><td>${g.running}</td><td>${g.queued}</td>
+<td>${g.hardConcurrencyLimit}</td></tr>`;
+if(g.subGroups)out+=groupRows(g.subGroups)}return out}
+async function tick(){
+const c=await j('/v1/cluster');
+document.getElementById('cards').innerHTML=
+['runningQueries','queuedQueries','finishedQueries','failedQueries']
+.map(k=>`<div class="card"><b>${c[k]}</b>${k.replace('Queries','')}
+</div>`).join('');
+const qs=await j('/v1/query');
+document.querySelector('#queries tbody').innerHTML=qs.slice(-50)
+.reverse().map(q=>`<tr><td>${esc(q.queryId)}</td>
+<td class="st-${q.state}">${q.state}</td><td>${esc(q.user)}</td>
+<td><code>${esc(q.query.slice(0,120))}</code></td></tr>`).join('');
+const gs=await j('/v1/resourceGroup');
+document.querySelector('#groups tbody').innerHTML=groupRows(gs);}
+tick();setInterval(tick,2000);
+</script></body></html>"""
 
 
 class CoordinatorServer(HttpService):

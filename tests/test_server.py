@@ -119,3 +119,19 @@ def test_query_max_run_time(server):
             c.execute("SELECT count(*) FROM bh2.slow2")
     finally:
         engine.session.set("query_max_run_time", 0.0)
+
+
+def test_web_ui_and_cluster_stats(server):
+    """Minimal Web UI (reference server/ui/ webapp) + cluster stats."""
+    import json
+    import urllib.request
+
+    base = f"http://127.0.0.1:{server.port}"
+    with urllib.request.urlopen(f"{base}/ui") as resp:
+        html = resp.read().decode()
+    assert "presto-tpu coordinator" in html
+    assert "Resource groups" in html
+    with urllib.request.urlopen(f"{base}/v1/cluster") as resp:
+        stats = json.loads(resp.read())
+    assert stats["totalQueries"] >= 1
+    assert "runningQueries" in stats
