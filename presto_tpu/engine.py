@@ -33,6 +33,11 @@ class Engine:
         # gen/PageFunctionCompiler.java:101 compiled-artifact caches)
         self._program_cache: dict = {}
         self._caps_memory: dict = {}
+        # runtime memory ledger: per-program tagged reservations of
+        # actual input+output array bytes (memory/MemoryPool.java:44);
+        # capacity 0 = unbounded (set memory_pool.capacity to enforce)
+        from presto_tpu.memory import MemoryPool
+        self.memory_pool = MemoryPool()
         # populated by the spill driver when a query exceeds the memory
         # budget and runs host-partitioned (exec/spill.py)
         self.last_spill: dict | None = None

@@ -421,21 +421,39 @@ def prepare_plan(engine, plan: N.PlanNode, scan_inputs: list[ScanInput]):
 def run_plan(engine, plan: N.PlanNode,
              scan_inputs: list[ScanInput]) -> Table:
     """Compile + run over prepared scan inputs (shared by the whole-table
-    and block-streamed paths)."""
-    _compiled, _flat, meta, (res, live, _oks) = prepare_plan(
-        engine, plan, scan_inputs)
+    and block-streamed paths). Input and output array bytes are
+    reserved in the engine's runtime memory pool for the duration
+    (memory/MemoryPool.java:44 tagged-reservation analog)."""
+    import uuid
 
-    live_np = np.asarray(live)
-    cols: dict[str, Column] = {}
-    i = 0
-    for sym, dtype, dictionary, has_valid in meta["out"]:
-        data = np.asarray(res[i])
-        valid = np.asarray(res[i + 1])
-        i += 2
-        cols[sym] = Column(dtype, data,
-                           valid if has_valid or not valid.all() else None,
-                           dictionary)
-    return Table(_rename_outputs(plan, cols), len(live_np), live_np)
+    pool = getattr(engine, "memory_pool", None)
+    tag = uuid.uuid4().hex[:12]
+    if pool is not None:
+        pool.reserve(tag, sum(
+            a.nbytes for scan in scan_inputs
+            for a in scan.arrays.values()))
+    try:
+        _compiled, _flat, meta, (res, live, _oks) = prepare_plan(
+            engine, plan, scan_inputs)
+        if pool is not None:
+            # device-side shape math only — no transfer
+            pool.reserve(tag, sum(int(r.nbytes) for r in res))
+
+        live_np = np.asarray(live)
+        cols: dict[str, Column] = {}
+        i = 0
+        for sym, dtype, dictionary, has_valid in meta["out"]:
+            data = np.asarray(res[i])
+            valid = np.asarray(res[i + 1])
+            i += 2
+            cols[sym] = Column(
+                dtype, data,
+                valid if has_valid or not valid.all() else None,
+                dictionary)
+        return Table(_rename_outputs(plan, cols), len(live_np), live_np)
+    finally:
+        if pool is not None:
+            pool.free(tag)
 
 
 def _rename_outputs(plan: N.PlanNode,

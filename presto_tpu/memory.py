@@ -123,3 +123,54 @@ def largest_join(per_node: list[NodeMemory]) -> N.Join | None:
             if build > best_rows:
                 best, best_rows = m.node, build
     return best
+
+
+class MemoryPool:
+    """Runtime memory ledger: tagged byte reservations with a capacity
+    (reference memory/MemoryPool.java:44 tagged reservations +
+    LocalMemoryManager GENERAL pool). The engine reserves each
+    program's measured input+output array bytes for the duration of
+    execution; the coordinator aggregates pool snapshots cluster-wide
+    (ClusterMemoryManager.java:89)."""
+
+    def __init__(self, capacity_bytes: int = 0):
+        import threading
+        self.capacity = capacity_bytes  # 0 = unbounded
+        self.reserved = 0
+        self.peak = 0
+        self.by_tag: dict[str, int] = {}
+        self._lock = threading.Lock()
+
+    def reserve(self, tag: str, nbytes: int) -> None:
+        with self._lock:
+            if self.capacity and self.reserved + nbytes > self.capacity:
+                raise MemoryLimitExceeded(
+                    f"pool exhausted: {self.reserved} + {nbytes} "
+                    f"> {self.capacity} bytes (query {tag})")
+            self.reserved += nbytes
+            self.peak = max(self.peak, self.reserved)
+            self.by_tag[tag] = self.by_tag.get(tag, 0) + nbytes
+
+    def free(self, tag: str, nbytes: int | None = None) -> None:
+        with self._lock:
+            held = self.by_tag.pop(tag, 0)
+            give_back = held if nbytes is None else min(nbytes, held)
+            if nbytes is not None and held - give_back > 0:
+                self.by_tag[tag] = held - give_back
+            self.reserved -= give_back
+
+    def largest_tag(self) -> tuple[str, int] | None:
+        """Biggest current reservation — the low-memory killer's victim
+        choice (TotalReservationLowMemoryKiller analog)."""
+        with self._lock:
+            if not self.by_tag:
+                return None
+            tag = max(self.by_tag, key=self.by_tag.get)
+            return tag, self.by_tag[tag]
+
+    def info(self) -> dict:
+        with self._lock:
+            return {"capacityBytes": self.capacity,
+                    "reservedBytes": self.reserved,
+                    "peakBytes": self.peak,
+                    "queries": dict(self.by_tag)}

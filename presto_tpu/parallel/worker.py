@@ -201,8 +201,15 @@ class WorkerServer(HttpService):
             def do_GET(self):  # noqa: N802
                 parts = self.path.strip("/").split("/")
                 if self.path == "/v1/status":
-                    self._send_json({"nodeId": outer.node_id,
-                                     "state": "active"})
+                    pools = [e.memory_pool.info()
+                             for e in outer._engines.values()]
+                    self._send_json({
+                        "nodeId": outer.node_id, "state": "active",
+                        "memory": {
+                            "reservedBytes": sum(
+                                p["reservedBytes"] for p in pools),
+                            "peakBytes": sum(
+                                p["peakBytes"] for p in pools)}})
                     return
                 if (len(parts) == 5 and parts[:2] == ["v1", "task"]
                         and parts[3] == "results"):

@@ -299,6 +299,7 @@ class _Handler(JsonHandler):
                 "state": "active",
                 "coordinator": True,
                 "uptime": f"{time.time() - self.server_start:.0f}s",
+                "memory": self.manager.engine.memory_pool.info(),
             })
             return
         if self.path == "/v1/resourceGroup":
