@@ -18,13 +18,20 @@ class QueryFailed(Exception):
 
 
 class Client:
-    def __init__(self, base_url: str, user: str = "presto"):
+    def __init__(self, base_url: str, user: str = "presto",
+                 password: str | None = None):
         self.base_url = base_url.rstrip("/")
         self.user = user
+        self.password = password
 
     def _request(self, method: str, url: str, body: bytes | None = None):
         req = urllib.request.Request(url, data=body, method=method)
         req.add_header("X-Trino-User", self.user)
+        if self.password is not None:
+            import base64
+            cred = base64.b64encode(
+                f"{self.user}:{self.password}".encode()).decode()
+            req.add_header("Authorization", f"Basic {cred}")
         with urllib.request.urlopen(req, timeout=300) as resp:
             return json.loads(resp.read() or b"{}")
 

@@ -38,6 +38,10 @@ class Engine:
         # capacity 0 = unbounded (set memory_pool.capacity to enforce)
         from presto_tpu.memory import MemoryPool
         self.memory_pool = MemoryPool()
+        # table-level authorization consulted by the planner at scans
+        # and by DML (security/AccessControlManager.java analog)
+        from presto_tpu.security import AllowAllAccessControl
+        self.access_control = AllowAllAccessControl()
         # populated by the spill driver when a query exceeds the memory
         # budget and runs host-partitioned (exec/spill.py)
         self.last_spill: dict | None = None
@@ -208,6 +212,8 @@ class Engine:
 
         if isinstance(stmt, A.CreateTableAs):
             catalog, table = self._resolve_table(stmt.table)
+            self.access_control.check_can_write(
+                self.session.user, catalog, table)
             conn = self._connector(catalog)
             result = self._execute_query(stmt.query, mesh)
             schema, data, valid = _table_to_host(result)
@@ -216,6 +222,8 @@ class Engine:
 
         if isinstance(stmt, A.InsertStatement):
             catalog, table = self._resolve_table(stmt.table)
+            self.access_control.check_can_write(
+                self.session.user, catalog, table)
             conn = self._connector(catalog)
             result = self._execute_query(stmt.query, mesh)
             schema, data, valid = _table_to_host(result)
@@ -232,6 +240,8 @@ class Engine:
             # ConnectorPageSink rowId delete, trimmed to the host-table
             # connectors this engine mutates in place)
             catalog, table = self._resolve_table(stmt.table)
+            self.access_control.check_can_write(
+                self.session.user, catalog, table)
             conn = self._connector(catalog)
             mask = self._row_mask(stmt.table, stmt.where, mesh)
             return [(conn.delete_rows(table, mask),)]

@@ -836,6 +836,8 @@ class LogicalPlanner:
             types[sym] = dtype
             colsyms[col] = sym
             fields.append(Field(col, table, sym, dtype))
+        self.engine.access_control.check_can_select(
+            self.engine.session.user, catalog, table)
         node = N.TableScan(catalog, table, assignments, types)
         unique = [frozenset(colsyms[c] for c in key)
                   for key in conn.unique_keys(table)]

@@ -210,7 +210,38 @@ class QueryManager:
 
 class _Handler(JsonHandler):
     manager: QueryManager = None  # type: ignore[assignment]
+    authenticator = None  # security.PasswordAuthenticator | None
     server_start = time.time()
+
+    def _authenticated_user(self) -> str | None:
+        """Resolve the request user; None means 401 was sent. With no
+        authenticator configured the user header is trusted (the
+        reference's insecure authentication mode)."""
+        import base64
+
+        from presto_tpu.security import AuthenticationError
+
+        header_user = self.headers.get(
+            "X-Trino-User", self.headers.get("X-Presto-User",
+                                             "anonymous"))
+        if self.authenticator is None:
+            return header_user
+        auth = self.headers.get("Authorization", "")
+        if auth.startswith("Basic "):
+            try:
+                raw = base64.b64decode(auth[6:]).decode()
+                user, _, password = raw.partition(":")
+                self.authenticator.authenticate(user, password)
+                return user
+            except (AuthenticationError, ValueError):
+                pass
+        body = b'{"error": "authentication failed"}'
+        self.send_response(401)
+        self.send_header("WWW-Authenticate", "Basic realm=presto-tpu")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+        return None
 
     # -- helpers ------------------------------------------------------------
 
@@ -252,11 +283,11 @@ class _Handler(JsonHandler):
 
     def do_POST(self):  # noqa: N802
         if self.path == "/v1/statement":
+            user = self._authenticated_user()
+            if user is None:
+                return
             length = int(self.headers.get("Content-Length", 0))
             sql = self.rfile.read(length).decode()
-            user = self.headers.get("X-Trino-User",
-                                    self.headers.get("X-Presto-User",
-                                                     "anonymous"))
             q = self.manager.submit(sql, user)
             self._send_json(self._query_results(q, 0))
             return
@@ -395,8 +426,9 @@ class CoordinatorServer(HttpService):
     """Threaded HTTP coordinator over an Engine (Server.java:75 analog)."""
 
     def __init__(self, engine, host: str = "127.0.0.1", port: int = 0,
-                 resource_groups=None):
+                 resource_groups=None, authenticator=None):
         handler = type("BoundHandler", (_Handler,), {
             "manager": QueryManager(engine,
-                                    resource_groups=resource_groups)})
+                                    resource_groups=resource_groups),
+            "authenticator": authenticator})
         super().__init__(handler, host, port)

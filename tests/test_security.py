@@ -1,0 +1,62 @@
+"""Authentication + access control (reference server/security/ +
+AccessControlManager + file-based access control)."""
+
+import pytest
+
+from presto_tpu import Engine
+from presto_tpu.security import (AccessDeniedError, AccessRule,
+                                 FileBasedPasswordAuthenticator,
+                                 RuleBasedAccessControl)
+
+
+def test_access_control_blocks_select(tpch_tiny):
+    e = Engine()
+    e.register_catalog("tpch", tpch_tiny)
+    e.access_control = RuleBasedAccessControl([
+        AccessRule(user_pattern="analyst", catalog_pattern="tpch",
+                   table_pattern="lineitem", allow=True, write=False),
+    ])
+    e.session.user = "analyst"
+    assert e.execute("select count(*) from lineitem")[0][0] > 0
+    with pytest.raises(AccessDeniedError):
+        e.execute("select count(*) from orders")
+    with pytest.raises(AccessDeniedError):
+        e.execute("delete from lineitem where l_orderkey = 1")
+
+
+def test_rule_order_first_match_wins():
+    ac = RuleBasedAccessControl([
+        AccessRule(user_pattern="bob", table_pattern="secret",
+                   allow=False),
+        AccessRule(),  # allow everything else
+    ])
+    ac.check_can_select("bob", "c", "public")
+    with pytest.raises(AccessDeniedError):
+        ac.check_can_select("bob", "c", "secret")
+    ac.check_can_select("alice", "c", "secret")
+
+
+def test_http_basic_auth(tpch_tiny):
+    from presto_tpu.client import Client, QueryFailed
+    from presto_tpu.server import CoordinatorServer
+    import urllib.error
+
+    e = Engine()
+    e.register_catalog("tpch", tpch_tiny)
+    auth = FileBasedPasswordAuthenticator({
+        "alice": FileBasedPasswordAuthenticator.hash_password("s3cret")})
+    srv = CoordinatorServer(e, authenticator=auth).start()
+    try:
+        ok = Client(f"http://127.0.0.1:{srv.port}", user="alice",
+                    password="s3cret")
+        cols, rows = ok.execute("select 1")
+        assert rows == [[1]]
+        bad = Client(f"http://127.0.0.1:{srv.port}", user="alice",
+                     password="wrong")
+        with pytest.raises(urllib.error.HTTPError):
+            bad.execute("select 1")
+        anon = Client(f"http://127.0.0.1:{srv.port}", user="alice")
+        with pytest.raises(urllib.error.HTTPError):
+            anon.execute("select 1")
+    finally:
+        srv.stop()
