@@ -194,72 +194,53 @@ class ClusterCoordinator:
             from presto_tpu.exec.executor import execute_plan
             return execute_plan(self.engine, plan).to_pylist()
         agg, _scan = found
-        partial = dataclasses.replace(agg, step=N.AggStep.PARTIAL)
-        types = partial.output_types()
+        return self._execute_partial_fragments(plan, agg, workers)
 
+    def _execute_partial_fragments(self, plan, agg,
+                                   workers) -> list[tuple]:
+        """Scan->aggregate plans ship the PARTIAL fragment (serialized
+        plan IR, not SQL — the worker no longer re-plans) as one split
+        per worker with binary columnar results; failed splits fail
+        over to survivors (elastic recovery)."""
+        import dataclasses as DC
+
+        from presto_tpu.exec.executor import ScanInput, run_plan
+        from presto_tpu.exec.streaming import _replace_node
+        from presto_tpu.parallel.wire import (bytes_to_columns,
+                                              concat_columns)
+        from presto_tpu.plan import nodes as N
+        from presto_tpu.plan.serde import fragment_to_dict
+
+        partial = DC.replace(agg, step=N.AggStep.PARTIAL)
+        types = partial.output_types()
         nshards = len(workers)
-        payloads = [{"sql": sql, "shard": i, "nshards": nshards}
+        frag = fragment_to_dict(partial)
+        payloads = [{"fragment": frag, "shard": i, "nshards": nshards}
                     for i in range(nshards)]
         results = self._dispatch_splits(payloads, workers)
 
-        # -- gather partial states into a carrier scan (streaming.py
-        #    phase 2, with HTTP instead of the block loop) -------------
-        syms = list(types)
-        arrays: dict[str, np.ndarray] = {}
-        dicts: dict[str, np.ndarray | None] = {}
-        per_sym_vals: dict[str, list] = {s: [] for s in syms}
-        per_sym_valid: dict[str, list] = {s: [] for s in syms}
-        per_sym_dtype: dict[str, str | None] = {s: None for s in syms}
-        total = 0
-        for res in results:
-            got = {c["name"]: c for c in res["columns"]}
-            if set(got) != set(syms):
-                raise RuntimeError(
-                    f"worker fragment schema mismatch: {sorted(got)} "
-                    f"!= {sorted(syms)}")
-            n = res["nrows"]
-            total += n
-            for s in syms:
-                per_sym_vals[s].extend(got[s]["values"])
-                if got[s].get("dtype"):
-                    per_sym_dtype[s] = got[s]["dtype"]
-                v = got[s]["valid"]
-                per_sym_valid[s].extend(
-                    v if v is not None else [True] * n)
-        from presto_tpu.block import dictionary_encode
-        for s in syms:
-            dtype = types[s]
-            if isinstance(dtype, T.VarcharType):
-                codes, d = dictionary_encode(
-                    np.array(per_sym_vals[s], object))
-                arrays[s] = codes
-                dicts[s] = d
-            else:
-                # the wire dtype wins over the nominal SQL type: sketch
-                # states (checksum $sum, approx_percentile $rhash) are
-                # uint64 yet declared BIGINT, and int64 parsing would
-                # overflow on values >= 2**63
-                np_dtype = (np.dtype(per_sym_dtype[s])
-                            if per_sym_dtype[s] else dtype.physical_dtype)
-                arrays[s] = np.asarray(per_sym_vals[s], dtype=np_dtype)
-                dicts[s] = None
-            if not all(per_sym_valid[s]):
-                arrays[f"{s}$valid"] = np.asarray(per_sym_valid[s],
-                                                  dtype=bool)
-        arrays["__live__"] = np.ones(total, dtype=bool)
-
-        from presto_tpu.exec.executor import ScanInput, run_plan
+        parts = [bytes_to_columns(b) for b in results]
+        cols = concat_columns([p[0] for p in parts])
+        total = sum(p[1] for p in parts)
         carrier = N.TableScan("__cluster__", "__partials__",
-                              {s: s for s in syms}, dict(types))
-        final_agg = dataclasses.replace(agg, source=carrier,
-                                        step=N.AggStep.FINAL)
+                              {s: s for s in types}, dict(types))
+        final_agg = DC.replace(agg, source=carrier,
+                               step=N.AggStep.FINAL)
         plan2 = _replace_node(plan, agg, final_agg)
+        arrays: dict = {}
+        dicts: dict = {}
+        for s in types:
+            col = cols[s]
+            arrays[s] = np.asarray(col.data)
+            if col.valid is not None:
+                arrays[f"{s}$valid"] = np.asarray(col.valid)
+            dicts[s] = col.dictionary
         carrier_input = ScanInput(carrier, arrays, dicts, dict(types),
                                   total)
         self.last_distribution = {"nshards": nshards,
                                   "partial_rows": total}
-        return run_plan(self.engine, plan2, [carrier_input]).to_pylist()
-
+        return run_plan(self.engine, plan2,
+                        [carrier_input]).to_pylist()
     def _execute_fragmented(self, plan, fragged,
                             workers: list[RemoteWorker]) -> list[tuple]:
         """Run a fragmented join plan: scan stages partition legs into
@@ -417,7 +398,7 @@ class ClusterCoordinator:
                 if not w.alive:
                     continue
                 try:
-                    out = w.post_task(payloads[i])
+                    out = w.post_task_any(payloads[i])
                     w.record(False)
                     return out
                 except TaskError:
