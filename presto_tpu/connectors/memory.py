@@ -88,6 +88,24 @@ class MemoryConnector(Connector):
                 self._valid[name][c] = old_v
         return int(m.sum())
 
+    def snapshot(self):
+        """Deep copy of the store for transaction rollback
+        (transaction.py copy-on-first-write)."""
+        return (
+            {t: dict(cols) for t, cols in self._schemas.items()},
+            {t: {c: np.copy(a) for c, a in cols.items()}
+             for t, cols in self._data.items()},
+            {t: {c: None if v is None else np.copy(v)
+                 for c, v in cols.items()}
+             for t, cols in self._valid.items()},
+        )
+
+    def restore(self, snap) -> None:
+        schemas, data, valid = snap
+        self._schemas = {t: dict(cols) for t, cols in schemas.items()}
+        self._data = {t: dict(cols) for t, cols in data.items()}
+        self._valid = {t: dict(cols) for t, cols in valid.items()}
+
     def drop_table(self, name: str) -> None:
         self._schemas.pop(name, None)
         self._data.pop(name, None)

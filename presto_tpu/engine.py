@@ -42,6 +42,10 @@ class Engine:
         # and by DML (security/AccessControlManager.java analog)
         from presto_tpu.security import AllowAllAccessControl
         self.access_control = AllowAllAccessControl()
+        # session-scoped transactions (transaction.py; reference
+        # transaction/InMemoryTransactionManager)
+        from presto_tpu.transaction import TransactionManager
+        self.transactions = TransactionManager()
         # populated by the spill driver when a query exceeds the memory
         # budget and runs host-partitioned (exec/spill.py)
         self.last_spill: dict | None = None
@@ -183,6 +187,16 @@ class Engine:
                 return [(format_plan(plan),)]
             raise ValueError("EXPLAIN of non-query statements unsupported")
 
+        if isinstance(stmt, A.StartTransaction):
+            self.transactions.begin()
+            return []
+        if isinstance(stmt, A.CommitStatement):
+            self.transactions.commit()
+            return []
+        if isinstance(stmt, A.RollbackStatement):
+            self.transactions.rollback()
+            return []
+
         if isinstance(stmt, A.ShowCatalogs):
             return [(name,) for name in sorted(self.catalogs)]
 
@@ -215,6 +229,7 @@ class Engine:
             self.access_control.check_can_write(
                 self.session.user, catalog, table)
             conn = self._connector(catalog)
+            self.transactions.touch(conn)
             result = self._execute_query(stmt.query, mesh)
             schema, data, valid = _table_to_host(result)
             conn.create_table(table, schema, data, valid)
@@ -225,6 +240,7 @@ class Engine:
             self.access_control.check_can_write(
                 self.session.user, catalog, table)
             conn = self._connector(catalog)
+            self.transactions.touch(conn)
             result = self._execute_query(stmt.query, mesh)
             schema, data, valid = _table_to_host(result)
             target = conn.table_schema(table)
@@ -243,6 +259,7 @@ class Engine:
             self.access_control.check_can_write(
                 self.session.user, catalog, table)
             conn = self._connector(catalog)
+            self.transactions.touch(conn)
             mask = self._row_mask(stmt.table, stmt.where, mesh)
             return [(conn.delete_rows(table, mask),)]
 
@@ -251,6 +268,7 @@ class Engine:
 
             catalog, table = self._resolve_table(stmt.table)
             conn = self._connector(catalog)
+            self.transactions.touch(conn)
             target = conn.table_schema(table)
             # one scan computes the new values AND the WHERE mask, so
             # both come from the same row order
@@ -280,6 +298,7 @@ class Engine:
                 if stmt.if_exists:
                     return []
                 raise ValueError(f"table {table} does not exist")
+            self.transactions.touch(conn)
             conn.drop_table(table)
             return []
 

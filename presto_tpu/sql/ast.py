@@ -339,6 +339,21 @@ class ShowColumns(Statement):
 
 
 @dataclasses.dataclass(frozen=True)
+class StartTransaction(Statement):
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
+class CommitStatement(Statement):
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
+class RollbackStatement(Statement):
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
 class ShowCatalogs(Statement):
     pass
 

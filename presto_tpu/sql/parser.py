@@ -135,6 +135,20 @@ class Parser:
                 table = self.qualified_name()
                 self.expect_eof()
                 return A.ShowColumns(table)
+            if t.value in ("start", "begin"):
+                self.advance()
+                if t.value == "start":
+                    self.expect_keyword("transaction")
+                self.expect_eof()
+                return A.StartTransaction()
+            if t.value == "commit":
+                self.advance()
+                self.expect_eof()
+                return A.CommitStatement()
+            if t.value == "rollback":
+                self.advance()
+                self.expect_eof()
+                return A.RollbackStatement()
             if t.value == "set":
                 self.advance()
                 self.expect_keyword("session")

@@ -1,0 +1,59 @@
+"""Transactions: START TRANSACTION / COMMIT / ROLLBACK scope writes to
+mutable connectors (reference transaction/InMemoryTransactionManager +
+TransactionBuilder)."""
+
+import pytest
+
+from presto_tpu import BIGINT, Engine
+from presto_tpu.connectors.memory import MemoryConnector
+from presto_tpu.transaction import TransactionError
+
+
+@pytest.fixture()
+def eng():
+    e = Engine()
+    e.register_catalog("mem", MemoryConnector())
+    e.session.catalog = "mem"
+    e.execute("create table t as select 1 as x")
+    e.execute("insert into t select 2")
+    return e
+
+
+def _xs(e):
+    return sorted(r[0] for r in e.execute("select x from t"))
+
+
+def test_rollback_restores_writes(eng):
+    eng.execute("start transaction")
+    eng.execute("insert into t select 3")
+    eng.execute("delete from t where x = 1")
+    assert _xs(eng) == [2, 3]  # reads see in-transaction writes
+    eng.execute("rollback")
+    assert _xs(eng) == [1, 2]
+
+
+def test_commit_keeps_writes(eng):
+    eng.execute("begin")
+    eng.execute("update t set x = x + 10 where x = 2")
+    eng.execute("commit")
+    assert _xs(eng) == [1, 12]
+
+
+def test_rollback_restores_dropped_table(eng):
+    eng.execute("start transaction")
+    eng.execute("drop table t")
+    assert "t" not in eng.catalogs["mem"].table_names()
+    eng.execute("rollback")
+    assert _xs(eng) == [1, 2]
+
+
+def test_nested_begin_rejected(eng):
+    eng.execute("start transaction")
+    with pytest.raises(TransactionError):
+        eng.execute("begin")
+    eng.execute("rollback")
+
+
+def test_commit_without_transaction_rejected(eng):
+    with pytest.raises(TransactionError):
+        eng.execute("commit")
