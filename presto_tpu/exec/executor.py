@@ -347,6 +347,9 @@ def execute_plan(engine, plan: N.PlanNode) -> Table:
     split analog) when the plan shape allows it."""
     from presto_tpu.exec.spill import try_execute_spilled
     from presto_tpu.exec.streaming import try_execute_streamed
+    mr = _find_match_recognize(plan)
+    if mr is not None:
+        return _execute_with_match_recognize(engine, plan, mr)
     # streaming first: a block-streamed scan already bounds its working
     # set, so the memory-budget check must not veto it
     streamed = try_execute_streamed(engine, plan)
@@ -416,6 +419,34 @@ def prepare_plan(engine, plan: N.PlanNode, scan_inputs: list[ScanInput]):
                 capacities[key] = (RETRY_GROWTH
                                    * meta["used_capacity"][key])
     raise RuntimeError("hash table capacity retry limit exceeded")
+
+
+def _find_match_recognize(plan: N.PlanNode):
+    if isinstance(plan, N.MatchRecognize):
+        return plan
+    for s in plan.sources():
+        found = _find_match_recognize(s)
+        if found is not None:
+            return found
+    return None
+
+
+def _execute_with_match_recognize(engine, plan: N.PlanNode,
+                                  mr) -> Table:
+    """Split execution around a MatchRecognize node: run its input
+    subplan on device, evaluate the pattern automaton host-side
+    (exec/match_recognize.py — vectorized predicates, host NFA), feed
+    the matches back through a carrier scan for the rest of the plan
+    (the same splice mechanism as the spill driver)."""
+    from presto_tpu.exec.match_recognize import evaluate
+    from presto_tpu.exec.spill import _carrier_scan
+    from presto_tpu.exec.streaming import _replace_node
+
+    input_table = execute_plan(engine, mr.source)
+    matched = evaluate(input_table, mr)
+    carrier_node, carrier_input = _carrier_scan("__matches__", matched)
+    rest = _replace_node(plan, mr, carrier_node)
+    return run_plan(engine, rest, [carrier_input])
 
 
 def run_plan(engine, plan: N.PlanNode,

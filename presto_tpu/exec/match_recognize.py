@@ -1,0 +1,296 @@
+"""MATCH_RECOGNIZE execution: vectorized predicates + NFA matching.
+
+TPU-native split of the reference's row-pattern machinery
+(operator/window/matcher/Matcher.java NFA VM + IrRowPatternToProgram):
+the per-row DEFINE predicates — the data-heavy part — evaluate
+VECTORIZED over the sorted partition arrays (including the shifted
+``$prev`` columns), producing one boolean array per pattern variable;
+only the pattern automaton itself runs as a host loop over candidate
+match positions (the reference's VM is row-at-a-time for this part
+too). ONE ROW PER MATCH + AFTER MATCH SKIP PAST LAST ROW.
+
+Thompson NFA with preference order: greedy quantifiers explore the
+consume branch first; the first accepting path in preference order is
+the SQL-required preferred match. A visited set per (state, position)
+bounds the search linearly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from presto_tpu import types as T
+from presto_tpu.block import Column, Table
+from presto_tpu.sql import ast as A
+
+
+# -- pattern -> NFA ----------------------------------------------------------
+
+
+@dataclasses.dataclass
+class _State:
+    kind: str  # var | split | accept
+    var: str | None = None
+    nxt: int = -1
+    alt: int = -1  # split: preferred branch is nxt, then alt
+
+
+def compile_pattern(pattern) -> list[_State]:
+    states: list[_State] = []
+
+    def add(st: _State) -> int:
+        states.append(st)
+        return len(states) - 1
+
+    def build(p, nxt: int) -> int:
+        """Returns the entry state for pattern ``p`` continuing to
+        ``nxt``."""
+        if isinstance(p, A.PatVar):
+            return add(_State("var", p.name.lower(), nxt))
+        if isinstance(p, A.PatConcat):
+            entry = nxt
+            for part in reversed(p.parts):
+                entry = build(part, entry)
+            return entry
+        if isinstance(p, A.PatAlt):
+            entry = build(p.options[-1], nxt)
+            for opt in reversed(p.options[:-1]):
+                o = build(opt, nxt)
+                entry = add(_State("split", None, o, entry))
+            return entry
+        if isinstance(p, A.PatQuant):
+            lo, hi = p.min, p.max
+            entry = nxt
+            if hi is None:
+                # loop: split(enter-body -> loop, exit) — greedy
+                # prefers the body
+                loop = add(_State("split", None, -1, nxt))
+                body = build(p.term, loop)
+                states[loop].nxt = body
+                entry = loop
+            else:
+                for _ in range(hi - lo):
+                    body = build(p.term, entry)
+                    entry = add(_State("split", None, body, entry))
+            for _ in range(lo):
+                entry = build(p.term, entry)
+            return entry
+        raise TypeError(f"unknown pattern node {type(p).__name__}")
+
+    accept = add(_State("accept"))
+    start = build(pattern, accept)
+    return states, start  # type: ignore[return-value]
+
+
+def match_at(states, start: int, var_match: dict[str, np.ndarray],
+             pos: int, end: int):
+    """Preferred match starting at ``pos``: returns (last_pos_exclusive,
+    classifier list of per-row variables) or None. Iterative DFS in
+    preference order with (state, pos) dedupe."""
+    stack = [(start, pos, ())]
+    seen: set[tuple[int, int]] = set()
+    while stack:
+        st, i, path = stack.pop()
+        if (st, i) in seen:
+            continue
+        seen.add((st, i))
+        s = states[st]
+        if s.kind == "accept":
+            if i > pos:  # empty matches produce no row (subset)
+                return i, list(path)
+            continue
+        if s.kind == "split":
+            # LIFO stack: push the less-preferred branch first
+            stack.append((s.alt, i, path))
+            stack.append((s.nxt, i, path))
+            continue
+        # var consume
+        if i < end and bool(var_match[s.var][i]):
+            stack.append((s.nxt, i + 1, path + (s.var,)))
+    return None
+
+
+# -- operator ----------------------------------------------------------------
+
+
+def evaluate(table: Table, node) -> Table:
+    """Host-side MATCH_RECOGNIZE over a materialized input table.
+    Returns the ONE-ROW-PER-MATCH output table."""
+    import jax.numpy as jnp
+
+    from presto_tpu.expr.compile import ExprCompiler, Val
+
+    n = table.nrows
+    live = (np.ones(n, bool) if table.mask is None
+            else np.asarray(table.mask))
+    idx = np.nonzero(live)[0]
+
+    # sort by (partition, order) — numpy lexsort, least-significant last
+    keys: list[np.ndarray] = []
+    for o in reversed(node.orderings):
+        col = table.columns[o.symbol]
+        data = np.asarray(col.data)[idx]
+        keys.append(-data if not o.ascending else data)
+    for s in reversed(node.partition_by):
+        keys.append(np.asarray(table.columns[s].data)[idx])
+    order = (np.lexsort(keys) if keys
+             else np.arange(len(idx)))
+    ridx = idx[order]
+    m = len(ridx)
+
+    # partition boundaries in sorted order
+    new_part = np.zeros(m, bool)
+    if m:
+        new_part[0] = True
+    for s in node.partition_by:
+        d = np.asarray(table.columns[s].data)[ridx]
+        new_part[1:] |= d[1:] != d[:-1]
+        v = table.columns[s].valid
+        if v is not None:
+            vv = np.asarray(v)[ridx]
+            new_part[1:] |= vv[1:] != vv[:-1]
+    part_start_idx = np.nonzero(new_part)[0]
+
+    # vectorized DEFINE predicates over sorted arrays + $prev shifts
+    cols: dict[str, Val] = {}
+    for sym, col in table.columns.items():
+        data = np.asarray(col.data)[ridx]
+        valid = (None if col.valid is None
+                 else np.asarray(col.valid)[ridx])
+        cols[sym] = Val(col.dtype, jnp.asarray(data),
+                        None if valid is None else jnp.asarray(valid),
+                        col.dictionary)
+    referenced = set()
+    for cond in node.defines.values():
+        from presto_tpu.expr import ir as IR
+        referenced |= IR.referenced_columns([cond])
+    for ref in referenced:
+        if "$prev" in ref:
+            base, cnt = ref.rsplit("$prev", 1)
+            k = int(cnt)
+            src = cols[base]
+            shifted = np.roll(np.asarray(src.data), k, axis=0)
+            valid = (np.ones(m, bool) if src.valid is None
+                     else np.asarray(src.valid))
+            vshift = np.roll(valid, k)
+            # rows whose PREV crosses a partition boundary are NULL
+            pos_in_part = np.arange(m) - np.maximum.accumulate(
+                np.where(new_part, np.arange(m), 0))
+            vshift &= pos_in_part >= k
+            cols[ref] = Val(src.dtype, jnp.asarray(shifted),
+                            jnp.asarray(vshift), src.dictionary)
+
+    c = ExprCompiler(cols)
+    var_match: dict[str, np.ndarray] = {}
+    pattern_vars = _pattern_vars(node.pattern)
+    for var in pattern_vars:
+        cond = node.defines.get(var)
+        if cond is None:
+            var_match[var] = np.ones(m, bool)  # undefined: always true
+        else:
+            v = c.compile(cond)
+            data = np.asarray(v.data, dtype=bool)
+            if v.valid is not None:
+                data = data & np.asarray(v.valid)
+            var_match[var] = data
+
+    states, start_state = compile_pattern(node.pattern)
+
+    # measure inputs evaluated once, vectorized
+    measure_vals = {}
+    for sym, kind, expr, _dtype in node.measures:
+        if expr is not None:
+            measure_vals[sym] = c.compile(expr)
+
+    out_rows: dict[str, list] = {s: [] for s in node.partition_by}
+    out_meas: dict[str, list] = {sym: [] for sym, *_ in node.measures}
+    out_valid: dict[str, list] = {sym: [] for sym, *_ in node.measures}
+    match_no = 0
+    bounds = list(part_start_idx) + [m]
+    for b in range(len(bounds) - 1):
+        lo, hi = bounds[b], bounds[b + 1]
+        i = lo
+        match_in_part = 0
+        while i < hi:
+            found = match_at(states, start_state, var_match, i, hi)
+            if found is None:
+                i += 1
+                continue
+            end, classifiers = found
+            match_no += 1
+            match_in_part += 1
+            first_row, last_row = i, end - 1
+            for s in node.partition_by:
+                out_rows[s].append(int(ridx[first_row]))
+            for sym, kind, _expr, _dtype in node.measures:
+                if kind == "match_number":
+                    out_meas[sym].append(match_in_part)
+                    out_valid[sym].append(True)
+                elif kind == "classifier":
+                    out_meas[sym].append(classifiers[-1].upper())
+                    out_valid[sym].append(True)
+                else:
+                    row = first_row if kind == "first" else last_row
+                    v = measure_vals[sym]
+                    out_meas[sym].append(np.asarray(v.data)[row])
+                    ok = (True if v.valid is None
+                          else bool(np.asarray(v.valid)[row]))
+                    out_valid[sym].append(ok)
+            i = end  # AFTER MATCH SKIP PAST LAST ROW
+
+    nout = match_no
+    out_cols: dict[str, Column] = {}
+    for s in node.partition_by:
+        src = table.columns[s]
+        rows = np.asarray(out_rows[s], dtype=np.int64)
+        data = (np.asarray(src.data)[rows] if nout
+                else np.empty(0, np.asarray(src.data).dtype))
+        valid = None
+        if src.valid is not None:
+            valid = (np.asarray(src.valid)[rows] if nout
+                     else np.empty(0, bool))
+        out_cols[s] = Column(src.dtype, data, valid, src.dictionary)
+    for sym, kind, expr, dtype in node.measures:
+        valid = np.asarray(out_valid[sym], bool)
+        if kind == "classifier":
+            from presto_tpu.block import dictionary_encode
+            codes, d = dictionary_encode(
+                np.asarray(out_meas[sym], object))
+            out_cols[sym] = Column(dtype, codes,
+                                   None if valid.all() else valid, d)
+        else:
+            if expr is not None and expr.dtype and isinstance(
+                    dtype, T.VarcharType):
+                v = measure_vals[sym]
+                out_cols[sym] = Column(
+                    dtype, np.asarray(out_meas[sym]),
+                    None if valid.all() else valid, v.dictionary)
+            else:
+                phys = dtype.physical_dtype
+                out_cols[sym] = Column(
+                    dtype, np.asarray(out_meas[sym], phys) if nout
+                    else np.empty(0, phys),
+                    None if valid.all() else valid, None)
+    return Table(out_cols, nout, None)
+
+
+def _pattern_vars(p) -> list[str]:
+    out: list[str] = []
+
+    def walk(q):
+        if isinstance(q, A.PatVar):
+            if q.name.lower() not in out:
+                out.append(q.name.lower())
+        elif isinstance(q, A.PatConcat):
+            for x in q.parts:
+                walk(x)
+        elif isinstance(q, A.PatAlt):
+            for x in q.options:
+                walk(x)
+        elif isinstance(q, A.PatQuant):
+            walk(q.term)
+
+    walk(p)
+    return out

@@ -408,6 +408,39 @@ class Window(PlanNode):
         return out
 
 
+@dataclasses.dataclass
+class MatchRecognize(PlanNode):
+    """Row pattern recognition, ONE ROW PER MATCH + SKIP PAST LAST ROW
+    (reference plan/PatternRecognitionNode.java + the NFA program of
+    operator/window/matcher/*). ``pattern`` is the parsed pattern AST
+    (sql/ast.py PatVar/PatConcat/PatAlt/PatQuant); ``defines`` maps
+    variable -> boolean IR over the input symbols, where PREV(col, n)
+    references appear as ColumnRef "{sym}$prev{n}"; ``measures`` is
+    [(out symbol, kind, IR expr|None, dtype)] with kind in
+    {first, last, match_number, classifier}."""
+
+    source: PlanNode = None  # type: ignore[assignment]
+    partition_by: list[str] = dataclasses.field(default_factory=list)
+    orderings: list[Ordering] = dataclasses.field(default_factory=list)
+    pattern: object = None
+    defines: dict[str, ir.Expr] = dataclasses.field(default_factory=dict)
+    measures: list[tuple] = dataclasses.field(default_factory=list)
+
+    def sources(self):
+        return [self.source]
+
+    @property
+    def output_symbols(self):
+        return self.partition_by + [m[0] for m in self.measures]
+
+    def output_types(self):
+        src = self.source.output_types()
+        out = {s: src[s] for s in self.partition_by}
+        for sym, _kind, _expr, dtype in self.measures:
+            out[sym] = dtype
+        return out
+
+
 class ExchangeType(enum.Enum):
     GATHER = "gather"  # all shards -> one
     REPARTITION = "repartition"  # hash all_to_all

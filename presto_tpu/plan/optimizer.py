@@ -160,6 +160,18 @@ def prune_columns(node: N.PlanNode,
                             needed | set(node.partition_keys))
         return dataclasses.replace(node, source=src)
 
+    if isinstance(node, N.MatchRecognize):
+        sub = set(node.partition_by)
+        sub |= {o.symbol for o in node.orderings}
+        exprs = list(node.defines.values()) + [
+            e for _s, _k, e, _t in node.measures if e is not None]
+        # $prev columns are synthesized at execution from their base
+        for ref in _expr_refs(*exprs):
+            sub.add(ref.rsplit("$prev", 1)[0] if "$prev" in ref
+                    else ref)
+        src = prune_columns(node.source, sub)
+        return dataclasses.replace(node, source=src)
+
     raise NotImplementedError(f"prune_columns: {type(node).__name__}")
 
 

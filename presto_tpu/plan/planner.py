@@ -801,7 +801,119 @@ class LogicalPlanner:
             return self._plan_inner_join_tree(rel, ctes, outer)
         if isinstance(rel, A.ValuesRelation):
             return self.plan_values(rel)
+        if isinstance(rel, A.MatchRecognizeRelation):
+            return self.plan_match_recognize(rel, ctes, outer)
         raise SemanticError(f"unsupported relation {type(rel).__name__}")
+
+    def plan_match_recognize(self, rel: A.MatchRecognizeRelation,
+                             ctes: dict, outer: Scope | None
+                             ) -> RelationPlan:
+        """MATCH_RECOGNIZE (reference sql/analyzer/
+        PatternRecognitionAnalyzer + plan/PatternRecognitionNode).
+        Supported subset: ONE ROW PER MATCH, AFTER MATCH SKIP PAST LAST
+        ROW, DEFINE over current-row columns and PREV(col [, n]),
+        measures FIRST(x)/LAST(x)/plain (=LAST)/MATCH_NUMBER()/
+        CLASSIFIER()."""
+        inner = self.plan_relation(rel.input, ctes, outer)
+        ctx = ExprCtx(inner.scope, self)
+
+        def plain_sym(e: A.Expression, what: str) -> str:
+            planned = ExprPlanner(ctx).plan(e)
+            if not isinstance(planned, ir.ColumnRef):
+                raise SemanticError(
+                    f"MATCH_RECOGNIZE {what} must be a column")
+            return planned.name
+
+        part_syms = [plain_sym(e, "PARTITION BY")
+                     for e in rel.partition_by]
+        orderings = []
+        for item in rel.order_by:
+            orderings.append(N.Ordering(
+                plain_sym(item.expression, "ORDER BY"),
+                item.ascending, item.nulls_first))
+
+        types = inner.node.output_types()
+
+        def rewrite_prev(e: A.Expression) -> A.Expression:
+            """PREV(col [, n]) -> column reference {sym}$prev{n}."""
+            if isinstance(e, A.FunctionCall) and e.name == "prev":
+                col = e.args[0]
+                n = 1
+                if len(e.args) > 1:
+                    if not isinstance(e.args[1], A.NumericLiteral):
+                        raise SemanticError("PREV offset must be a "
+                                            "literal")
+                    n = int(e.args[1].text)
+                sym = plain_sym(col, "PREV argument")
+                return A.Identifier(f"{sym}$prev{n}")
+            if dataclasses.is_dataclass(e):
+                changed = {}
+                for f in dataclasses.fields(e):
+                    v = getattr(e, f.name)
+                    if isinstance(v, A.Expression):
+                        changed[f.name] = rewrite_prev(v)
+                    elif isinstance(v, tuple) and any(
+                            isinstance(x, A.Expression) for x in v):
+                        changed[f.name] = tuple(
+                            rewrite_prev(x)
+                            if isinstance(x, A.Expression) else x
+                            for x in v)
+                if changed:
+                    return dataclasses.replace(e, **changed)
+            return e
+
+        # prev-columns extend the scope with the base column's type
+        prev_fields = list(inner.scope.fields)
+        import re as _re
+        defines: dict[str, ir.Expr] = {}
+        for var, cond in rel.defines:
+            rewritten = rewrite_prev(cond)
+            for m in _re.finditer(r"([A-Za-z_0-9]+)\$prev(\d+)",
+                                  repr(rewritten)):
+                base, _n = m.group(1), m.group(2)
+                full = m.group(0)
+                if base in types and not any(
+                        f.symbol == full for f in prev_fields):
+                    prev_fields.append(
+                        Field(full, None, full, types[base]))
+            dctx = ExprCtx(Scope(prev_fields), self)
+            planned = ExprPlanner(dctx).plan(rewritten)
+            defines[var.lower()] = planned
+
+        measures: list[tuple] = []
+        out_fields = [Field(f.name, f.qualifier, f.symbol, f.dtype)
+                      for f in inner.scope.fields
+                      if f.symbol in part_syms]
+        for m in rel.measures:
+            e = m.expression
+            kind = "last"
+            arg: A.Expression | None = e
+            if isinstance(e, A.FunctionCall):
+                if e.name in ("first", "last"):
+                    kind = e.name
+                    arg = e.args[0]
+                elif e.name == "match_number":
+                    kind, arg = "match_number", None
+                elif e.name == "classifier":
+                    kind, arg = "classifier", None
+            if arg is not None:
+                planned = ExprPlanner(ctx).plan(arg)
+                dtype = planned.dtype
+            else:
+                planned = None
+                dtype = (T.BIGINT if kind == "match_number"
+                         else T.VARCHAR)
+            sym = self.symbols.fresh(m.name)
+            measures.append((sym, kind, planned, dtype))
+            out_fields.append(Field(m.name, None, sym, dtype))
+
+        node = N.MatchRecognize(inner.node, part_syms, orderings,
+                                rel.pattern, defines, measures)
+        ndv = 1
+        for s in part_syms:
+            ndv *= max(self.ndv.get(s, 32), 1)
+        est = max(min(inner.est, ndv * 8), 1)
+        return RelationPlan(node, Scope(out_fields), est, [])
 
     def plan_table_ref(self, rel: A.TableRef, ctes: dict,
                        outer: Scope | None) -> RelationPlan:

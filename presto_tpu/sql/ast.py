@@ -237,6 +237,48 @@ class ValuesRelation(Relation):
     rows: tuple[tuple[Expression, ...], ...]
 
 
+# ---- MATCH_RECOGNIZE (row pattern recognition, SQL:2016) ------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class PatVar:
+    name: str
+
+
+@dataclasses.dataclass(frozen=True)
+class PatConcat:
+    parts: tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class PatAlt:
+    options: tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class PatQuant:
+    term: object
+    min: int
+    max: int | None  # None = unbounded
+    greedy: bool = True
+
+
+@dataclasses.dataclass(frozen=True)
+class Measure:
+    expression: Expression
+    name: str
+
+
+@dataclasses.dataclass(frozen=True)
+class MatchRecognizeRelation(Relation):
+    input: Relation
+    partition_by: tuple[Expression, ...]
+    order_by: tuple["SortItem", ...]
+    measures: tuple[Measure, ...]
+    pattern: object
+    defines: tuple[tuple[str, Expression], ...]
+
+
 # ---- query structure ------------------------------------------------------
 
 

@@ -25,7 +25,7 @@ class Token:
 _OPERATORS = [
     "<>", "!=", ">=", "<=", "||", "=>",
     "(", ")", ",", ".", ";", "+", "-", "*", "/", "%", "<", ">", "=", "?",
-    "[", "]",
+    "[", "]", "|", "{", "}",
 ]
 
 

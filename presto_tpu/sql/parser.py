@@ -19,7 +19,7 @@ _RESERVED_STOP = {
     "full", "cross", "when", "then", "else", "end", "and", "or", "not",
     "as", "by", "asc", "desc", "nulls", "first", "last", "with", "select",
     "distinct", "all", "between", "in", "like", "is", "exists", "case",
-    "escape", "fetch",
+    "escape", "fetch", "match_recognize",
 }
 
 
@@ -508,7 +508,116 @@ class Parser:
                 ordinality = True
             return self._maybe_alias(A.Unnest(tuple(exprs), ordinality))
         name = self.qualified_name()
-        return self._maybe_alias(A.TableRef(name))
+        rel = A.TableRef(name)
+        if self.at_keyword("match_recognize"):
+            rel = self._match_recognize(rel)
+        return self._maybe_alias(rel)
+
+    def _match_recognize(self, rel: A.Relation) -> A.Relation:
+        """MATCH_RECOGNIZE clause (SqlBase.g4 patternRecognition;
+        supported subset: PARTITION BY / ORDER BY / MEASURES /
+        ONE ROW PER MATCH / AFTER MATCH SKIP PAST LAST ROW /
+        PATTERN with concat, |, *, +, ?, {n[,m]} / DEFINE)."""
+        self.expect_keyword("match_recognize")
+        self.expect_op("(")
+        partition_by: tuple = ()
+        order_by: tuple = ()
+        measures: list[A.Measure] = []
+        if self.accept_keyword("partition"):
+            self.expect_keyword("by")
+            parts = [self.expression()]
+            while self.accept_op(","):
+                parts.append(self.expression())
+            partition_by = tuple(parts)
+        if self.accept_keyword("order"):
+            self.expect_keyword("by")
+            order_by = self._sort_items()
+        if self.accept_keyword("measures"):
+            while True:
+                e = self.expression()
+                self.expect_keyword("as")
+                measures.append(A.Measure(e, self.identifier()))
+                if not self.accept_op(","):
+                    break
+        if self.accept_keyword("one"):
+            self.expect_keyword("row")
+            self.expect_keyword("per")
+            self.expect_keyword("match")
+        if self.accept_keyword("after"):
+            self.expect_keyword("match")
+            self.expect_keyword("skip")
+            self.expect_keyword("past")
+            self.expect_keyword("last")
+            self.expect_keyword("row")
+        self.expect_keyword("pattern")
+        self.expect_op("(")
+        pattern = self._pattern_alt()
+        self.expect_op(")")
+        defines: list[tuple[str, A.Expression]] = []
+        if self.accept_keyword("define"):
+            while True:
+                var = self.identifier()
+                self.expect_keyword("as")
+                defines.append((var, self.expression()))
+                if not self.accept_op(","):
+                    break
+        self.expect_op(")")
+        return A.MatchRecognizeRelation(
+            rel, partition_by, order_by, tuple(measures), pattern,
+            tuple(defines))
+
+    def _pattern_alt(self):
+        opts = [self._pattern_concat()]
+        while self.accept_op("|"):
+            opts.append(self._pattern_concat())
+        if len(opts) == 1:
+            return opts[0]
+        return A.PatAlt(tuple(opts))
+
+    def _pattern_concat(self):
+        parts = []
+        while True:
+            t = self.peek()
+            if t.kind == "op" and t.value in (")", "|"):
+                break
+            parts.append(self._pattern_quant())
+        if len(parts) == 1:
+            return parts[0]
+        return A.PatConcat(tuple(parts))
+
+    def _pattern_quant(self):
+        if self.accept_op("("):
+            term: object = self._pattern_alt()
+            self.expect_op(")")
+        else:
+            term = A.PatVar(self.identifier())
+        while True:
+            t = self.peek()
+            if t.kind != "op":
+                return term
+            if t.value == "*":
+                self.advance()
+                term = A.PatQuant(term, 0, None)
+            elif t.value == "+":
+                self.advance()
+                term = A.PatQuant(term, 1, None)
+            elif t.value == "?":
+                self.advance()
+                term = A.PatQuant(term, 0, 1)
+            elif t.value == "{":
+                self.advance()
+                lo = int(self.peek().value)
+                self.advance()
+                hi: int | None = lo
+                if self.accept_op(","):
+                    hi = None
+                    if self.peek().kind == "number":
+                        hi = int(self.peek().value)
+                        self.advance()
+                self.expect_op("}")
+                term = A.PatQuant(term, lo, hi)
+            else:
+                return term
 
     def _maybe_alias(self, rel: A.Relation) -> A.Relation:
         alias = None

@@ -1,0 +1,87 @@
+"""MATCH_RECOGNIZE (reference sql/analyzer/PatternRecognitionAnalyzer,
+operator/window/matcher NFA VM). Expected results are hand-computed —
+the sqlite oracle has no row-pattern support."""
+
+import pytest
+
+from presto_tpu import BIGINT, Engine
+from presto_tpu.connectors.memory import MemoryConnector
+import numpy as np
+
+
+@pytest.fixture()
+def eng():
+    e = Engine()
+    conn = MemoryConnector()
+    # stock price series: two tickers with V shapes
+    #   A: 10 9 8 9 10 11  (down x2 then up x3)
+    #   B: 5 6 5 4 6       (down-up twice-ish)
+    conn.create_table(
+        "ticks",
+        {"sym_id": BIGINT, "ts": BIGINT, "price": BIGINT},
+        {"sym_id": np.array([1] * 6 + [2] * 5),
+         "ts": np.array([1, 2, 3, 4, 5, 6, 1, 2, 3, 4, 5]),
+         "price": np.array([10, 9, 8, 9, 10, 11, 5, 6, 5, 4, 6])},
+        {"sym_id": None, "ts": None, "price": None})
+    e.register_catalog("mem", conn)
+    e.session.catalog = "mem"
+    return e
+
+
+def test_v_shape_matches(eng):
+    rows = eng.execute("""
+        select * from ticks match_recognize (
+          partition by sym_id order by ts
+          measures first(ts) as start_ts, last(ts) as end_ts,
+                   last(price) as end_price,
+                   match_number() as mno
+          one row per match
+          after match skip past last row
+          pattern (strt down+ up+)
+          define down as price < prev(price),
+                 up as price > prev(price)
+        ) order by sym_id, start_ts""")
+    # sym 1: strt@ts1 down ts2,ts3 up ts4,ts5,ts6 -> one match (1..6)
+    # sym 2: strt@ts1(5) 6? no down from 5->6... strt@1,down needs
+    #   price<prev: ts3(5<6) yes with strt@ts2; up ts5... trace:
+    #   prices 5 6 5 4 6: match at ts2: strt=6, down 5,4, up 6 -> (2..5)
+    assert rows == [(1, 1, 6, 11, 1), (2, 2, 5, 6, 1)]
+
+
+def test_classifier_and_alternation(eng):
+    rows = eng.execute("""
+        select * from ticks match_recognize (
+          partition by sym_id order by ts
+          measures last(ts) as t, classifier() as cls
+          pattern (lo | hi)
+          define lo as price <= 5, hi as price >= 10
+        ) order by sym_id, t""")
+    # greedy preference: lo tried first; each match is one row
+    # sym1 prices 10 9 8 9 10 11: hi at ts1, ts5, ts6
+    # sym2 prices 5 6 5 4 6: lo at ts1, ts3, ts4
+    assert rows == [(1, 1, "HI"), (1, 5, "HI"), (1, 6, "HI"),
+                    (2, 1, "LO"), (2, 3, "LO"), (2, 4, "LO")]
+
+
+def test_bounded_quantifier(eng):
+    rows = eng.execute("""
+        select * from ticks match_recognize (
+          partition by sym_id order by ts
+          measures first(ts) as t0, last(ts) as t1
+          pattern (down{2})
+          define down as price < prev(price)
+        ) order by sym_id, t0""")
+    # sym1: down rows ts2,ts3 (9,8) -> match (2,3); sym2: ts3,ts4 (5,4)
+    assert rows == [(1, 2, 3), (2, 3, 4)]
+
+
+def test_match_recognize_feeds_downstream(eng):
+    rows = eng.execute("""
+        select count(*) from ticks match_recognize (
+          partition by sym_id order by ts
+          measures last(price) as p
+          pattern (down)
+          define down as price < prev(price)
+        )""")
+    # down rows: sym1 ts2,ts3; sym2 ts3,ts4 -> 4 single-row matches
+    assert rows == [(4,)]
