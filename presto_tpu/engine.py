@@ -74,7 +74,9 @@ class Engine:
 
         from presto_tpu.events import monitored
 
-        stmt = parse_statement(sql)
+        from presto_tpu.sql.rewrite import rewrite_statement
+
+        stmt = rewrite_statement(parse_statement(sql), self)
         with self._cancel_scope(cancel_token):
             if isinstance(stmt, A.QueryStatement):
                 return monitored(
@@ -90,7 +92,9 @@ class Engine:
         from presto_tpu.sql import ast as A
         from presto_tpu.sql.parser import parse_statement
 
-        stmt = parse_statement(sql)
+        from presto_tpu.sql.rewrite import rewrite_statement
+
+        stmt = rewrite_statement(parse_statement(sql), self)
         if not isinstance(stmt, A.QueryStatement):
             raise ValueError("execute_table expects a SELECT query")
         with self._cancel_scope(cancel_token):
@@ -199,17 +203,6 @@ class Engine:
 
         if isinstance(stmt, A.ShowCatalogs):
             return [(name,) for name in sorted(self.catalogs)]
-
-        if isinstance(stmt, A.ShowTables):
-            catalog = stmt.catalog or self.session.catalog
-            conn = self._connector(catalog)
-            return [(t,) for t in sorted(conn.table_names())]
-
-        if isinstance(stmt, A.ShowColumns):
-            catalog, table = self._resolve_table(stmt.table)
-            conn = self._connector(catalog)
-            schema = conn.table_schema(table)
-            return [(c, str(t)) for c, t in schema.items()]
 
         if isinstance(stmt, A.ShowSession):
             rows = []

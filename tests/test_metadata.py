@@ -78,3 +78,20 @@ def test_session_properties_table_reflects_set_session(eng):
         "select value from system.runtime.session_properties "
         "where name = 'distributed_sort'")
     assert rows == [("False",)]
+
+
+def test_show_rewrites_to_information_schema(eng):
+    """SHOW TABLES/COLUMNS desugar into plans over information_schema
+    (reference sql/rewrite/ShowQueriesRewrite.java), not ad hoc code."""
+    from presto_tpu.sql.parser import parse_statement
+    from presto_tpu.sql.rewrite import rewrite_statement
+    from presto_tpu.sql import ast as A
+
+    stmt = rewrite_statement(parse_statement("show tables"), eng)
+    assert isinstance(stmt, A.QueryStatement)
+    plan, _ = eng.plan_sql(
+        "select table_name from information_schema.tables")
+    assert plan is not None
+    # the rewritten statement executes through the normal query path
+    rows = eng.execute("show tables")
+    assert ("region",) in rows
