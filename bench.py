@@ -52,21 +52,16 @@ def steady_state_sql(engine, sql: str, reps: int) -> float:
     """Compile a SQL query once (via the engine's program cache, with
     capacity retries) and return the best steady-state wall seconds over
     ``reps`` device-resident runs."""
-    import jax
-
-    from presto_tpu.exec.executor import collect_scans, prepare_plan
+    from presto_tpu.exec.executor import run_plan_live
 
     plan, _ = engine.plan_sql(sql)
-    scan_inputs = collect_scans(plan, engine)
-    compiled, flat_arrays, _meta, _out = prepare_plan(
-        engine, plan, scan_inputs)
-    device_args = [jax.device_put(a) for a in flat_arrays]
+    np.asarray(run_plan_live(engine, plan))  # compile + warm all segs
     times = []
     for _ in range(reps):
         t0 = time.perf_counter()
         # host materialization = real device sync (block_until_ready
         # does not reliably block on tunneled accelerator platforms)
-        np.asarray(compiled(*device_args)[1])
+        np.asarray(run_plan_live(engine, plan))
         times.append(time.perf_counter() - t0)
     return min(times)
 
@@ -134,24 +129,31 @@ def main() -> None:
     # visible. Each runs in a SUBPROCESS: a device OOM / TPU worker crash
     # in a detail query must not take down the headline measurement.
     detail = {}
-    budget = float(os.environ.get("PRESTO_TPU_BENCH_BUDGET_S", "240"))
+    budget = float(os.environ.get("PRESTO_TPU_BENCH_BUDGET_S", "330"))
     t_detail = time.perf_counter()
     if os.environ.get("PRESTO_TPU_BENCH_Q1_ONLY") != "1":
         import subprocess
+        # q05's six-table join exceeds single-chip HBM at SF1 (its
+        # multi-chip home is the v5e-8 config, BASELINE.md ladder 4);
+        # bench it at a bounded SF and record the SF used
+        sf_cap = {"q05": 0.25}
         for name in ("q06", "q03", "q05"):
             left = budget - (time.perf_counter() - t_detail)
             if left <= 0:
                 detail[f"{name}_skipped"] = "bench time budget exhausted"
                 continue
+            q_sf = min(sf, sf_cap.get(name, sf))
             try:
                 proc = subprocess.run(
                     [sys.executable, os.path.abspath(__file__)],
                     env={**os.environ, "PRESTO_TPU_BENCH_ONE": name,
-                         "PRESTO_TPU_BENCH_SF": str(sf)},
+                         "PRESTO_TPU_BENCH_SF": str(q_sf)},
                     capture_output=True, text=True, timeout=left,
                     cwd=os.path.dirname(os.path.abspath(__file__)))
                 out = proc.stdout.strip().splitlines()
                 detail[f"{name}_rows_per_sec"] = round(float(out[-1]))
+                if q_sf != sf:
+                    detail[f"{name}_sf"] = q_sf
             except Exception as exc:  # never let detail kill the headline
                 detail[f"{name}_error"] = f"{type(exc).__name__}: {exc}"[:200]
 

@@ -839,5 +839,42 @@ class TpcdsConnector(Connector):
     def unique_keys(self, name: str) -> list[tuple[str, ...]]:
         return list(_UNIQUE.get(name, []))
 
+    # TPC-DS surrogate keys are named for the dimension they reference;
+    # the ndv of an FK column is (at most) that dimension's row count —
+    # the analog of the reference tpcds connector's shipped column
+    # statistics (plugin/trino-tpcds TpcdsMetadata statistics). Longest
+    # suffix wins (cs_bill_cdemo_sk -> customer_demographics before
+    # _demo_sk could mis-route).
+    _SK_SUFFIX = (
+        ("_call_center_sk", "call_center"),
+        ("_catalog_page_sk", "catalog_page"),
+        ("_web_page_sk", "web_page"),
+        ("_web_site_sk", "web_site"),
+        ("_ship_mode_sk", "ship_mode"),
+        ("_income_band_sk", "income_band"),
+        ("_warehouse_sk", "warehouse"),
+        ("_customer_sk", "customer"),
+        ("_cdemo_sk", "customer_demographics"),
+        ("_hdemo_sk", "household_demographics"),
+        ("_demo_sk", "customer_demographics"),
+        ("_addr_sk", "customer_address"),
+        ("_date_sk", "date_dim"),
+        ("_time_sk", "time_dim"),
+        ("_item_sk", "item"),
+        ("_store_sk", "store"),
+        ("_promo_sk", "promotion"),
+        ("_reason_sk", "reason"),
+    )
+
+    def ndv_estimates(self, name: str) -> dict[str, int]:
+        rows = self.gen.rows(name)
+        out: dict[str, int] = {}
+        for col in self.table_schema(name):
+            for suffix, ref in self._SK_SUFFIX:
+                if col.endswith(suffix):
+                    out[col] = min(self.gen.rows(ref), rows)
+                    break
+        return out
+
     def stats(self, name: str) -> TableStats:
         return TableStats(row_count=self.gen.rows(name))

@@ -33,6 +33,14 @@ class Engine:
         # gen/PageFunctionCompiler.java:101 compiled-artifact caches)
         self._program_cache: dict = {}
         self._caps_memory: dict = {}
+        # host->device transfer cache: id(np array) -> (host ref, dev
+        # array). The strong host ref pins the id; repeat executions of
+        # a query (and bench steady state) reuse HBM-resident inputs
+        # instead of re-uploading every run (the reference keeps pages
+        # pooled in worker memory the same way)
+        self._dev_cache: dict = {}
+        self._dev_cache_bytes = 0
+        self.dev_cache_limit = 8 << 30  # HBM budget for pinned inputs
         # runtime memory ledger: per-program tagged reservations of
         # actual input+output array bytes (memory/MemoryPool.java:44);
         # capacity 0 = unbounded (set memory_pool.capacity to enforce)
@@ -59,6 +67,27 @@ class Engine:
 
     def register_catalog(self, name: str, connector: Connector) -> None:
         self.catalogs[name] = connector
+
+    def device_array(self, a):
+        """Device copy of a host scan array, cached so repeat
+        executions reuse HBM-resident inputs instead of re-uploading
+        (the reference keeps pages pooled in worker memory). The
+        strong host ref pins the id key; FIFO eviction bounds HBM."""
+        import jax
+        if not isinstance(a, np.ndarray):
+            return a  # already a device array (segment carriers)
+        hit = self._dev_cache.get(id(a))
+        if hit is not None and hit[0] is a:
+            return hit[1]
+        dev = jax.device_put(a)
+        self._dev_cache[id(a)] = (a, dev)
+        self._dev_cache_bytes += a.nbytes
+        while (self._dev_cache_bytes > self.dev_cache_limit
+               and len(self._dev_cache) > 1):
+            k = next(iter(self._dev_cache))
+            old, _old_dev = self._dev_cache.pop(k)
+            self._dev_cache_bytes -= old.nbytes
+        return dev
 
     # -- SQL entry points ---------------------------------------------------
 
@@ -170,6 +199,24 @@ class Engine:
         return execute_plan(self, plan)
 
     def _execute_statement(self, stmt, mesh=None) -> list[tuple]:
+        from presto_tpu.sql import ast as A
+        try:
+            return self._execute_statement_inner(stmt, mesh)
+        finally:
+            # DML may mutate connector arrays IN PLACE (same object
+            # identity), so pinned device copies must not survive it;
+            # commit/rollback restore snapshots the same way
+            if isinstance(stmt, (A.CreateTableAs, A.InsertStatement,
+                                 A.DeleteStatement, A.UpdateStatement,
+                                 A.DropTable, A.CommitStatement,
+                                 A.RollbackStatement)):
+                self.invalidate_device_cache()
+
+    def invalidate_device_cache(self) -> None:
+        self._dev_cache.clear()
+        self._dev_cache_bytes = 0
+
+    def _execute_statement_inner(self, stmt, mesh=None) -> list[tuple]:
         from presto_tpu.plan.printer import format_plan
         from presto_tpu.sql import ast as A
 

@@ -37,6 +37,11 @@ class ScanInput:
     dictionaries: dict[str, np.ndarray | None]
     types: dict[str, T.DataType]
     nrows: int
+    # True only for connector-owned table arrays (stable identity across
+    # executions): those pin device copies via Engine.device_array.
+    # Per-execution temporaries (spill partitions, match-recognize
+    # carriers) would pollute the pin cache with 0%-hit entries.
+    cache_device: bool = False
 
 
 def collect_scans(plan: N.PlanNode, engine) -> list[ScanInput]:
@@ -59,7 +64,8 @@ def collect_scans(plan: N.PlanNode, engine) -> list[ScanInput]:
                 # table-level row mask (padded exchange buffers ship a
                 # dead row so empty relations keep static shape >= 1)
                 arrays["__live__"] = np.asarray(tbl.mask)
-            out.append(ScanInput(node, arrays, dicts, types, tbl.nrows))
+            out.append(ScanInput(node, arrays, dicts, types, tbl.nrows,
+                                 cache_device=True))
         for s in node.sources():
             visit(s)
 
@@ -355,9 +361,13 @@ def execute_plan(engine, plan: N.PlanNode) -> Table:
     streamed = try_execute_streamed(engine, plan)
     if streamed is not None:
         return streamed
+    # the memory budget (host-partitioned spill) outranks compile-time
+    # segmentation: an over-budget join must not device-OOM mid-segment
     spilled = try_execute_spilled(engine, plan)
     if spilled is not None:
         return spilled
+    if _count_joins(plan) > MAX_JOINS_PER_PROGRAM:
+        return _execute_segmented(engine, plan)
     scan_inputs = collect_scans(plan, engine)
     return run_plan(engine, plan, scan_inputs)
 
@@ -398,10 +408,12 @@ def prepare_plan(engine, plan: N.PlanNode, scan_inputs: list[ScanInput]):
         checkpoint()
         caps_key = tuple(sorted(capacities.items()))
         entry = engine._program_cache.get((base_key, caps_key))
-        flat_arrays = [scan.arrays[sym]
-                       for scan in scan_inputs for sym in scan.arrays]
+        flat_arrays = [
+            engine.device_array(scan.arrays[sym])
+            if getattr(scan, "cache_device", False) else scan.arrays[sym]
+            for scan in scan_inputs for sym in scan.arrays]
         if entry is None:
-            traced_fn, flat_arrays, meta = make_traced(
+            traced_fn, _host_arrays, meta = make_traced(
                 scan_inputs, plan, capacities, engine.session)
             compiled = jax.jit(traced_fn)
             out = compiled(*flat_arrays)
@@ -419,6 +431,157 @@ def prepare_plan(engine, plan: N.PlanNode, scan_inputs: list[ScanInput]):
                 capacities[key] = (RETRY_GROWTH
                                    * meta["used_capacity"][key])
     raise RuntimeError("hash table capacity retry limit exceeded")
+
+
+# XLA compile time grows superlinearly with program size (a 5-join
+# TPC-H Q5 program compiles >10x slower than twice a 2-join Q3); plans
+# with more joins than this split into separately compiled segments
+# with DEVICE-RESIDENT handoff (no host round trip).
+MAX_JOINS_PER_PROGRAM = 2
+
+
+def _count_joins(node: N.PlanNode) -> int:
+    own = isinstance(node, (N.Join, N.SemiJoin))
+    return int(own) + sum(_count_joins(s) for s in node.sources())
+
+
+def _find_split(node: N.PlanNode):
+    """A subtree with <= MAX_JOINS_PER_PROGRAM joins (at least one) to
+    materialize first, or None when the plan fits one program."""
+    if _count_joins(node) <= MAX_JOINS_PER_PROGRAM:
+        return None
+    kids = node.sources()
+    best = max(kids, key=_count_joins)
+    c = _count_joins(best)
+    if c > MAX_JOINS_PER_PROGRAM:
+        return _find_split(best)
+    return best if c >= 1 else None
+
+
+def _collect_with_carriers(plan: N.PlanNode, engine,
+                           carriers: dict[int, "ScanInput"]
+                           ) -> list["ScanInput"]:
+    out: list[ScanInput] = []
+
+    def visit(node):
+        if id(node) in carriers:
+            out.append(carriers[id(node)])
+            return
+        if isinstance(node, N.TableScan):
+            out.extend(collect_scans(node, engine))
+            return
+        for s in node.sources():
+            visit(s)
+
+    visit(plan)
+    return out
+
+
+def _compact_kernel(live, data, cap: int):
+    """Gather live rows to the front of a ``cap``-row buffer (device
+    gather; the page-compaction analog). Padding rows replicate the
+    last row and are marked dead in the returned live mask."""
+    idx = jnp.nonzero(live, size=cap, fill_value=live.shape[0] - 1)[0]
+    out = {k: v[idx] for k, v in data.items()}
+    newlive = jnp.arange(cap) < jnp.sum(live)
+    return out, newlive
+
+
+_compact_jit = jax.jit(_compact_kernel, static_argnames=("cap",))
+
+
+def run_plan_device(engine, plan: N.PlanNode,
+                    scan_inputs: list["ScanInput"]):
+    """Like run_plan but keeps results as DEVICE arrays (segment
+    handoff): returns (arrays incl. $valid/__live__, dicts, types, n).
+    Outputs compact to pow2(live count) when that at least halves the
+    buffer, so later segments never churn through dead padding."""
+    _c, _f, meta, (res, live, _oks) = prepare_plan(
+        engine, plan, scan_inputs)
+    arrays: dict = {}
+    dicts: dict = {}
+    types: dict = {}
+    i = 0
+    for sym, dtype, dictionary, has_valid in meta["out"]:
+        arrays[sym] = res[i]
+        if has_valid:
+            arrays[f"{sym}$valid"] = res[i + 1]
+        i += 2
+        dicts[sym] = dictionary
+        types[sym] = dtype
+    n = int(live.shape[0])
+    cnt = int(np.asarray(jnp.sum(live)))
+    cap = max(128, next_pow2(max(cnt, 1)))
+    if cap <= n // 2:
+        arrays, live = _compact_jit(live, arrays, cap=cap)
+        n = cap
+    arrays["__live__"] = live
+    return arrays, dicts, types, n
+
+
+def _segment_carriers(engine, plan: N.PlanNode, pool_tag: str):
+    """Materialize many-join subtrees as device-resident carrier scans
+    until the remaining plan fits one program. Returns the rewritten
+    plan + carrier inputs. Carrier bytes are reserved under
+    ``pool_tag`` (freed by the caller when the pipeline finishes)."""
+    from presto_tpu.exec.streaming import _replace_node
+
+    pool = getattr(engine, "memory_pool", None)
+    carriers: dict[int, ScanInput] = {}
+    seg = 0
+    while True:
+        sub = _find_split(plan)
+        if sub is None:
+            break
+        scans = _collect_with_carriers(sub, engine, carriers)
+        arrays, dicts, types, n = run_plan_device(engine, sub, scans)
+        if pool is not None:
+            pool.reserve(pool_tag, sum(
+                int(a.nbytes) for a in arrays.values()))
+        cnode = N.TableScan("__segment__", f"s{seg}",
+                            {s: s for s in types}, types)
+        seg += 1
+        carriers[id(cnode)] = ScanInput(cnode, arrays, dicts, types, n)
+        plan = _replace_node(plan, sub, cnode)
+    return plan, carriers
+
+
+def _execute_segmented(engine, plan: N.PlanNode) -> Table:
+    """Execute a many-join plan as a pipeline of separately compiled
+    segments — the engine's stage materialization (the reference
+    streams between stages; here segment outputs stay in HBM and feed
+    the next program as inputs)."""
+    import uuid
+
+    pool = getattr(engine, "memory_pool", None)
+    tag = "seg-" + uuid.uuid4().hex[:12]
+    try:
+        plan, carriers = _segment_carriers(engine, plan, tag)
+        return run_plan(engine, plan,
+                        _collect_with_carriers(plan, engine, carriers))
+    finally:
+        if pool is not None:
+            pool.free(tag)
+
+
+def run_plan_live(engine, plan: N.PlanNode):
+    """Run a plan fully on device (segmenting many-join plans) and
+    return ONLY the final live mask (device array) — the steady-state
+    benchmarking entry: materializing the mask is the host-side sync
+    without paying result transfer."""
+    import uuid
+
+    pool = getattr(engine, "memory_pool", None)
+    tag = "seg-" + uuid.uuid4().hex[:12]
+    try:
+        plan, carriers = _segment_carriers(engine, plan, tag)
+        scans = _collect_with_carriers(plan, engine, carriers)
+        _c, _f, _meta, (_res, live, _oks) = prepare_plan(
+            engine, plan, scans)
+        return live
+    finally:
+        if pool is not None:
+            pool.free(tag)
 
 
 def _find_match_recognize(plan: N.PlanNode):
@@ -460,9 +623,12 @@ def run_plan(engine, plan: N.PlanNode,
     pool = getattr(engine, "memory_pool", None)
     tag = uuid.uuid4().hex[:12]
     if pool is not None:
+        # host (numpy) inputs only: device-resident segment carriers
+        # are already reserved under their pipeline's seg- tag
         pool.reserve(tag, sum(
             a.nbytes for scan in scan_inputs
-            for a in scan.arrays.values()))
+            for a in scan.arrays.values()
+            if isinstance(a, np.ndarray)))
     try:
         _compiled, _flat, meta, (res, live, _oks) = prepare_plan(
             engine, plan, scan_inputs)

@@ -1417,24 +1417,46 @@ class LogicalPlanner:
                 remaining.discard(j)
                 joined_syms |= set(legs[j].node.output_types())
                 continue
-            j = min(cands, key=lambda i: legs[i].est)
+            # cost-based choice: estimated OUTPUT rows, not build size.
+            # A small build side joined on a low-ndv key (Q5's
+            # customer on c_nationkey = s_nationkey) is a many-to-many
+            # explosion; the reference's ReorderJoins costs candidate
+            # orders through JoinStatsRule the same way.
+            def out_est(i: int) -> int:
+                b = legs[i]
+                syms = frozenset(bs for _, bs in cands[i])
+                if any(k <= syms for k in b.unique):
+                    return max(int(est * b.sel), 1)
+                ndv = 1
+                for _, bs in cands[i]:
+                    ndv *= max(self.ndv.get(bs, 32), 1)
+                ndv = min(ndv, max(b.est, 1))
+                return max(int(est * b.est / ndv), 1)
+
+            j = min(cands, key=lambda i: (out_est(i), legs[i].est))
             criteria = cands[j]
             build = legs[j]
             build_syms = frozenset(b for _, b in criteria)
             build_unique = any(k <= build_syms for k in build.unique)
+            est_out = out_est(j)
+            # the capacity HINT stays conservative: an undersized first
+            # guess is fixed by one RETRY_GROWTH recompile, an oversized
+            # one allocates est_out-rows of HBM up front (q72's default
+            # ndv once produced a 2^29-row hint)
+            out_cap = min(2 * max(est_out, est), 8 * max(est, build.est))
             node = N.Join(node, build.node, N.JoinType.INNER, criteria,
                           None, build_unique,
                           build_rows=build.est,
                           capacity=_next_pow2(2 * build.est),
                           output_capacity=None if build_unique else
-                          _next_pow2(2 * max(est, build.est)))
+                          _next_pow2(max(out_cap, 2)))
             if build_unique:
                 # FK->PK join: a filtered PK side keeps only its
                 # selectivity fraction of probe rows (containment,
                 # cost/JoinStatsRule.java analog)
-                est = max(int(est * build.sel), 1)
+                est = est_out
             else:
-                est = max(est, build.est) * 2
+                est = max(est_out, 2)
                 # each output row is a distinct (probe row, build row)
                 # pair: probe key + a unique key of the BUILD side (the
                 # join keys themselves are NOT unique here)

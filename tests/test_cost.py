@@ -1,0 +1,42 @@
+"""Cost-based join ordering: the planner must pick candidate joins by
+estimated OUTPUT rows (unique-build containment vs ndv-based expansion),
+not build-side size alone — the ReorderJoins/JoinStatsRule analog
+(reference sql/planner/iterative/rule/ReorderJoins.java,
+cost/JoinStatsRule.java)."""
+
+from presto_tpu import Engine
+from presto_tpu.plan import nodes as N
+from tests.tpch_queries import QUERIES
+
+
+def _joins(plan):
+    out = []
+
+    def visit(n):
+        if isinstance(n, N.Join):
+            out.append(n)
+        for s in n.sources():
+            visit(s)
+
+    visit(plan)
+    return out
+
+
+def test_q5_avoids_nationkey_expansion(tpch_tiny):
+    """Q5's customer leg must join through c_custkey (unique) — joining
+    it early through c_nationkey = s_nationkey alone is a many-to-many
+    explosion (rows x customers-per-nation)."""
+    eng = Engine()
+    eng.register_catalog("tpch", tpch_tiny)
+    plan, _ = eng.plan_sql(QUERIES["q05"])
+    joins = _joins(plan)
+    assert len(joins) == 5
+    assert all(j.build_unique for j in joins), [
+        (j.criteria, j.build_unique) for j in joins]
+
+
+def test_q9_all_joins_unique_build(tpch_tiny):
+    eng = Engine()
+    eng.register_catalog("tpch", tpch_tiny)
+    plan, _ = eng.plan_sql(QUERIES["q09"])
+    assert all(j.build_unique for j in _joins(plan))

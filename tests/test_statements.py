@@ -179,3 +179,23 @@ def test_delete_with_mesh_mask_alignment(eng):
     assert deleted > 0 and deleted + left == n
     assert eng.execute("select count(*) from memory.t3 "
                        "where o_orderkey % 2 = 0") == [(0,)]
+
+
+def test_update_invalidates_device_cache(eng):
+    """In-place UPDATE must not leave stale device copies: the engine
+    pins scan arrays in HBM across repeat executions (Engine.device_array)
+    and MemoryConnector.update_rows mutates the SAME numpy object."""
+    eng.execute("create table memory.dc as select 1 as x union all "
+                "select 2 union all select 3")
+    assert sorted(eng.execute("select x from memory.dc")) == [(1,), (2,), (3,)]
+    assert len(eng._dev_cache) > 0  # the SELECT pinned its scan arrays
+    eng.execute("update memory.dc set x = 9 where x = 2")
+    assert len(eng._dev_cache) == 0  # UPDATE dropped the pinned copies
+    assert sorted(eng.execute("select x from memory.dc")) == [(1,), (3,), (9,)]
+
+
+def test_insert_invalidates_device_cache(eng):
+    eng.execute("create table memory.dc2 as select 1 as x")
+    eng.execute("select x from memory.dc2")
+    eng.execute("insert into memory.dc2 select 5")
+    assert sorted(eng.execute("select x from memory.dc2")) == [(1,), (5,)]
