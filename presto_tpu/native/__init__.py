@@ -1,0 +1,106 @@
+"""Native runtime components (C++), bound via ctypes.
+
+The compute path is JAX/XLA; the runtime around it is native where the
+reference's is. First component: the page codec for the multi-host data
+plane (reference execution/buffer/PagesSerde.java:41,64 — LZ4-compressed
+SerializedPage frames + checksum; here a from-scratch LZ77 codec +
+CRC-32C, see src/pageserde.cpp).
+
+The shared library builds lazily with g++ on first use and is cached
+next to the source. Everything degrades gracefully: ``codec()`` returns
+``None`` when no toolchain is available and callers fall back to the
+pure-Python wire format.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "src", "pageserde.cpp")
+_LIB = os.path.join(_DIR, "libpageserde.so")
+
+_lock = threading.Lock()
+_codec: "PageCodec | None | bool" = False  # False = not yet attempted
+
+
+def _build() -> str | None:
+    """Compile the shared library if missing/stale; returns its path."""
+    try:
+        if (not os.path.exists(_LIB)
+                or os.path.getmtime(_LIB) < os.path.getmtime(_SRC)):
+            # pid-unique temp: concurrent workers building at once must
+            # not interleave writes into one file
+            tmp = f"{_LIB}.{os.getpid()}.tmp"
+            subprocess.run(
+                ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+                 "-o", tmp, _SRC],
+                check=True, capture_output=True, timeout=120)
+            os.replace(tmp, _LIB)
+        return _LIB
+    except Exception:
+        return None
+
+
+class PageCodec:
+    """ctypes wrapper over the native ppage codec."""
+
+    def __init__(self, lib_path: str):
+        lib = ctypes.CDLL(lib_path)
+        lib.ppage_bound.restype = ctypes.c_size_t
+        lib.ppage_bound.argtypes = [ctypes.c_size_t]
+        lib.ppage_compress.restype = ctypes.c_size_t
+        lib.ppage_compress.argtypes = [
+            ctypes.c_char_p, ctypes.c_size_t,
+            ctypes.c_char_p, ctypes.c_size_t]
+        lib.ppage_decompress.restype = ctypes.c_size_t
+        lib.ppage_decompress.argtypes = [
+            ctypes.c_char_p, ctypes.c_size_t,
+            ctypes.c_char_p, ctypes.c_size_t]
+        lib.ppage_crc32c.restype = ctypes.c_uint32
+        lib.ppage_crc32c.argtypes = [ctypes.c_char_p, ctypes.c_size_t]
+        self._lib = lib
+
+    def compress(self, data: bytes) -> bytes:
+        n = len(data)
+        cap = self._lib.ppage_bound(n)
+        buf = ctypes.create_string_buffer(cap)
+        size = self._lib.ppage_compress(data, n, buf, cap)
+        if size == 0 and n:
+            raise RuntimeError("ppage_compress failed")
+        return buf.raw[:size]
+
+    def decompress(self, data: bytes, orig_size: int) -> bytes:
+        buf = ctypes.create_string_buffer(max(orig_size, 1))
+        size = self._lib.ppage_decompress(
+            data, len(data), buf, orig_size)
+        if size != orig_size:
+            raise ValueError("ppage: corrupt block "
+                             f"(got {size}, want {orig_size})")
+        return buf.raw[:orig_size]
+
+    def crc32c(self, data: bytes) -> int:
+        return int(self._lib.ppage_crc32c(data, len(data)))
+
+
+def codec() -> PageCodec | None:
+    """The process-wide native codec, or None when unavailable
+    (toolchain missing, build failure, PRESTO_TPU_NO_NATIVE=1)."""
+    global _codec
+    if _codec is False:
+        with _lock:
+            if _codec is False:
+                if os.environ.get("PRESTO_TPU_NO_NATIVE") == "1":
+                    _codec = None
+                else:
+                    path = _build()
+                    try:
+                        # load failure (stale/corrupt/wrong-arch .so)
+                        # degrades to the pure-Python wire format
+                        _codec = PageCodec(path) if path else None
+                    except OSError:
+                        _codec = None
+    return _codec  # type: ignore[return-value]

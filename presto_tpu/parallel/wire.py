@@ -1,20 +1,62 @@
 """Binary columnar wire format for the multi-host data plane.
 
-Replaces round-2's JSON-lists-of-Python-values with npz payloads: each
-column ships as its physical numpy array plus optional validity mask
-and string dictionary — the analog of the reference's SerializedPage
-stream (execution/buffer/PagesSerde.java:41,64; compression is left to
-HTTP transport, the reference uses LZ4 inside the page stream).
+Each column ships as its physical numpy array plus optional validity
+mask and string dictionary — the analog of the reference's
+SerializedPage stream (execution/buffer/PagesSerde.java:41,64). Frames
+are compressed by the native C++ page codec with a CRC-32C integrity
+check (presto_tpu/native, the LZ4+xxhash analog); when the native
+library is unavailable the raw npz payload ships unframed, and readers
+accept both.
 """
 
 from __future__ import annotations
 
 import io
+import struct
 
 import numpy as np
 
 from presto_tpu import types as T
 from presto_tpu.block import Column, Table
+
+# framed-page header: magic | u8 flags | u64 raw size | u32 crc32c(body)
+# | u32 crc32c(header[:13]) — the header carries its own checksum so a
+# corrupted raw_size cannot drive an unbounded allocation
+_MAGIC = b"PPG1"
+_HEADER = struct.Struct("<4sBQII")
+
+
+def _frame(raw: bytes) -> bytes:
+    from presto_tpu.native import codec
+    c = codec()
+    if c is None:
+        return raw
+    body = c.compress(raw)
+    if len(body) >= len(raw):  # incompressible: don't pay decompression
+        return raw
+    head = struct.pack("<4sBQ", _MAGIC, 1, len(raw))
+    return head + struct.pack(
+        "<II", c.crc32c(body), c.crc32c(head)) + body
+
+
+def _deframe(payload: bytes) -> bytes:
+    if payload[:4] != _MAGIC:
+        return payload  # legacy / uncompressed npz
+    from presto_tpu.native import codec
+    c = codec()
+    if c is None:
+        raise RuntimeError(
+            "received a native-compressed page but the native codec is "
+            "unavailable on this host")
+    if len(payload) < _HEADER.size:
+        raise ValueError("page frame truncated")
+    _m, _flags, raw_size, crc, hcrc = _HEADER.unpack_from(payload)
+    if c.crc32c(payload[:13]) != hcrc:
+        raise ValueError("page header checksum mismatch")
+    body = payload[_HEADER.size:]
+    if c.crc32c(body) != crc:
+        raise ValueError("page checksum mismatch (corrupt exchange frame)")
+    return c.decompress(body, raw_size)
 
 
 def columns_to_bytes(cols: dict[str, Column]) -> bytes:
@@ -34,7 +76,7 @@ def columns_to_bytes(cols: dict[str, Column]) -> bytes:
     arrays["__names__"] = np.asarray(names, dtype="U")
     buf = io.BytesIO()
     np.savez(buf, **arrays)
-    return buf.getvalue()
+    return _frame(buf.getvalue())
 
 
 def table_to_bytes(table: Table, compact: bool = True) -> bytes:
@@ -50,6 +92,7 @@ def bytes_to_columns(payload: bytes) -> tuple[dict[str, Column], int]:
     """Deserialize into {name: Column} + row count."""
     from presto_tpu.types import parse_type
 
+    payload = _deframe(payload)
     with np.load(io.BytesIO(payload), allow_pickle=False) as z:
         names = [str(s) for s in z["__names__"]]
         cols: dict[str, Column] = {}
