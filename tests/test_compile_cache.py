@@ -1,0 +1,40 @@
+"""Compiled-program reuse: repeat executions must not re-trace or
+recompile (the round-2 pathology was 174s of XLA recompiles for 0.79s
+of execution on Q3). Reference analog: compiled-artifact caches keyed
+by expression (gen/PageFunctionCompiler.java:101)."""
+
+import pytest
+
+import presto_tpu.exec.executor as ex
+from presto_tpu import Engine
+from presto_tpu.connectors.tpch import TpchConnector
+from tests.tpch_queries import QUERIES
+
+
+@pytest.fixture(scope="module")
+def eng(tpch_tiny):
+    e = Engine()
+    e.register_catalog("tpch", tpch_tiny)
+    return e
+
+
+@pytest.mark.parametrize("qname", ["q03", "q05", "q09"])
+def test_repeat_execution_compiles_nothing(eng, qname, monkeypatch):
+    calls = []
+    orig = ex.make_traced
+
+    def counting(*a, **k):
+        calls.append(1)
+        return orig(*a, **k)
+
+    monkeypatch.setattr(ex, "make_traced", counting)
+    eng.execute(QUERIES[qname])
+    first = len(calls)
+    # capacity retries are bounded: at most ONE growth recompile per
+    # compiled segment (RETRY_GROWTH overshoots all failed capacities)
+    nsegs = max(1, ex._count_joins(eng.plan_sql(QUERIES[qname])[0])
+                - ex.MAX_JOINS_PER_PROGRAM + 1)
+    assert first <= 2 * nsegs + 1, (first, nsegs)
+    calls.clear()
+    eng.execute(QUERIES[qname])
+    assert len(calls) == 0, "repeat execution re-traced the program"

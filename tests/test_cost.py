@@ -40,3 +40,36 @@ def test_q9_all_joins_unique_build(tpch_tiny):
     eng.register_catalog("tpch", tpch_tiny)
     plan, _ = eng.plan_sql(QUERIES["q09"])
     assert all(j.build_unique for j in _joins(plan))
+
+
+def test_flipped_stats_change_join_order():
+    """The ordering is driven by stats, not table names: shrinking one
+    side's row counts flips which leg becomes the fact table."""
+    import numpy as np
+    from presto_tpu.connectors.memory import MemoryConnector
+    from presto_tpu import types as T
+
+    def build(big_left: bool):
+        eng = Engine()
+        mem = MemoryConnector()
+        n_a, n_b = (100000, 50) if big_left else (50, 100000)
+        mem.create_table("a", {"a_id": T.BIGINT, "a_x": T.BIGINT},
+                         {"a_id": np.arange(n_a), "a_x": np.arange(n_a)},
+                         {"a_id": None, "a_x": None})
+        mem.create_table("b", {"b_id": T.BIGINT, "b_y": T.BIGINT},
+                         {"b_id": np.arange(n_b), "b_y": np.arange(n_b)},
+                         {"b_id": None, "b_y": None})
+        eng.register_catalog("mem", mem)
+        eng.session.catalog = "mem"
+        plan, _ = eng.plan_sql(
+            "select count(*) from a, b where a_id = b_id")
+        return _joins(plan)[0]
+
+    j_big_left = build(True)
+    j_big_right = build(False)
+    # the probe (left) side of the produced Join is always the larger
+    # leg; flipping the stats flips the plan
+    left_syms_1 = set(j_big_left.left.output_types())
+    left_syms_2 = set(j_big_right.left.output_types())
+    assert any(s.startswith("a_") for s in left_syms_1)
+    assert any(s.startswith("b_") for s in left_syms_2)
