@@ -166,6 +166,10 @@ class Table:
 
 def _decode_column(dtype: T.DataType, data: np.ndarray, dictionary):
     if isinstance(dtype, T.VarcharType):
+        if dictionary is None:
+            # host-materialized strings (varlen aggregates): already
+            # decoded Python objects, no code indirection
+            return data
         if not len(dictionary):
             return np.full(len(data), "", object)
         safe = np.clip(data, 0, len(dictionary) - 1)

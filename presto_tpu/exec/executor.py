@@ -356,6 +356,11 @@ def execute_plan(engine, plan: N.PlanNode) -> Table:
     mr = _find_match_recognize(plan)
     if mr is not None:
         return _execute_with_match_recognize(engine, plan, mr)
+    from presto_tpu.exec.varlen import (
+        execute_with_varlen, find_varlen_aggregate)
+    vl = find_varlen_aggregate(plan)
+    if vl is not None:
+        return execute_with_varlen(engine, plan, vl)
     # streaming first: a block-streamed scan already bounds its working
     # set, so the memory-budget check must not veto it
     streamed = try_execute_streamed(engine, plan)

@@ -1,0 +1,286 @@
+"""Host-finalized variable-length aggregates: array_agg / map_agg /
+listagg.
+
+Fixed-width HBM arrays cannot hold per-group variable-length values, so
+these aggregates split execution: the device runs the aggregate's
+source subplan (and the scalar part of the aggregation as usual), then
+the host groups the materialized argument rows and assembles the
+variable-length results — the same device/host split the reference
+makes between its fixed-slice accumulators and the typed heap blocks
+behind array_agg (operator/aggregation/ArrayAggregationFunction,
+MapAggAggregationFunction, ListaggAggregationFunction).
+
+Supported plan shape: the varlen Aggregate may sit under any chain of
+Output / Project (varlen symbols passed through as bare references) /
+Sort / Limit nodes. Anything else (varlen value feeding a scalar
+expression, joins above the aggregation) raises a clear error.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from presto_tpu import types as T
+from presto_tpu.block import Column, Table, _decode_column
+from presto_tpu.expr import aggregates as A
+from presto_tpu.expr import ir
+from presto_tpu.plan import nodes as N
+
+
+def find_varlen_aggregate(plan: N.PlanNode) -> N.Aggregate | None:
+    """The (single) Aggregate node carrying varlen calls, or None."""
+    found: list[N.Aggregate] = []
+
+    def visit(node):
+        if isinstance(node, N.Aggregate) and any(
+                c.fn in A.VARLEN_FNS for c in node.aggs.values()):
+            found.append(node)
+        for s in node.sources():
+            visit(s)
+
+    visit(plan)
+    if len(found) > 1:
+        raise NotImplementedError(
+            "multiple variable-length aggregations in one query")
+    return found[0] if found else None
+
+
+def _chain_to(plan: N.PlanNode, target: N.Aggregate) -> list[N.PlanNode]:
+    """Root->target node chain; validates the supported shape."""
+    chain: list[N.PlanNode] = []
+    node = plan
+    varlen_syms = {s for s, c in target.aggs.items()
+                   if c.fn in A.VARLEN_FNS}
+    while node is not target:
+        if isinstance(node, N.Output):
+            pass
+        elif isinstance(node, N.Project):
+            for sym, e in node.assignments.items():
+                refs = ir.referenced_columns([e])
+                if refs & varlen_syms and not isinstance(e, ir.ColumnRef):
+                    raise NotImplementedError(
+                        "variable-length aggregate results cannot feed "
+                        "scalar expressions")
+        elif isinstance(node, (N.Sort, N.Limit)):
+            if isinstance(node, N.Sort) and any(
+                    o.symbol in varlen_syms for o in node.orderings):
+                raise NotImplementedError(
+                    "ORDER BY on a variable-length aggregate result")
+        else:
+            raise NotImplementedError(
+                f"plan node {type(node).__name__} above a "
+                "variable-length aggregation is unsupported")
+        chain.append(node)
+        srcs = node.sources()
+        if len(srcs) != 1:
+            raise NotImplementedError(
+                "variable-length aggregation under a multi-source node")
+        node = srcs[0]
+    return chain
+
+
+def _strip_and_rebuild(chain: list[N.PlanNode], agg: N.Aggregate,
+                       scalar_agg: N.Aggregate,
+                       keep_syms: list[str]) -> N.PlanNode:
+    """Rebuild the chain over ``scalar_agg`` with varlen symbols removed
+    and group keys (``keep_syms``) passed through every level."""
+    import dataclasses
+
+    varlen_syms = {s for s, c in agg.aggs.items()
+                   if c.fn in A.VARLEN_FNS}
+    node: N.PlanNode = scalar_agg
+    for level in reversed(chain):
+        if isinstance(level, N.Output):
+            keep_pairs = [(n, s) for n, s in
+                          zip(level.names, level.symbols)
+                          if s not in varlen_syms]
+            names = [n for n, _ in keep_pairs]
+            syms = [s for _, s in keep_pairs]
+            # every group key also rides under a reserved name so host
+            # matching never depends on what the user selected
+            for k in keep_syms:
+                names.append(f"__vl_{k}")
+                syms.append(k)
+            node = N.Output(node, names, syms)
+        elif isinstance(level, N.Project):
+            assigns = {s: e for s, e in level.assignments.items()
+                       if not (ir.referenced_columns([e]) & varlen_syms)}
+            for k in keep_syms:
+                if k not in assigns:
+                    assigns[k] = ir.ColumnRef(
+                        _sym_type(scalar_agg, k), k)
+            node = N.Project(node, assigns)
+        else:
+            node = dataclasses.replace(level, source=node)
+    return node
+
+
+def _sym_type(agg: N.Aggregate, sym: str) -> T.DataType:
+    return agg.source.output_types()[sym]
+
+
+def _decoded(col: Column):
+    """(values as a plain Python list, validity list or None)."""
+    data = _decode_column(col.dtype, np.asarray(col.data), col.dictionary)
+    values = np.asarray(data).tolist()
+    valid = None if col.valid is None else np.asarray(col.valid).tolist()
+    return values, valid
+
+
+def _key_tuples(table: Table, keys: list[str]) -> list[tuple]:
+    cols = [_decoded(table.columns[k]) for k in keys]
+    mask = None if table.mask is None else np.asarray(table.mask)
+    out = []
+    for i in range(table.nrows):
+        if mask is not None and not mask[i]:
+            out.append(None)
+            continue
+        out.append(tuple(
+            None if v is not None and not v[i] else d[i]
+            for d, v in cols))
+    return out
+
+
+def execute_with_varlen(engine, plan: N.PlanNode,
+                        agg: N.Aggregate) -> Table:
+    from presto_tpu.exec.executor import execute_plan
+
+    chain = _chain_to(plan, agg)
+    varlen = {s: c for s, c in agg.aggs.items() if c.fn in A.VARLEN_FNS}
+    scalar = {s: c for s, c in agg.aggs.items()
+              if c.fn not in A.VARLEN_FNS}
+
+    # 1. materialize the aggregation input: group keys + varlen args
+    #    (+ order columns), projected to symbols on the source
+    need: dict[str, ir.Expr] = {}
+    src_types = agg.source.output_types()
+    for k in agg.group_keys:
+        need[k] = ir.ColumnRef(src_types[k], k)
+    arg_syms: dict[str, tuple] = {}
+    for sym, call in varlen.items():
+        a_sym = f"{sym}$arg"
+        need[a_sym] = call.arg
+        a2_sym = None
+        if call.arg2 is not None:
+            a2_sym = f"{sym}$arg2"
+            need[a2_sym] = call.arg2
+        o_sym = call.order_sym
+        if o_sym is not None:
+            need[o_sym] = ir.ColumnRef(src_types[o_sym], o_sym)
+        if call.mask is not None:  # FILTER (WHERE ...) mask column
+            need[call.mask] = ir.ColumnRef(src_types[call.mask],
+                                           call.mask)
+        arg_syms[sym] = (a_sym, a2_sym, o_sym)
+    src_plan = N.Output(N.Project(agg.source, need),
+                        list(need), list(need))
+    src_table = execute_plan(engine, src_plan)
+
+    # 2. scalar part on device (hidden count keeps the node non-empty)
+    if not scalar:
+        scalar = {"__vl_cnt": A.AggCall("count_star", None, T.BIGINT)}
+    import dataclasses
+    scalar_agg = dataclasses.replace(agg, aggs=scalar)
+    scalar_plan = _strip_and_rebuild(chain, agg, scalar_agg,
+                                     list(agg.group_keys))
+    result = execute_plan(engine, scalar_plan)
+
+    # 3. assemble varlen values per group on host
+    src_keys = _key_tuples(src_table, list(agg.group_keys))
+    values: dict[str, dict] = {sym: {} for sym in varlen}
+    per_sym_cols = {}
+    for sym, (a_sym, a2_sym, o_sym) in arg_syms.items():
+        a = _decoded(src_table.columns[a_sym])
+        a2 = _decoded(src_table.columns[a2_sym]) if a2_sym else None
+        o = _decoded(src_table.columns[o_sym]) if o_sym else None
+        call = varlen[sym]
+        m = (_decoded(src_table.columns[call.mask])
+             if call.mask is not None else None)
+        per_sym_cols[sym] = (a, a2, o, m)
+    for i, key in enumerate(src_keys):
+        if key is None:
+            continue
+        for sym, call in varlen.items():
+            (ad, av), a2c, oc, mc = per_sym_cols[sym]
+            if mc is not None:
+                md, mv = mc
+                if (mv is not None and not mv[i]) or not md[i]:
+                    continue  # row excluded by FILTER
+            is_null = av is not None and not av[i]
+            # NULL handling per function (reference semantics):
+            # array_agg keeps NULL elements, map_agg drops NULL keys,
+            # listagg drops NULL values
+            if is_null and call.fn != "array_agg":
+                continue
+            v = None if is_null else ad[i]
+            entry = values[sym].setdefault(key, [])
+            okey = None
+            if oc is not None:
+                od, ov = oc
+                okey = od[i] if (ov is None or ov[i]) else None
+            if call.fn == "map_agg":
+                a2d, a2v = a2c
+                v2 = a2d[i] if (a2v is None or a2v[i]) else None
+                entry.append((okey, v, v2))
+            else:
+                entry.append((okey, v))
+
+    def finish(call: A.AggCall, entry: list):
+        if call.order_sym is not None:
+            entry = sorted(
+                entry,
+                key=lambda t: (t[0] is None, t[0]),
+                reverse=call.order_desc)
+        if call.fn == "map_agg":
+            return {k: v for _, k, v in entry}
+        vals = [v for _, v in entry]
+        if call.distinct:
+            seen, uniq = set(), []
+            for v in vals:
+                if v not in seen:
+                    seen.add(v)
+                    uniq.append(v)
+            vals = uniq
+        if call.fn == "listagg":
+            return (call.sep or ",").join(str(v) for v in vals)
+        return vals
+
+    # 4. attach host columns to the device result, matched by the
+    #    reserved __vl_<key> passthrough columns
+    key_cols = [f"__vl_{k}" for k in agg.group_keys]
+    if all(c in result.columns for c in key_cols):
+        res_keys = _key_tuples(result, key_cols)
+    else:  # chain was empty: columns keyed by symbol
+        res_keys = _key_tuples(result, list(agg.group_keys))
+    out_cols: dict[str, Column] = {}
+    root = chain[0] if chain else plan
+    # restore the original Output column order/names
+    if isinstance(root, N.Output):
+        name_syms = list(zip(root.names, root.symbols))
+    else:
+        name_syms = [(s, s) for s in agg.group_keys + list(agg.aggs)]
+    for name, sym in name_syms:
+        if sym in varlen:
+            call = varlen[sym]
+            data = np.empty(result.nrows, dtype=object)
+            valid = np.zeros(result.nrows, dtype=bool)
+            for i, key in enumerate(res_keys):
+                if key is None:
+                    continue
+                entry = values[sym].get(key)
+                if entry is None:
+                    # every input was dropped (NULL keys / FILTER):
+                    # the accumulator was never initialized -> NULL
+                    # (reference MapAggAggregationFunction behavior);
+                    # array_agg keeps NULLs so it cannot land here
+                    # unless FILTER removed the whole group
+                    data[i] = None
+                    valid[i] = False
+                else:
+                    data[i] = finish(call, entry)
+                    valid[i] = True
+            out_cols[name] = Column(call.dtype, data, valid, None)
+        elif name in result.columns:
+            out_cols[name] = result.columns[name]
+        else:  # chain was empty: keyed by symbol
+            out_cols[name] = result.columns[sym]
+    return Table(out_cols, result.nrows, result.mask)

@@ -41,6 +41,11 @@ class AggCall:
     arg2: ir.Expr | None = None
     # literal parameter (approx_percentile's percentile)
     param: float | None = None
+    # varlen aggregates (array_agg/map_agg/listagg): separator literal
+    # and intra-group ordering column (host-finalized, exec/varlen.py)
+    sep: str | None = None
+    order_sym: str | None = None
+    order_desc: bool = False
 
     def __str__(self) -> str:
         inner = "*" if self.arg is None else str(self.arg)
@@ -65,6 +70,11 @@ BOOL_FNS = frozenset({"bool_and", "bool_or", "every"})
 COVAR_FNS = frozenset({"corr", "covar_samp", "covar_pop",
                        "regr_slope", "regr_intercept"})
 BY_FNS = frozenset({"min_by", "max_by"})
+# variable-length-output aggregates: computed host-side at finalization
+# (exec/varlen.py) because their results cannot live in fixed-width HBM
+# arrays (reference operator/aggregation/ArrayAggregationFunction,
+# MapAggAggregationFunction, ListaggAggregationFunction)
+VARLEN_FNS = frozenset({"array_agg", "map_agg", "listagg"})
 
 # HyperLogLog register count for approx_distinct: p=11 -> 2048 buckets,
 # standard error 1.04/sqrt(2048) ~= 2.3% — the reference's default
@@ -109,6 +119,10 @@ def output_type(fn: str, arg_type: T.DataType | None) -> T.DataType:
         return T.DOUBLE
     if fn in BY_FNS or fn == "approx_percentile":
         return arg_type
+    if fn == "array_agg":
+        return T.ArrayType(arg_type if arg_type is not None else T.UNKNOWN)
+    if fn == "listagg":
+        return T.VARCHAR
     raise NotImplementedError(f"aggregate {fn}")
 
 

@@ -83,6 +83,9 @@ def prune_columns(node: N.PlanNode,
             *[c.arg for c in aggs.values() if c.arg is not None],
             *[c.arg2 for c in aggs.values() if c.arg2 is not None])
         child |= {c.mask for c in aggs.values() if c.mask is not None}
+        # varlen aggregates order within the group by a source column
+        child |= {c.order_sym for c in aggs.values()
+                  if getattr(c, "order_sym", None) is not None}
         if node.step == N.AggStep.FINAL:
             from presto_tpu.expr import aggregates as AGG
             for s, c in aggs.items():

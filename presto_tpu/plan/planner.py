@@ -46,7 +46,8 @@ AGG_FUNCTIONS = {"count", "sum", "avg", "min", "max", "arbitrary",
                  "geometric_mean", "approx_distinct", "checksum",
                  "corr", "covar_samp", "covar_pop",
                  "regr_slope", "regr_intercept",
-                 "min_by", "max_by", "approx_percentile"}
+                 "min_by", "max_by", "approx_percentile",
+                 "array_agg", "map_agg", "listagg"}
 
 _COMPARISONS = {"=": "eq", "<>": "neq", "<": "lt", "<=": "lte",
                 ">": "gt", ">=": "gte"}
@@ -379,6 +380,9 @@ class ExprPlanner:
                     f"aggregate {name}() not collected for this block")
             sym, dtype = entry
             return ir.ColumnRef(dtype, sym)
+        if e.agg_order_by:
+            raise SemanticError(
+                f"ORDER BY inside {name}() is not supported")
         if name in ("substr", "substring"):
             name = "substring"
         args = tuple(self.plan(a) for a in e.args)
@@ -402,6 +406,11 @@ class ExprPlanner:
         if name in ("regexp_replace", "regexp_extract", "lpad", "rpad",
                     "split_part"):
             return ir.Call(T.VARCHAR, name, args)
+        if name in ("json_extract_scalar", "json_extract", "json_parse",
+                    "json_format"):
+            return ir.Call(T.VARCHAR, name, args)
+        if name in ("json_array_length", "json_size"):
+            return ir.Call(T.BIGINT, name, args)
         if name == "abs":
             return ir.Call(args[0].dtype, name, args)
         if name == "sign":
@@ -1553,7 +1562,9 @@ class LogicalPlanner:
         agg_syms: dict[A.FunctionCall, tuple[str, T.DataType]] = {}
 
         def _is_distinct(c: A.FunctionCall) -> bool:
-            return c.distinct
+            # varlen DISTINCT (array_agg(distinct x)) dedups host-side
+            # in exec/varlen.py, not via MarkDistinct
+            return c.distinct and c.name not in AGG.VARLEN_FNS
 
         distinct_calls = [c for c in agg_calls if _is_distinct(c)]
         for call in agg_calls:
@@ -1589,16 +1600,69 @@ class LogicalPlanner:
                     raise SemanticError(
                         "percentile must be between 0 and 1")
                 arg_t = arg_ir.dtype
+            elif fn == "map_agg":
+                if len(call.args) != 2:
+                    raise SemanticError("map_agg takes (key, value)")
+                arg_ir = planner.plan(call.args[0])
+                arg2_ir = planner.plan(call.args[1])
+                arg_t = arg_ir.dtype
+            elif fn == "listagg":
+                if not 1 <= len(call.args) <= 2:
+                    raise SemanticError(
+                        "listagg takes (value[, separator])")
+                arg_ir = planner.plan(call.args[0])
+                arg_t = arg_ir.dtype
             else:
                 if len(call.args) != 1:
                     raise SemanticError(
                         f"aggregate {fn} takes one argument")
                 arg_ir = planner.plan(call.args[0])
                 arg_t = arg_ir.dtype
-            out_t = AGG.output_type(fn, arg_t)
+            if call.agg_order_by and fn not in AGG.VARLEN_FNS:
+                raise SemanticError(
+                    f"ORDER BY inside {fn}() is not supported (only "
+                    "array_agg/listagg order within the group)")
+            sep = None
+            order_sym = None
+            order_desc = False
+            if fn in AGG.VARLEN_FNS:
+                if fn == "listagg":
+                    sep = ","
+                    if len(call.args) == 2:
+                        s_ir = planner.plan(call.args[1])
+                        if not isinstance(s_ir, ir.Literal):
+                            raise SemanticError(
+                                "listagg separator must be a literal")
+                        sep = str(s_ir.value)
+                if call.agg_order_by:
+                    if len(call.agg_order_by) != 1:
+                        raise SemanticError(
+                            "aggregate ORDER BY supports one key")
+                    item = call.agg_order_by[0]
+                    o_ir = planner.plan(item.expression)
+                    order_sym = qs.add_projection(o_ir, "aggorder", self)
+                    order_desc = not item.ascending
+            if fn == "map_agg":
+                out_t = T.MapType(arg_t, arg2_ir.dtype)
+            else:
+                out_t = AGG.output_type(fn, arg_t)
+            mask_sym = None
+            if call.filter is not None:
+                # FILTER (WHERE p): fold under a boolean mask column
+                # (reference Aggregation.mask / FilterAggregations)
+                if call.distinct:
+                    raise SemanticError(
+                        "DISTINCT aggregate with FILTER is unsupported")
+                f_ir = planner.plan(call.filter)
+                if not isinstance(f_ir.dtype, T.BooleanType):
+                    raise SemanticError("FILTER predicate must be boolean")
+                mask_sym = qs.add_projection(f_ir, "aggfilter", self)
             sym = self.symbols.fresh(fn)
-            aggs[sym] = AggCall(fn, arg_ir, out_t, _is_distinct(call),
-                                arg2=arg2_ir, param=param)
+            aggs[sym] = AggCall(fn, arg_ir, out_t, call.distinct,
+                                mask=mask_sym,
+                                arg2=arg2_ir, param=param, sep=sep,
+                                order_sym=order_sym,
+                                order_desc=order_desc)
             agg_syms[call] = (sym, out_t)
 
         gsets = self._resolve_grouping_sets(spec)

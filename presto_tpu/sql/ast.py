@@ -149,6 +149,9 @@ class FunctionCall(Expression):
     is_star: bool = False  # count(*)
     window: Optional["WindowSpec"] = None
     filter: Optional[Expression] = None
+    # intra-aggregate ordering: array_agg(x ORDER BY y) or
+    # listagg(x, s) WITHIN GROUP (ORDER BY y)
+    agg_order_by: tuple["SortItem", ...] = ()
 
 
 @dataclasses.dataclass(frozen=True)

@@ -872,7 +872,22 @@ class Parser:
             args.append(self.expression())
             while self.accept_op(","):
                 args.append(self.expression())
+        agg_order: tuple[A.SortItem, ...] = ()
+        if self.at_keyword("order"):
+            # array_agg(x ORDER BY y)
+            self.advance()
+            self.expect_keyword("by")
+            agg_order = self._sort_items()
         self.expect_op(")")
+        if self.at_keyword("within"):
+            # listagg(x, sep) WITHIN GROUP (ORDER BY y)
+            self.advance()
+            self.expect_keyword("group")
+            self.expect_op("(")
+            self.expect_keyword("order")
+            self.expect_keyword("by")
+            agg_order = self._sort_items()
+            self.expect_op(")")
         filt = None
         if self.at_keyword("filter"):
             self.advance()
@@ -885,7 +900,7 @@ class Parser:
             self.advance()
             window = self._window_spec()
         return A.FunctionCall(name, tuple(args), distinct, is_star,
-                              window, filt)
+                              window, filt, agg_order)
 
     def _window_spec(self) -> A.WindowSpec:
         self.expect_op("(")

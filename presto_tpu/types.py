@@ -151,6 +151,41 @@ class UnknownType(DataType):
         return np.dtype(np.int64)
 
 
+@dataclasses.dataclass(frozen=True)
+class ArrayType(DataType):
+    """Variable-length array (reference spi/type/ArrayType). Values are
+    host-side Python lists in an object ndarray — produced by
+    host-finalized operators (array_agg); not a device dtype."""
+
+    element: DataType = None  # type: ignore[assignment]
+
+    def __init__(self, element: DataType) -> None:
+        object.__setattr__(self, "element", element)
+        super().__init__(f"array({element})")
+
+    @property
+    def physical_dtype(self) -> np.dtype:
+        return np.dtype(object)
+
+
+@dataclasses.dataclass(frozen=True)
+class MapType(DataType):
+    """Key->value map (reference spi/type/MapType). Values are host-side
+    Python dicts in an object ndarray; not a device dtype."""
+
+    key: DataType = None  # type: ignore[assignment]
+    value: DataType = None  # type: ignore[assignment]
+
+    def __init__(self, key: DataType, value: DataType) -> None:
+        object.__setattr__(self, "key", key)
+        object.__setattr__(self, "value", value)
+        super().__init__(f"map({key}, {value})")
+
+    @property
+    def physical_dtype(self) -> np.dtype:
+        return np.dtype(object)
+
+
 BIGINT = BigintType()
 INTEGER = IntegerType()
 DOUBLE = DoubleType()
@@ -218,6 +253,21 @@ def parse_type(s: str) -> DataType:
         return DecimalType(int(p), int(sc))
     if s.startswith("varchar"):
         return VARCHAR
+    if s.startswith("array(") and s.endswith(")"):
+        return ArrayType(parse_type(s[6:-1]))
+    if s.startswith("map(") and s.endswith(")"):
+        inner = s[4:-1]
+        # split on the top-level comma (element types may nest)
+        depth = 0
+        for i, ch in enumerate(inner):
+            if ch == "(":
+                depth += 1
+            elif ch == ")":
+                depth -= 1
+            elif ch == "," and depth == 0:
+                return MapType(parse_type(inner[:i]),
+                               parse_type(inner[i + 1:]))
+        raise ValueError(f"cannot parse type {s!r}")
     simple = {"bigint": BIGINT, "integer": INTEGER, "double": DOUBLE,
               "boolean": BOOLEAN, "date": DATE, "unknown": UNKNOWN}
     if s in simple:

@@ -292,3 +292,137 @@ def test_regexp_family(eng):
         assert part2 == phone.split("-")[1]
         assert lp == phone.rjust(20, "*")[:20]
         assert rp == phone.ljust(4)[:4]
+
+
+# ---- variable-length aggregates (host-finalized, exec/varlen.py) ------
+
+
+def test_array_agg_ordered(eng):
+    rows = eng.execute(
+        "select n_regionkey, array_agg(n_name order by n_name) "
+        "from nation group by n_regionkey order by n_regionkey")
+    assert len(rows) == 5
+    for _, names in rows:
+        assert names == sorted(names) and len(names) == 5
+
+
+def test_array_agg_keeps_nulls_and_distinct(eng):
+    rows = eng.execute(
+        "select array_agg(case when n_regionkey = 0 then null "
+        "else n_regionkey end) from nation")
+    (vals,) = rows[0]
+    assert vals.count(None) == 5 and len(vals) == 25
+    rows = eng.execute(
+        "select array_agg(distinct n_regionkey order by n_regionkey) "
+        "from nation")
+    assert rows[0][0] == [0, 1, 2, 3, 4]
+
+
+def test_map_agg(eng):
+    rows = eng.execute("select map_agg(r_name, r_regionkey) from region")
+    assert rows[0][0] == {"AFRICA": 0, "AMERICA": 1, "ASIA": 2,
+                          "EUROPE": 3, "MIDDLE EAST": 4}
+
+
+def test_listagg_within_group(eng):
+    rows = eng.execute(
+        "select n_regionkey, listagg(n_name, '|') within group "
+        "(order by n_name desc) from nation "
+        "where n_regionkey = 1 group by n_regionkey")
+    assert rows[0][1] == "UNITED STATES|PERU|CANADA|BRAZIL|ARGENTINA"
+
+
+def test_varlen_agg_with_scalar_aggs_and_limit(eng):
+    rows = eng.execute(
+        "select c_nationkey, count(*) as cnt, "
+        "array_agg(c_name order by c_acctbal desc) "
+        "from customer group by c_nationkey order by c_nationkey limit 3")
+    assert len(rows) == 3
+    for nk, cnt, names in rows:
+        assert cnt == len(names)
+
+
+def test_varlen_agg_feeding_expression_rejected(eng):
+    with pytest.raises(Exception, match="variable-length|cardinality"):
+        eng.execute("select cardinality(array_agg(n_name)) from nation")
+
+
+# ---- JSON functions ---------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def json_eng():
+    from presto_tpu import Engine, types as T
+    from presto_tpu.connectors.memory import MemoryConnector
+    e = Engine()
+    mem = MemoryConnector()
+    docs = np.asarray(
+        ['{"a": 1, "b": {"c": "x"}, "arr": [1, 2]}',
+         '{"a": 2, "arr": [10, 20, 30]}',
+         'not json',
+         '{"b": {"c": "y"}, "flag": true}'], object)
+    mem.create_table("j", {"id": T.BIGINT, "doc": T.VARCHAR},
+                     {"id": np.arange(4), "doc": docs},
+                     {"id": None, "doc": None})
+    e.register_catalog("mem", mem)
+    e.session.catalog = "mem"
+    return e
+
+
+def test_json_extract_scalar(json_eng):
+    rows = json_eng.execute(
+        "select id, json_extract_scalar(doc, '$.a'), "
+        "json_extract_scalar(doc, '$.b.c'), "
+        "json_extract_scalar(doc, '$.flag'), "
+        "json_extract_scalar(doc, '$.arr[1]') from j order by id")
+    assert rows[0][1:] == ("1", "x", None, "2")
+    assert rows[1][1:] == ("2", None, None, "20")
+    assert rows[2][1:] == (None, None, None, None)  # malformed doc
+    assert rows[3][1:] == (None, "y", "true", None)
+
+
+def test_json_extract_and_lengths(json_eng):
+    rows = json_eng.execute(
+        "select id, json_extract(doc, '$.b'), json_array_length(doc), "
+        "json_size(doc, '$.arr') from j order by id")
+    assert rows[0][1] == '{"c":"x"}'
+    assert rows[0][3] == 2 and rows[1][3] == 3
+    # whole docs are objects, not arrays
+    assert all(r[2] is None for r in rows)
+
+
+def test_json_parse_format_roundtrip(json_eng):
+    rows = json_eng.execute(
+        "select json_format(json_parse(doc)) from j where id = 1")
+    assert rows[0][0] == '{"a": 2, "arr": [10, 20, 30]}'
+
+
+def test_aggregate_filter_clause(eng):
+    rows = eng.execute(
+        "select sum(n_nationkey) filter (where n_regionkey = 0), "
+        "count(*) filter (where n_regionkey = 1), count(*) from nation")
+    import numpy as np
+    tbl = eng.catalogs["tpch"].table("nation")
+    nk = np.asarray(tbl.columns["n_nationkey"].data)
+    rk = np.asarray(tbl.columns["n_regionkey"].data)
+    assert rows[0] == (int(nk[rk == 0].sum()), int((rk == 1).sum()), 25)
+
+
+def test_varlen_filter_clause(eng):
+    rows = eng.execute(
+        "select array_agg(n_name order by n_name) "
+        "filter (where n_regionkey = 1) from nation")
+    assert rows[0][0] == ["ARGENTINA", "BRAZIL", "CANADA", "PERU",
+                         "UNITED STATES"]
+    # FILTER that removes every row -> NULL (uninitialized accumulator)
+    rows = eng.execute(
+        "select map_agg(n_name, n_nationkey) "
+        "filter (where n_regionkey = 99) from nation")
+    assert rows[0][0] is None
+
+
+def test_order_by_rejected_outside_varlen(eng):
+    with pytest.raises(Exception, match="ORDER BY inside"):
+        eng.execute("select sum(n_nationkey order by n_name) from nation")
+    with pytest.raises(Exception, match="ORDER BY inside"):
+        eng.execute("select length(n_name order by n_name) from nation")
