@@ -61,8 +61,8 @@ def _chain_to(plan: N.PlanNode, target: N.Aggregate) -> list[N.PlanNode]:
                     raise NotImplementedError(
                         "variable-length aggregate results cannot feed "
                         "scalar expressions")
-        elif isinstance(node, (N.Sort, N.Limit)):
-            if isinstance(node, N.Sort) and any(
+        elif isinstance(node, (N.Sort, N.TopN, N.Limit)):
+            if isinstance(node, (N.Sort, N.TopN)) and any(
                     o.symbol in varlen_syms for o in node.orderings):
                 raise NotImplementedError(
                     "ORDER BY on a variable-length aggregate result")

@@ -22,6 +22,8 @@ from presto_tpu.plan import nodes as N
 
 
 def optimize(plan: N.PlanNode, engine) -> N.PlanNode:
+    from presto_tpu.plan.rules import apply_rules
+    plan = apply_rules(plan)
     plan = prune_columns(plan)
     plan = inline_trivial_projects(plan)
     return plan

@@ -249,6 +249,45 @@ class _Handler(JsonHandler):
         host = self.headers.get("Host", "localhost")
         return f"http://{host}"
 
+    def _metrics_text(self) -> str:
+        """Prometheus text exposition — the observability export the
+        reference provides through JMX+REST (/v1/jmx/mbean; here the
+        standard scrape format so any collector can consume it)."""
+        qs = list(self.manager.queries.values())
+        by_state: dict[str, int] = {}
+        dur_sum = 0.0
+        dur_count = 0
+        rows_sum = 0
+        for q in qs:
+            by_state[q.state] = by_state.get(q.state, 0) + 1
+            if q.finished is not None and q.started is not None:
+                dur_sum += q.finished - q.started
+                dur_count += 1
+                rows_sum += len(q.rows or [])
+        pool = self.manager.engine.memory_pool
+        lines = [
+            # per-state counts shrink when queries change state: gauge
+            "# TYPE presto_tpu_queries gauge",
+            *[f'presto_tpu_queries{{state="{s.lower()}"}} {n}'
+              for s, n in sorted(by_state.items())],
+            "# TYPE presto_tpu_query_duration_seconds summary",
+            f"presto_tpu_query_duration_seconds_sum {dur_sum:.6f}",
+            f"presto_tpu_query_duration_seconds_count {dur_count}",
+            "# TYPE presto_tpu_result_rows_total counter",
+            f"presto_tpu_result_rows_total {rows_sum}",
+            "# TYPE presto_tpu_memory_reserved_bytes gauge",
+            f"presto_tpu_memory_reserved_bytes {pool.reserved}",
+            "# TYPE presto_tpu_memory_capacity_bytes gauge",
+            f"presto_tpu_memory_capacity_bytes {pool.capacity}",
+            "# TYPE presto_tpu_compiled_programs gauge",
+            "presto_tpu_compiled_programs "
+            f"{len(self.manager.engine._program_cache)}",
+            "# TYPE presto_tpu_uptime_seconds gauge",
+            f"presto_tpu_uptime_seconds "
+            f"{time.time() - self.server_start:.1f}",
+        ]
+        return "\n".join(lines) + "\n"
+
     def _query_results(self, q: QueryInfo, token: int) -> dict:
         out: dict = {
             "id": q.query_id,
@@ -335,6 +374,15 @@ class _Handler(JsonHandler):
             return
         if self.path == "/v1/resourceGroup":
             self._send_json(self.manager.resource_groups.info())
+            return
+        if self.path == "/metrics":
+            body = self._metrics_text().encode()
+            self.send_response(200)
+            self.send_header("Content-Type",
+                             "text/plain; version=0.0.4; charset=utf-8")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
             return
         if self.path == "/v1/query":
             self._send_json([

@@ -1,0 +1,82 @@
+"""Iterative optimizer rules (plan/rules.py): plan-shape assertions plus
+oracle-checked end-to-end behavior."""
+
+import pytest
+
+from presto_tpu import Engine, types as T
+from presto_tpu.expr import ir
+from presto_tpu.plan import nodes as N
+from presto_tpu.plan.rules import apply_rules, simplify_expr
+
+
+@pytest.fixture(scope="module")
+def eng(tpch_tiny):
+    e = Engine()
+    e.register_catalog("tpch", tpch_tiny)
+    return e
+
+
+def _nodes(plan, cls):
+    out = []
+
+    def visit(n):
+        if isinstance(n, cls):
+            out.append(n)
+        for s in n.sources():
+            visit(s)
+
+    visit(plan)
+    return out
+
+
+def test_constant_folding():
+    two = ir.Literal(T.BIGINT, 2)
+    three = ir.Literal(T.BIGINT, 3)
+    e = simplify_expr(ir.Call(T.BIGINT, "add", (two, three)))
+    assert isinstance(e, ir.Literal) and e.value == 5
+    e = simplify_expr(ir.Call(T.BOOLEAN, "lt", (two, three)))
+    assert e.value is True
+    x = ir.ColumnRef(T.BOOLEAN, "x")
+    e = simplify_expr(ir.Call(
+        T.BOOLEAN, "and", (x, ir.Literal(T.BOOLEAN, True))))
+    assert e == x
+    e = simplify_expr(ir.Call(
+        T.BOOLEAN, "and", (x, ir.Literal(T.BOOLEAN, False))))
+    assert isinstance(e, ir.Literal) and e.value is False
+    e = simplify_expr(ir.Call(
+        T.BOOLEAN, "not", (ir.Call(T.BOOLEAN, "not", (x,)),)))
+    assert e == x
+
+
+def test_merge_filters_and_push_through_project(eng):
+    plan, _ = eng.plan_sql(
+        "select * from (select n_nationkey + 1 as k, n_name from nation) t "
+        "where k > 3 and k < 20")
+    # after rules, the predicate sits directly on the scan subtree; no
+    # Filter remains above any Project
+    for f in _nodes(plan, N.Filter):
+        assert not isinstance(f.source, N.Project)
+
+
+def test_sort_limit_becomes_topn(eng):
+    plan, _ = eng.plan_sql(
+        "select n_name from nation order by n_name limit 5")
+    assert _nodes(plan, N.TopN) and not _nodes(plan, N.Limit)
+
+
+def test_filter_true_removed(eng):
+    plan, _ = eng.plan_sql(
+        "select n_name from nation where 1 = 1 and n_nationkey >= 0")
+    for f in _nodes(plan, N.Filter):
+        assert not isinstance(f.predicate, ir.Literal)
+
+
+def test_rules_preserve_results(eng, oracle):
+    from presto_tpu.testing.oracle import assert_query
+    assert_query(eng, oracle,
+                 "select n_regionkey, count(*) from nation "
+                 "where 2 > 1 and n_nationkey + 0 >= 0 "
+                 "group by n_regionkey order by n_regionkey limit 3")
+    assert_query(eng, oracle,
+                 "select * from (select n_nationkey + 1 as k from nation) t "
+                 "where k between 3 and 7 order by k")

@@ -135,3 +135,72 @@ def test_web_ui_and_cluster_stats(server):
         stats = json.loads(resp.read())
     assert stats["totalQueries"] >= 1
     assert "runningQueries" in stats
+
+
+def test_metrics_endpoint(server, client):
+    client.execute("select count(*) from nation")
+    import urllib.request
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{server.port}/metrics") as r:
+        assert "text/plain" in r.headers["Content-Type"]
+        text = r.read().decode()
+    assert 'presto_tpu_queries{state="finished"}' in text
+    assert "presto_tpu_query_duration_seconds_sum" in text
+    assert "presto_tpu_memory_reserved_bytes" in text
+
+
+# ---- DB-API 2.0 driver (presto_tpu/dbapi.py) --------------------------
+
+
+def test_dbapi_roundtrip(server):
+    import presto_tpu.dbapi as dbapi
+    with dbapi.connect("127.0.0.1", server.port, user="tester") as conn:
+        cur = conn.cursor()
+        cur.execute("select n_name, n_nationkey from nation "
+                    "where n_regionkey = ? order by n_name limit ?",
+                    (1, 3))
+        assert [d[0] for d in cur.description] == ["n_name", "n_nationkey"]
+        assert cur.rowcount == 3
+        first = cur.fetchone()
+        assert first[0] == "ARGENTINA"
+        assert len(cur.fetchall()) == 2
+        assert cur.fetchone() is None
+
+
+def test_dbapi_param_quoting(server):
+    import presto_tpu.dbapi as dbapi
+    conn = dbapi.connect("127.0.0.1", server.port, user="tester")
+    cur = conn.cursor()
+    # a quoted literal containing ? must not consume a parameter; a
+    # string parameter with a quote must be escaped
+    cur.execute("select n_name from nation where n_name = ? "
+                "or n_name = 'who?'", ("O'BRIENLAND",))
+    assert cur.fetchall() == []
+    with __import__("pytest").raises(dbapi.ProgrammingError):
+        cur.execute("select 1", (1, 2))
+
+
+def test_dbapi_error_surface(server):
+    import presto_tpu.dbapi as dbapi
+    import pytest
+    conn = dbapi.connect("127.0.0.1", server.port, user="tester")
+    with pytest.raises(dbapi.DatabaseError):
+        conn.cursor().execute("select bogus_column from nation")
+
+
+def test_dbapi_comment_and_ident_handling(server):
+    import presto_tpu.dbapi as dbapi
+    import pytest
+    conn = dbapi.connect("127.0.0.1", server.port, user="tester")
+    cur = conn.cursor()
+    # apostrophe inside a comment must not break placeholder scanning
+    cur.execute("select n_name -- don't care\n from nation "
+                "where n_nationkey = ?", (3,))
+    assert cur.rowcount == 1
+    # leftover placeholder with no params fails client-side
+    with pytest.raises(dbapi.ProgrammingError, match="not enough"):
+        cur.execute("select 1 where 1 = ?")
+    # datetime.datetime binds are rejected loudly
+    import datetime
+    with pytest.raises(dbapi.NotSupportedError):
+        cur.execute("select ?", (datetime.datetime(2026, 7, 30, 12, 0),))
