@@ -67,6 +67,15 @@ class Connector:
         maySkipOutputDuplicates analog)."""
         return []
 
+    def partitioning(self, name: str) -> tuple[str, ...] | None:
+        """Connector-defined partitioning: the column set this table can
+        be hash-bucketed on at the source (reference
+        spi/connector/ConnectorNodePartitioningProvider +
+        TpchBucketFunction). The distributed executor shards such scans
+        by key hash instead of by row blocks, so joins/aggregations on
+        those keys skip the FIXED_HASH exchange entirely."""
+        return None
+
     def delete_rows(self, name: str, mask) -> int:
         """Delete rows where mask is true (None = all); returns the
         deleted count. Analog of spi row-level delete

@@ -463,6 +463,15 @@ class TpchConnector(Connector):
     def unique_keys(self, name: str) -> list[tuple[str, ...]]:
         return list(self._UNIQUE_KEYS.get(name, []))
 
+    # orders and lineitem bucket by orderkey, exactly the reference's
+    # tpch partitioning (plugin/trino-tpch TpchNodePartitioningProvider
+    # + TpchBucketFunction): the orderkey join/group never reshuffles
+    _PARTITIONING = {"orders": ("o_orderkey",),
+                     "lineitem": ("l_orderkey",)}
+
+    def partitioning(self, name: str) -> tuple[str, ...] | None:
+        return self._PARTITIONING.get(name)
+
     # Scale-free distinct-value counts from the TPC-H spec (the analog of
     # the reference's shipped tpch column statistics,
     # plugin/trino-tpch/src/main/resources/tpch/statistics).

@@ -271,7 +271,7 @@ class Engine:
             conn = self._connector(catalog)
             self.transactions.touch(conn)
             result = self._execute_query(stmt.query, mesh)
-            schema, data, valid = _table_to_host(result)
+            schema, data, valid = _table_to_host(result, self)
             conn.create_table(table, schema, data, valid)
             return [(len(next(iter(data.values()), [])),)]
 
@@ -282,7 +282,7 @@ class Engine:
             conn = self._connector(catalog)
             self.transactions.touch(conn)
             result = self._execute_query(stmt.query, mesh)
-            schema, data, valid = _table_to_host(result)
+            schema, data, valid = _table_to_host(result, self)
             target = conn.table_schema(table)
             names = stmt.columns or list(target)
             renamed = {t: d for t, d in zip(names, data.values())}
@@ -325,7 +325,7 @@ class Engine:
             q = A.Query(A.QuerySpec(tuple(items), False,
                                     A.TableRef(stmt.table)))
             result = self._execute_query(q, mesh)
-            _, data, valid = _table_to_host(result)
+            _, data, valid = _table_to_host(result, self)
             mask = np.asarray(data["__pred__"], dtype=bool)
             values = {col: data[col] for col, _ in stmt.assignments}
             valids = {col: valid[col] for col, _ in stmt.assignments}
@@ -395,23 +395,53 @@ def _literal_value(e):
     raise ValueError("SET SESSION value must be a literal")
 
 
-def _table_to_host(table: Table):
+# one writer task per this many result cells (rows x columns); the task
+# count grows with produced data up to the pool bound — the scaled-
+# writers policy (reference ScaledWriterScheduler.java +
+# SCALED_WRITER_DISTRIBUTION), applied to this engine's write-side
+# bottleneck: device->host materialization and decode of result columns
+WRITER_SCALING_CELLS = 1 << 20
+WRITER_MAX_TASKS = 8
+
+
+def _table_to_host(table: Table, engine=None):
     """Result Table -> (schema, host column arrays, validity masks) for
     connector writes. VARCHAR decodes to strings; other types keep their
     physical values (decimals stay scaled, matching column_from_numpy's
-    contract)."""
+    contract). Large results convert with a scaled pool of writer
+    tasks (one per column batch)."""
     schema: dict[str, T.DataType] = {}
     data: dict[str, np.ndarray] = {}
     valid: dict[str, np.ndarray | None] = {}
     mask = (np.ones(table.nrows, dtype=bool) if table.mask is None
             else np.asarray(table.mask))
-    for name, col in table.columns.items():
-        schema[name] = col.dtype
+
+    def convert(item):
+        name, col = item
         raw = np.asarray(col.data)[mask]
         if isinstance(col.dtype, T.VarcharType):
-            data[name] = _decode_column(col.dtype, raw, col.dictionary)
+            out = _decode_column(col.dtype, raw, col.dictionary)
         else:
-            data[name] = raw
-        valid[name] = (None if col.valid is None
-                       else np.asarray(col.valid)[mask])
+            out = raw
+        v = None if col.valid is None else np.asarray(col.valid)[mask]
+        return name, col.dtype, out, v
+
+    cells = table.nrows * max(len(table.columns), 1)
+    writers = min(WRITER_MAX_TASKS,
+                  max(1, cells // WRITER_SCALING_CELLS))
+    items = list(table.columns.items())
+    if writers > 1 and len(items) > 1:
+        from concurrent.futures import ThreadPoolExecutor
+        with ThreadPoolExecutor(max_workers=writers) as pool:
+            results = list(pool.map(convert, items))
+    else:
+        writers = 1
+        results = [convert(i) for i in items]
+    if engine is not None:
+        engine.last_write = {"writer_tasks": writers,
+                             "rows": int(mask.sum())}
+    for name, dtype, out, v in results:
+        schema[name] = dtype
+        data[name] = out
+        valid[name] = v
     return schema, data, valid

@@ -42,6 +42,28 @@ class ScanInput:
     # Per-execution temporaries (spill partitions, match-recognize
     # carriers) would pollute the pin cache with 0%-hit entries.
     cache_device: bool = False
+    # connector-defined partitioning mapped to scan SYMBOLS (set when
+    # every partitioning column is scanned); the distributed executor
+    # bucket-shards such scans so co-partitioned joins skip exchanges
+    part_cols: tuple[str, ...] | None = None
+    # set by execute_plan_distributed when this scan was actually
+    # bucket-sharded (scan rows placed by key hash, not blocks)
+    bucketed: bool = False
+
+
+def partitioning_symbols(connector, node: "N.TableScan"
+                         ) -> tuple[str, ...] | None:
+    """Connector-declared partitioning mapped to this scan's symbols,
+    or None when undeclared / not fully scanned. Duck-typed: worker-side
+    buffer connectors don't subclass the SPI base."""
+    declared = getattr(connector, "partitioning", lambda _n: None)(
+        node.table)
+    if not declared:
+        return None
+    by_col = {c: s for s, c in node.assignments.items()}
+    if not all(c in by_col for c in declared):
+        return None
+    return tuple(by_col[c] for c in declared)
 
 
 def collect_scans(plan: N.PlanNode, engine) -> list[ScanInput]:
@@ -64,8 +86,10 @@ def collect_scans(plan: N.PlanNode, engine) -> list[ScanInput]:
                 # table-level row mask (padded exchange buffers ship a
                 # dead row so empty relations keep static shape >= 1)
                 arrays["__live__"] = np.asarray(tbl.mask)
-            out.append(ScanInput(node, arrays, dicts, types, tbl.nrows,
-                                 cache_device=True))
+            out.append(ScanInput(
+                node, arrays, dicts, types, tbl.nrows,
+                cache_device=True,
+                part_cols=partitioning_symbols(connector, node)))
         for s in node.sources():
             visit(s)
 
@@ -366,11 +390,18 @@ def execute_plan(engine, plan: N.PlanNode) -> Table:
     streamed = try_execute_streamed(engine, plan)
     if streamed is not None:
         return streamed
-    # the memory budget (host-partitioned spill) outranks compile-time
-    # segmentation: an over-budget join must not device-OOM mid-segment
+    # the memory budget (host-partitioned spill) outranks both grouped
+    # execution and compile-time segmentation: an over-budget join must
+    # not device-OOM mid-bucket
     spilled = try_execute_spilled(engine, plan)
     if spilled is not None:
         return spilled
+    # grouped execution (lifespans): explicit opt-in, bucket-by-bucket
+    # joins over co-bucketed tables
+    from presto_tpu.exec.spill import try_execute_grouped
+    grouped = try_execute_grouped(engine, plan)
+    if grouped is not None:
+        return grouped
     if _count_joins(plan) > MAX_JOINS_PER_PROGRAM:
         return _execute_segmented(engine, plan)
     scan_inputs = collect_scans(plan, engine)

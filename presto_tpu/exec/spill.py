@@ -349,7 +349,25 @@ def try_execute_spilled(engine, plan: N.PlanNode):
                 f"has no spillable join on its root chain")
         node = srcs[0]
 
-    from presto_tpu.exec.executor import execute_plan, run_plan
+    nparts = max(2, next_pow2(-(-total // budget)))
+    if nparts > 64:
+        raise MemoryLimitExceeded(
+            f"query estimated {total} bytes cannot be bounded by "
+            f"query_max_memory_bytes={budget} within 64 spill "
+            f"partitions")
+    merged, build_rows = _partitioned_join_exec(engine, join, nparts)
+    engine.last_spill = {"partitions": nparts,
+                         "build_rows": build_rows,
+                         "estimated_bytes": total, "budget": budget}
+    return _resume_above_join(engine, plan, join, merged)
+
+
+def _partitioned_join_exec(engine, join: N.Join, nparts: int):
+    """Materialize both join sides to host, hash-partition by the join
+    keys, and run the per-partition join with one compiled program.
+    Shared by the memory-pressure spill path and grouped execution
+    (lifespans): the only difference is what decides ``nparts``."""
+    from presto_tpu.exec.executor import execute_plan
 
     in_spill_before = getattr(engine, "_in_spill", False)
     engine._in_spill = True
@@ -359,12 +377,6 @@ def try_execute_spilled(engine, plan: N.PlanNode):
     finally:
         engine._in_spill = in_spill_before
 
-    nparts = max(2, next_pow2(-(-total // budget)))
-    if nparts > 64:
-        raise MemoryLimitExceeded(
-            f"query estimated {total} bytes cannot be bounded by "
-            f"query_max_memory_bytes={budget} within 64 spill "
-            f"partitions")
     lkeys = [lk for lk, _ in join.criteria]
     rkeys = [rk for _, rk in join.criteria]
     ph, pvalid = _value_hash(probe_tbl, lkeys)
@@ -414,12 +426,63 @@ def try_execute_spilled(engine, plan: N.PlanNode):
              for s, t in join.output_types().items()}, 0, None)
     else:
         merged = _concat_tables(outs)
-    engine.last_spill = {"partitions": nparts,
-                         "build_rows": build_tbl.nrows,
-                         "estimated_bytes": total, "budget": budget}
+    return merged, build_tbl.nrows
 
+
+def _resume_above_join(engine, plan, join, merged: Table):
+    from presto_tpu.exec.executor import run_plan
+    from presto_tpu.exec.streaming import _replace_node
     carrier_node, carrier_input = _carrier_scan("__joined__",
                                                 _compact(merged))
-    from presto_tpu.exec.streaming import _replace_node
     rest = _replace_node(plan, join, carrier_node)
     return run_plan(engine, rest, [carrier_input])
+
+
+# --- grouped execution (lifespans) -----------------------------------------
+
+
+def _bucketed_keys(engine, node):
+    """Connector-declared partitioning symbols for a Filter*/TableScan
+    subtree, or None."""
+    from presto_tpu.exec.executor import partitioning_symbols
+    while isinstance(node, N.Filter):
+        node = node.source
+    if not isinstance(node, N.TableScan):
+        return None
+    conn = engine.catalogs.get(node.catalog)
+    if conn is None:
+        return None
+    return partitioning_symbols(conn, node)
+
+
+def try_execute_grouped(engine, plan):
+    """Grouped execution over co-bucketed tables: when both sides of a
+    root-chain join are scans of tables whose connector-defined
+    partitioning IS the join key, execute the join bucket-by-bucket so
+    peak memory is one bucket's working set — the lifespans model
+    (reference execution/Lifespan.java:26 +
+    scheduler/group/LifespanScheduler.java, StageExecutionDescriptor
+    grouped execution), opted in via the grouped_execution session
+    property."""
+    if not engine.session.get("grouped_execution"):
+        return None
+    node = plan
+    while True:
+        if isinstance(node, N.Join) and node.criteria:
+            lkeys = tuple(lk for lk, _ in node.criteria)
+            rkeys = tuple(rk for _, rk in node.criteria)
+            if (_bucketed_keys(engine, node.left) == lkeys
+                    and _bucketed_keys(engine, node.right) == rkeys):
+                nparts = max(1, int(
+                    engine.session.get("grouped_execution_partitions")))
+                merged, build_rows = _partitioned_join_exec(
+                    engine, node, nparts)
+                engine.last_grouped = {"partitions": nparts,
+                                       "build_rows": build_rows,
+                                       "keys": list(lkeys)}
+                return _resume_above_join(engine, plan, node, merged)
+            return None
+        srcs = node.sources()
+        if len(srcs) != 1:
+            return None
+        node = srcs[0]

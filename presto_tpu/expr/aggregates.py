@@ -63,6 +63,10 @@ class AggCall:
 VAR_FNS = frozenset({"variance", "var_samp", "var_pop",
                      "stddev", "stddev_samp", "stddev_pop"})
 BOOL_FNS = frozenset({"bool_and", "bool_or", "every"})
+# central-moments family: skewness/kurtosis carry (count, sum, m2, m3,
+# m4) states (reference CentralMomentsAggregation /
+# AggregationUtils.mergeCentralMomentsState)
+MOMENT_FNS = frozenset({"skewness", "kurtosis"})
 # bivariate co-moment family (reference CentralMomentsAggregation /
 # CorrelationAggregation / CovarianceAggregation / RegressionAggregation):
 # SQL shape fn(y, x), all DOUBLE-valued, rows with a NULL in either
@@ -92,7 +96,7 @@ PCT_K = 1024
 def output_type(fn: str, arg_type: T.DataType | None) -> T.DataType:
     if fn in ("count", "count_star", "count_if"):
         return T.BIGINT
-    if fn in VAR_FNS or fn == "geometric_mean":
+    if fn in VAR_FNS or fn in MOMENT_FNS or fn == "geometric_mean":
         return T.DOUBLE
     if fn in BOOL_FNS:
         return T.BOOLEAN
@@ -148,8 +152,8 @@ def state_type(call: "AggCall", field: str) -> T.DataType:
         if call.fn in BY_FNS:  # extremum of the comparison key (arg2)
             return call.arg2.dtype
         return call.arg.dtype if call.arg is not None else call.dtype
-    if field in ("m2", "sumlog", "sumx", "sumy", "cxy", "m2x", "m2y",
-                 "rval"):
+    if field in ("m2", "m3", "m4", "sumlog", "sumx", "sumy", "cxy",
+                 "m2x", "m2y", "rval"):
         return T.DOUBLE
     if field in ("regs", "rhash"):
         return T.BIGINT  # nominal: arrays carry their real dtype
@@ -172,6 +176,8 @@ def state_fields(fn: str) -> list[str]:
         return ["val", "count"]
     if fn in VAR_FNS:
         return ["count", "sum", "m2"]
+    if fn in MOMENT_FNS:
+        return ["count", "sum", "m2", "m3", "m4"]
     if fn == "geometric_mean":
         return ["count", "sumlog"]
     if fn == "approx_distinct":
@@ -226,7 +232,8 @@ def prepare_arg(fn: str, data, arg_type: T.DataType | None):
         if isinstance(arg_type, T.DecimalType):
             x = x / arg_type.unscale_factor
         return x
-    if fn not in VAR_FNS and fn != "geometric_mean":
+    if (fn not in VAR_FNS and fn not in MOMENT_FNS
+            and fn != "geometric_mean"):
         return data
     x = data.astype(jnp.float64)
     if isinstance(arg_type, T.DecimalType):
@@ -414,6 +421,19 @@ def fold(fn: str, data, weight, slots, capacity: int, *,
         m2 = segred.segment_sum(jnp.where(w, d * d, z), slots,
                                  num_segments=capacity)
         return {"count": c, "sum": s, "m2": m2}
+    if fn in MOMENT_FNS:
+        # exact two-pass central moments about the group mean
+        z = jnp.zeros((), jnp.float64)
+        c = segred.segment_sum(w.astype(jnp.int64), slots,
+                                num_segments=capacity)
+        s = segred.segment_sum(jnp.where(w, data, z), slots,
+                                num_segments=capacity)
+        mean = s / jnp.maximum(c, 1).astype(jnp.float64)
+        d = data - mean[slots]
+        seg = lambda v: segred.segment_sum(  # noqa: E731
+            jnp.where(w, v, z), slots, num_segments=capacity)
+        return {"count": c, "sum": s, "m2": seg(d * d),
+                "m3": seg(d * d * d), "m4": seg(d * d * d * d)}
     if fn == "geometric_mean":
         z = jnp.zeros((), jnp.float64)
         return {
@@ -430,7 +450,7 @@ def fold(fn: str, data, weight, slots, capacity: int, *,
 SCAN_FNS = (frozenset({"count", "count_star", "count_if", "sum", "avg",
                        "min", "max", "arbitrary", "geometric_mean",
                        "checksum"})
-            | VAR_FNS | BOOL_FNS | COVAR_FNS | BY_FNS)
+            | VAR_FNS | MOMENT_FNS | BOOL_FNS | COVAR_FNS | BY_FNS)
 
 
 def scan_fold(fn: str, data, weight, sg, *, data2=None, data_valid=None,
@@ -478,6 +498,17 @@ def scan_fold(fn: str, data, weight, sg, *, data2=None, data_valid=None,
         d = data - mean
         m2 = S.seg_sum(jnp.where(w, d * d, z64), sg)
         return {"count": c, "sum": s, "m2": m2}
+    if fn in MOMENT_FNS:
+        c = S.seg_sum(w.astype(jnp.int64), sg)
+        s = S.seg_sum(jnp.where(w, data, z64), sg)
+        tot_c = S.broadcast_last(c, sg)
+        tot_s = S.broadcast_last(s, sg)
+        mean = tot_s / jnp.maximum(tot_c, 1).astype(jnp.float64)
+        d = data - mean
+        return {"count": c, "sum": s,
+                "m2": S.seg_sum(jnp.where(w, d * d, z64), sg),
+                "m3": S.seg_sum(jnp.where(w, d * d * d, z64), sg),
+                "m4": S.seg_sum(jnp.where(w, d * d * d * d, z64), sg)}
     if fn == "geometric_mean":
         return {"count": S.seg_sum(w.astype(jnp.int64), sg),
                 "sumlog": S.seg_sum(jnp.where(w, data, z64), sg)}
@@ -544,6 +575,30 @@ def scan_merge(fn: str, states: dict, live, sg):
                                  + n_i.astype(jnp.float64) * dev * dev,
                                  z64), sg)
         return {"count": n, "sum": s, "m2": m2}
+    if fn in MOMENT_FNS:
+        # shifted-moment identities (binomial expansion about the total
+        # mean; the odd terms vanish because sum(x - mean_i) = 0):
+        #   M3 += 3*d*M2_i + n_i*d^3;  M4 += 4*d*M3_i + 6*d^2*M2_i
+        #   + n_i*d^4 — the k-way generalization of the reference's
+        #   pairwise mergeCentralMomentsState
+        n_i = jnp.where(w, states["count"], 0)
+        s_i = jnp.where(w, states["sum"], z64)
+        n = S.seg_sum(n_i, sg)
+        s = S.seg_sum(s_i, sg)
+        mean_tot = (S.broadcast_last(s, sg)
+                    / jnp.maximum(S.broadcast_last(n, sg), 1
+                                  ).astype(jnp.float64))
+        mean_i = s_i / jnp.maximum(n_i, 1).astype(jnp.float64)
+        d = mean_i - mean_tot
+        nf = n_i.astype(jnp.float64)
+        m2_i = jnp.where(w, states["m2"], z64)
+        m3_i = jnp.where(w, states["m3"], z64)
+        m4_i = jnp.where(w, states["m4"], z64)
+        return {"count": n, "sum": s,
+                "m2": S.seg_sum(m2_i + nf * d * d, sg),
+                "m3": S.seg_sum(m3_i + 3 * d * m2_i + nf * d**3, sg),
+                "m4": S.seg_sum(m4_i + 4 * d * m3_i + 6 * d * d * m2_i
+                                + nf * d**4, sg)}
     if fn == "geometric_mean":
         return {"count": S.seg_sum(jnp.where(w, states["count"], 0), sg),
                 "sumlog": S.seg_sum(
@@ -697,6 +752,26 @@ def merge(fn: str, states: dict, slots, capacity: int, live):
             + n_i.astype(jnp.float64) * dev * dev,
             slots, num_segments=capacity)
         return {"count": n, "sum": s, "m2": m2}
+    if fn in MOMENT_FNS:
+        z = jnp.zeros((), jnp.float64)
+        n_i = jnp.where(w, states["count"], 0)
+        s_i = jnp.where(w, states["sum"], z)
+        n = segred.segment_sum(n_i, slots, num_segments=capacity)
+        s = segred.segment_sum(s_i, slots, num_segments=capacity)
+        mean_tot = s / jnp.maximum(n, 1).astype(jnp.float64)
+        mean_i = s_i / jnp.maximum(n_i, 1).astype(jnp.float64)
+        d = mean_i - mean_tot[slots]
+        nf = n_i.astype(jnp.float64)
+        m2_i = jnp.where(w, states["m2"], z)
+        m3_i = jnp.where(w, states["m3"], z)
+        m4_i = jnp.where(w, states["m4"], z)
+        seg = lambda v: segred.segment_sum(  # noqa: E731
+            v, slots, num_segments=capacity)
+        return {"count": n, "sum": s,
+                "m2": seg(m2_i + nf * d * d),
+                "m3": seg(m3_i + 3 * d * m2_i + nf * d**3),
+                "m4": seg(m4_i + 4 * d * m3_i + 6 * d * d * m2_i
+                          + nf * d**4)}
     if fn == "geometric_mean":
         z = jnp.zeros((), jnp.float64)
         return {
@@ -807,6 +882,20 @@ def finalize(fn: str, states: dict, out_type: T.DataType,
         if fn.startswith("stddev"):
             return jnp.sqrt(var), ok
         return var, ok
+    if fn in MOMENT_FNS:
+        # reference CentralMomentsAggregation.java:55-87 exactly
+        c = states["count"]
+        nf = c.astype(jnp.float64)
+        m2 = states["m2"]
+        if fn == "skewness":
+            denom = jnp.maximum(m2, 1e-300) ** 1.5
+            return jnp.sqrt(nf) * states["m3"] / denom, c > 2
+        m4 = states["m4"]
+        d23 = jnp.maximum((nf - 2) * (nf - 3), 1.0)
+        val = ((nf - 1) * nf * (nf + 1)) / d23 * m4 \
+            / jnp.maximum(m2 * m2, 1e-300) \
+            - 3 * ((nf - 1) * (nf - 1)) / d23
+        return val, c > 3
     if fn == "geometric_mean":
         c = states["count"]
         safe = jnp.maximum(c, 1).astype(jnp.float64)

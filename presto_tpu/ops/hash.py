@@ -421,3 +421,49 @@ def expand_matches(lo, counts, build_sidx, probe_found,
 
 def next_pow2(x: int) -> int:
     return 1 << max(int(x) - 1, 1).bit_length()
+
+
+# --- numpy twins (host-side, exact same bit pattern) -----------------------
+# Scan bucketing for connector-defined partitioning happens on host
+# before shard placement; it must land rows on the SAME shard as the
+# device repartition kernel would, so co-partitioned scans and
+# FIXED_HASH exchange outputs are mutually co-located. Tested equal in
+# tests/test_connector_partitioning.py.
+
+
+def np_splitmix64(x: np.ndarray) -> np.ndarray:
+    x = x.astype(np.uint64, copy=True)
+    with np.errstate(over="ignore"):
+        x += np.uint64(0x9E3779B97F4A7C15)
+        x = (x ^ (x >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
+        x = (x ^ (x >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
+        x = x ^ (x >> np.uint64(31))
+    return x
+
+
+def np_hash_int_column(data: np.ndarray, valid=None) -> np.ndarray:
+    h = np_splitmix64(np.asarray(data).astype(np.int64).view(np.uint64))
+    if valid is not None:
+        h = np.where(valid, h, np.uint64(0x9E3779B97F4A7C15))
+    return h
+
+
+def np_hash_string_column(codes, dictionary, valid=None) -> np.ndarray:
+    lut = hash_string_dictionary(dictionary)
+    codes = np.asarray(codes)
+    if len(dictionary) == 0:
+        h = np.zeros(codes.shape, dtype=np.uint64)
+    else:
+        h = lut[np.clip(codes, 0, len(dictionary) - 1)]
+    if valid is not None:
+        h = np.where(valid, h, np.uint64(0x9E3779B97F4A7C15))
+    return h
+
+
+def np_combine_hashes(hashes: list) -> np.ndarray:
+    out = hashes[0]
+    with np.errstate(over="ignore"):
+        for h in hashes[1:]:
+            out = np_splitmix64(out * np.uint64(0x100000001B3) ^ h)
+    return np.where(out == np.uint64(0xFFFFFFFFFFFFFFFF),
+                    out - np.uint64(1), out)

@@ -56,6 +56,14 @@ REPLICATED = "replicated"
 class DistTable:
     dt: DTable
     dist: str  # SHARDED (rows split over AXIS) | REPLICATED
+    # when SHARDED: the symbol tuple this distribution is hash-
+    # partitioned on (rows with equal key tuples co-located), or None
+    # for block/round-robin sharding. Set by bucket-sharded scans
+    # (connector-defined partitioning) and FIXED_HASH exchanges; lets
+    # joins/aggregations on the same keys skip the exchange (reference
+    # ConnectorNodePartitioningProvider + AddExchanges partitioning
+    # matching).
+    part: tuple[str, ...] | None = None
 
 
 def _gather(dt: DTable, nshards: int) -> DTable:
@@ -131,7 +139,7 @@ class ShardedInterpreter:
         if self.dyn_filters:
             dt = PlanInterpreter._apply_dyn_filters(self, out.dt)
             if dt is not out.dt:
-                out = DistTable(dt, out.dist)
+                out = DistTable(dt, out.dist, out.part)
         if self.collect_counts:
             # mesh-global live rows out of this node: per-shard count
             # psum'd so the total is replicated (for a REPLICATED
@@ -194,6 +202,12 @@ class ShardedInterpreter:
                 for sym, v in dt.cols.items()}
         return DTable(cols, valid, self.nshards * cap)
 
+    def _co_located(self, side: "DistTable", keys: list[str]) -> bool:
+        """True when ``side`` is already hash-partitioned on exactly the
+        join/group keys (connector bucketing or an earlier FIXED_HASH
+        exchange on the same hash family) — the exchange is a no-op."""
+        return side.part is not None and side.part == tuple(keys)
+
     def _join_partitioned(self, node: N.Join) -> bool:
         """Broadcast-vs-partitioned distribution choice, analog of the
         reference's DetermineJoinDistributionType (AUTOMATIC mode uses
@@ -224,7 +238,9 @@ class ShardedInterpreter:
         # traced arrays are the local shard; live mask from row padding
         local_n = next(iter(traced.values())).shape[0]
         live = traced["__live__"]
-        return DistTable(DTable(cols, live, local_n), SHARDED)
+        part = (scan.part_cols
+                if getattr(scan, "bucketed", False) else None)
+        return DistTable(DTable(cols, live, local_n), SHARDED, part)
 
     def _r_values(self, node: N.Values) -> DistTable:
         dt = PlanInterpreter({}, {})._r_values(node)
@@ -234,12 +250,23 @@ class ShardedInterpreter:
 
     def _r_filter(self, node: N.Filter) -> DistTable:
         src = self.run(node.source)
-        return DistTable(OP.apply_filter(src.dt, node.predicate), src.dist)
+        return DistTable(OP.apply_filter(src.dt, node.predicate),
+                         src.dist, src.part)
 
     def _r_project(self, node: N.Project) -> DistTable:
+        from presto_tpu.expr import ir as _ir
         src = self.run(node.source)
+        part = None
+        if src.part is not None:
+            # follow the partition keys through identity renames; a key
+            # not projected (or transformed) loses the co-location fact
+            renames = {e.name: s for s, e in node.assignments.items()
+                       if isinstance(e, _ir.ColumnRef)}
+            mapped = tuple(renames.get(k) for k in src.part)
+            if all(m is not None for m in mapped):
+                part = mapped
         return DistTable(OP.apply_project(src.dt, node.assignments),
-                         src.dist)
+                         src.dist, part)
 
     # -- aggregation: partial local, merge replicated -----------------------
 
@@ -255,6 +282,19 @@ class ShardedInterpreter:
             if node.group_keys:
                 self._note_ok(node, ok)
             return DistTable(out, REPLICATED)
+        if (node.group_keys and src.part is not None
+                and set(src.part) <= set(node.group_keys)
+                and node.step == N.AggStep.SINGLE):
+            # equal group tuples are already co-located (connector
+            # bucketing / prior exchange on a subset of the keys):
+            # aggregate locally, output stays SHARDED — no partial/final
+            # split, no exchange (reference AddExchanges partitioning
+            # matching on pre-partitioned tables)
+            ccap = self._capacity(
+                node, next_pow2(min(2 * src.dt.n, 1 << 22)), override=ov)
+            out, ok = OP.apply_aggregate(src.dt, node, ccap)
+            self._note_ok(node, ok)
+            return DistTable(out, SHARDED, src.part)
         cap = (1 if not node.group_keys else
                self._capacity(node, next_pow2(min(2 * src.dt.n, 1 << 22)),
                               override=ov))
@@ -295,7 +335,7 @@ class ShardedInterpreter:
                 "final", override=ov)
             out, ok2 = OP.apply_aggregate(ex, final_node, fcap)
             self._note_ok(node, ok2, "final")
-            return DistTable(out, SHARDED)
+            return DistTable(out, SHARDED, tuple(node.group_keys))
         gathered = _gather(partial, self.nshards)
         fcap = (1 if not node.group_keys else
                 self._capacity(node, next_pow2(2 * cap), "final",
@@ -317,14 +357,22 @@ class ShardedInterpreter:
         left = self.run(node.left)
         lkeys = [lk for lk, _ in node.criteria]
         rkeys = [rk for _, rk in node.criteria]
+        out_part = left.part
         if (node.criteria and left.dist == SHARDED
                 and right.dist == SHARDED and self._join_partitioned(node)):
             # FIXED_HASH: repartition both sides by join-key hash so each
             # shard joins only its key range — per-device build memory is
             # O(build/nshards) instead of O(build)
-            # (AddExchanges.java:245 partitionedExchange)
-            probe = self._repart(left.dt, lkeys, node, "probe_exch")
-            build = self._repart(right.dt, rkeys, node, "build_exch")
+            # (AddExchanges.java:245 partitionedExchange). A side already
+            # partitioned on its keys skips its exchange (connector
+            # bucketing / reused exchange, AddExchanges partitioning
+            # matching)
+            probe = (left.dt if self._co_located(left, lkeys)
+                     else self._repart(left.dt, lkeys, node, "probe_exch"))
+            build = (right.dt if self._co_located(right, rkeys)
+                     else self._repart(right.dt, rkeys, node,
+                                       "build_exch"))
+            out_part = tuple(lkeys)
             # per-shard table: must NOT pick up the planner's global-sized
             # capacity hint (kind "ptable" skips it)
             tab_kind, out_kind = "ptable", "pout"
@@ -341,14 +389,14 @@ class ShardedInterpreter:
         if node.build_unique:
             out, ok = OP.apply_join(probe, build, node, cap)
             self._note_ok(node, ok, tab_kind)
-            return DistTable(out, left.dist)
+            return DistTable(out, left.dist, out_part)
         out_cap = self._capacity(
             node, next_pow2(2 * (probe.n + build.n)), out_kind)
         out, t_ok, o_ok = OP.apply_expand_join(probe, build, node, cap,
                                                out_cap)
         self._note_ok(node, t_ok, tab_kind)
         self._note_ok(node, o_ok, out_kind)
-        return DistTable(out, left.dist)
+        return DistTable(out, left.dist, out_part)
 
     def _r_semijoin(self, node: N.SemiJoin) -> DistTable:
         src = self.run(node.source)
@@ -356,14 +404,15 @@ class ShardedInterpreter:
         cap = self._capacity(node, next_pow2(2 * filt.n))
         out, ok = OP.apply_semijoin(src.dt, filt, node, cap)
         self._note_ok(node, ok)
-        return DistTable(out, src.dist)
+        return DistTable(out, src.dist, src.part)
 
     def _r_crossjoin(self, node: N.CrossJoin) -> DistTable:
         left = self.run(node.left)
         right = self.replicated(node.right)
         if not node.scalar:
             raise NotImplementedError("general cross join")
-        return DistTable(OP.apply_cross_scalar(left.dt, right), left.dist)
+        return DistTable(OP.apply_cross_scalar(left.dt, right),
+                         left.dist, left.part)
 
     # -- replicated-only operators ------------------------------------------
 
@@ -387,13 +436,19 @@ class ShardedInterpreter:
         src = self.run(node.source)
         if src.dist == SHARDED:
             # global mark correctness needs co-located key tuples:
-            # FIXED_HASH repartition by the distinct keys first
-            ex = self._repart(src.dt, node.keys, node, "mark_exch")
+            # FIXED_HASH repartition by the distinct keys first (skipped
+            # when the input is already partitioned on a key subset)
+            if src.part is not None and set(src.part) <= set(node.keys):
+                ex = src.dt
+                out_part = src.part
+            else:
+                ex = self._repart(src.dt, node.keys, node, "mark_exch")
+                out_part = tuple(node.keys)
             cap = self._capacity(
                 node, next_pow2(min(2 * ex.n, 1 << 22)))
             out, ok = OP.apply_mark_distinct(ex, node, cap)
             self._note_ok(node, ok)
-            return DistTable(out, SHARDED)
+            return DistTable(out, SHARDED, out_part)
         cap = self._capacity(
             node, next_pow2(min(2 * src.dt.n, 1 << 22)))
         out, ok = OP.apply_mark_distinct(src.dt, node, cap)
@@ -406,10 +461,16 @@ class ShardedInterpreter:
             # FIXED_HASH repartition by the window partition keys, then
             # each shard computes its partitions independently and the
             # output STAYS SHARDED (reference AddExchanges partitioned
-            # WindowNode + operator/WindowOperator.java:70)
+            # WindowNode + operator/WindowOperator.java:70). A
+            # co-partitioned input skips the exchange.
+            if src.part is not None and set(src.part) <= set(
+                    node.partition_by):
+                return DistTable(OP.apply_window(src.dt, node),
+                                 SHARDED, src.part)
             ex = self._repart(src.dt, node.partition_by, node,
                               "win_exch")
-            return DistTable(OP.apply_window(ex, node), SHARDED)
+            return DistTable(OP.apply_window(ex, node), SHARDED,
+                             tuple(node.partition_by))
         dt = (src.dt if src.dist == REPLICATED
               else _gather(src.dt, self.nshards))
         return DistTable(OP.apply_window(dt, node), REPLICATED)
@@ -495,15 +556,90 @@ class ShardedInterpreter:
             REPLICATED)
 
 
-def _shard_scan_arrays(scan: ScanInput, nshards: int):
-    """Pad rows to a multiple of nshards; returns arrays + live mask."""
+def _plan_exploits_partitioning(plan: N.PlanNode,
+                                part: tuple[str, ...]) -> bool:
+    """True when some plan operator could skip an exchange because its
+    keys match ``part`` (join side, aggregate/window/mark-distinct key
+    superset)."""
+    found = False
+
+    def visit(node):
+        nonlocal found
+        if found:
+            return
+        if isinstance(node, N.Join) and node.criteria:
+            if (tuple(lk for lk, _ in node.criteria) == part
+                    or tuple(rk for _, rk in node.criteria) == part):
+                found = True
+        elif isinstance(node, N.Aggregate) and node.group_keys:
+            if set(part) <= set(node.group_keys):
+                found = True
+        elif isinstance(node, N.Window) and node.partition_by:
+            if set(part) <= set(node.partition_by):
+                found = True
+        elif isinstance(node, N.MarkDistinct):
+            if set(part) <= set(node.keys):
+                found = True
+        for s in node.sources():
+            visit(s)
+
+    visit(plan)
+    return found
+
+
+def _shard_scan_arrays(scan: ScanInput, nshards: int,
+                       bucketed: bool = False):
+    """Rows split over shards; returns arrays + live mask.
+
+    Default split is contiguous blocks padded to a multiple of
+    nshards. With ``bucketed`` (connector-defined partitioning), rows
+    place by key-hash bucket — the exact bit pattern of the device
+    FIXED_HASH exchange (high 32 hash bits mod nshards, numpy twins in
+    ops/hash.py), so bucket-sharded scans are co-located with each
+    other AND with repartitioned intermediates on the same keys."""
+    from presto_tpu.ops import hash as H
     n = scan.nrows
-    per = -(-max(n, 1) // nshards)
-    total = per * nshards
+    if not bucketed:
+        per = -(-max(n, 1) // nshards)
+        total = per * nshards
+        out = {}
+        for sym, a in scan.arrays.items():
+            out[sym] = np.pad(a, [(0, total - n)] + [(0, 0)] *
+                              (a.ndim - 1))
+        out["__live__"] = np.arange(total) < n
+        return out
+    hs = []
+    for sym in scan.part_cols:
+        valid = scan.arrays.get(f"{sym}$valid")
+        if scan.dictionaries.get(sym) is not None:
+            hs.append(H.np_hash_string_column(
+                scan.arrays[sym], scan.dictionaries[sym], valid))
+        else:
+            hs.append(H.np_hash_int_column(scan.arrays[sym], valid))
+    bucket = ((H.np_combine_hashes(hs) >> np.uint64(32))
+              % np.uint64(nshards)).astype(np.int64)
+    base_live = scan.arrays.get("__live__")
+    if base_live is not None:
+        # dead padding rows go to bucket 0 as dead rows
+        bucket = np.where(base_live, bucket, 0)
+    counts = np.bincount(bucket, minlength=nshards)
+    per = max(int(counts.max()), 1)
+    order = np.argsort(bucket, kind="stable")
+    starts = np.zeros(nshards, dtype=np.int64)
+    starts[1:] = np.cumsum(counts)[:-1]
+    # position of each (sorted) row inside its destination shard
+    within = np.arange(n) - starts[bucket[order]]
+    dest = bucket[order] * per + within
     out = {}
     for sym, a in scan.arrays.items():
-        out[sym] = np.pad(a, [(0, total - n)] + [(0, 0)] * (a.ndim - 1))
-    out["__live__"] = np.arange(total) < n
+        if sym == "__live__":
+            continue
+        buf = np.zeros((nshards * per,) + a.shape[1:], dtype=a.dtype)
+        buf[dest] = a[order]
+        out[sym] = buf
+    live = np.zeros(nshards * per, dtype=bool)
+    live[dest] = True if base_live is None else base_live[order]
+    out["__live__"] = live
     return out
 
 
@@ -518,8 +654,17 @@ def execute_plan_distributed(engine, plan: N.PlanNode,
     scan_inputs = collect_scans(plan, engine)
     capacities: dict[tuple, int] = {}
 
-    sharded_arrays = [
-        _shard_scan_arrays(scan, nshards) for scan in scan_inputs]
+    use_part = bool(engine.session.get("use_connector_partitioning"))
+    sharded_arrays = []
+    for scan in scan_inputs:
+        # bucket only when some operator can exploit the co-location:
+        # pure block sharding is an O(n) pad, bucketing is a full-table
+        # hash + scatter on host
+        bucketed = (use_part and scan.part_cols is not None
+                    and _plan_exploits_partitioning(plan, scan.part_cols))
+        scan.bucketed = bucketed  # read by ShardedInterpreter scans
+        sharded_arrays.append(
+            _shard_scan_arrays(scan, nshards, bucketed))
     flat_names = [(i, sym) for i, arrs in enumerate(sharded_arrays)
                   for sym in arrs]
     flat_arrays = [sharded_arrays[i][sym] for i, sym in flat_names]

@@ -47,6 +47,7 @@ AGG_FUNCTIONS = {"count", "sum", "avg", "min", "max", "arbitrary",
                  "corr", "covar_samp", "covar_pop",
                  "regr_slope", "regr_intercept",
                  "min_by", "max_by", "approx_percentile",
+                 "skewness", "kurtosis",
                  "array_agg", "map_agg", "listagg"}
 
 _COMPARISONS = {"=": "eq", "<>": "neq", "<": "lt", "<=": "lte",

@@ -33,6 +33,21 @@ SYSTEM_SESSION_PROPERTIES: dict[str, tuple[Any, type, str]] = {
                                    "gathering them"),
     "partial_aggregation": (True, bool,
                             "partial->final aggregation across shards"),
+    "grouped_execution": (False, bool,
+                          "execute joins of co-bucketed tables "
+                          "bucket-by-bucket so peak memory is one "
+                          "bucket's working set (reference lifespans, "
+                          "execution/Lifespan.java)"),
+    "grouped_execution_partitions": (8, int,
+                                     "bucket count for grouped "
+                                     "execution"),
+    "use_connector_partitioning": (True, bool,
+                                   "bucket-shard scans of tables with "
+                                   "connector-defined partitioning so "
+                                   "co-partitioned joins/aggregations "
+                                   "skip the FIXED_HASH exchange "
+                                   "(reference ConnectorNodePartitioning"
+                                   "Provider)"),
     "enable_dynamic_filtering": (True, bool,
                                  "prune probe scans with build-side "
                                  "join-key min/max ranges (reference "

@@ -51,7 +51,10 @@ def test_groupby_table_size_overrides_capacity(tpch_tiny, mesh):
 def test_broadcast_join_threshold_flips_distribution(tpch_tiny, mesh):
     sql = ("select count(*) from lineitem, orders "
            "where l_orderkey = o_orderkey")
-    e = make_engine(tpch_tiny, broadcast_join_threshold_rows=1)
+    # connector partitioning would co-locate the orderkey join and skip
+    # the exchange entirely; disable it so the threshold flip is visible
+    e = make_engine(tpch_tiny, broadcast_join_threshold_rows=1,
+                    use_connector_partitioning=False)
     e.execute(sql, mesh=mesh)
     kinds_low = {k for (_, k) in e.last_dist_meta["used_capacity"]}
     assert "build_exch" in kinds_low  # build too big -> partitioned

@@ -1,0 +1,128 @@
+"""Connector-defined partitioning: bucket-sharded scans co-locate with
+each other and with FIXED_HASH exchanges, so orderkey joins/groupings
+over tpch orders+lineitem never reshuffle (reference
+spi/connector/ConnectorNodePartitioningProvider + TpchBucketFunction +
+AddExchanges partitioning matching)."""
+
+import numpy as np
+import pytest
+
+from presto_tpu import Engine
+from presto_tpu.sql.parser import parse_statement
+from presto_tpu.sql.sqlite_dialect import to_sqlite
+from presto_tpu.testing.oracle import rows_equal
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    import jax
+    from jax.sharding import Mesh
+    return Mesh(np.array(jax.devices("cpu")[:8]), ("d",))
+
+
+def _engine(tpch_tiny, **props):
+    e = Engine()
+    e.register_catalog("tpch", tpch_tiny)
+    for k, v in props.items():
+        e.session.set(k, v)
+    return e
+
+
+def test_host_hash_matches_device_hash():
+    import jax.numpy as jnp
+    from presto_tpu.ops import hash as H
+    rng = np.random.default_rng(1)
+    data = rng.integers(-2**62, 2**62, 4096, dtype=np.int64)
+    valid = rng.random(4096) > 0.15
+    assert (np.asarray(H.hash_int_column(jnp.asarray(data),
+                                         jnp.asarray(valid)))
+            == H.np_hash_int_column(data, valid)).all()
+    d = np.asarray(["aa", "bb", "cc", "dd"], object)
+    codes = rng.integers(0, 4, 1000).astype(np.int32)
+    assert (np.asarray(H.hash_string_column(jnp.asarray(codes), d))
+            == H.np_hash_string_column(codes, d)).all()
+
+
+ORDERKEY_JOIN = (
+    "select o_orderpriority, count(*) as c "
+    "from orders, lineitem where o_orderkey = l_orderkey "
+    "and l_quantity < 2500 group by o_orderpriority "
+    "order by o_orderpriority")
+
+
+def test_orderkey_join_skips_exchange(tpch_tiny, oracle, mesh):
+    e = _engine(tpch_tiny, join_distribution_type="PARTITIONED")
+    got = e.execute(ORDERKEY_JOIN, mesh=mesh)
+    kinds = {k for (_, k) in e.last_dist_meta["used_capacity"]}
+    assert "probe_exch" not in kinds and "build_exch" not in kinds
+    want = oracle.query(to_sqlite(parse_statement(ORDERKEY_JOIN)))
+    ok, msg = rows_equal(got, want, ordered=True)
+    assert ok, msg
+
+
+def test_partitioning_off_restores_exchange(tpch_tiny, oracle, mesh):
+    e = _engine(tpch_tiny, join_distribution_type="PARTITIONED",
+                use_connector_partitioning=False)
+    got = e.execute(ORDERKEY_JOIN, mesh=mesh)
+    kinds = {k for (_, k) in e.last_dist_meta["used_capacity"]}
+    assert "probe_exch" in kinds and "build_exch" in kinds
+    want = oracle.query(to_sqlite(parse_statement(ORDERKEY_JOIN)))
+    ok, msg = rows_equal(got, want, ordered=True)
+    assert ok, msg
+
+
+def test_copartitioned_groupby_aggregates_locally(tpch_tiny, oracle,
+                                                  mesh):
+    sql = ("select l_orderkey, sum(l_quantity) as q, count(*) as c "
+           "from lineitem group by l_orderkey "
+           "order by q desc, l_orderkey limit 10")
+    e = _engine(tpch_tiny, partitioned_agg_min_groups=1)
+    got = e.execute(sql, mesh=mesh)
+    kinds = {k for (_, k) in e.last_dist_meta["used_capacity"]}
+    assert "agg_exch" not in kinds  # no partial/final exchange at all
+    want = oracle.query(to_sqlite(parse_statement(sql)))
+    ok, msg = rows_equal(got, want, ordered=True)
+    assert ok, msg
+
+
+def test_unrelated_keys_still_exchange(tpch_tiny, oracle, mesh):
+    # custkey is NOT the declared partitioning of orders
+    sql = ("select c_mktsegment, count(*) as c from customer, orders "
+           "where c_custkey = o_custkey group by c_mktsegment "
+           "order by c_mktsegment")
+    e = _engine(tpch_tiny, join_distribution_type="PARTITIONED")
+    got = e.execute(sql, mesh=mesh)
+    kinds = {k for (_, k) in e.last_dist_meta["used_capacity"]}
+    assert "probe_exch" in kinds or "build_exch" in kinds
+    want = oracle.query(to_sqlite(parse_statement(sql)))
+    ok, msg = rows_equal(got, want, ordered=True)
+    assert ok, msg
+
+
+# ---- grouped execution (lifespans) ------------------------------------
+
+
+def test_grouped_execution_bucket_by_bucket(tpch_tiny, oracle):
+    sql = ("select o_orderpriority, count(*) as c, "
+           "sum(l_quantity) as q from orders, lineitem "
+           "where o_orderkey = l_orderkey "
+           "group by o_orderpriority order by o_orderpriority")
+    e = _engine(tpch_tiny, grouped_execution=True,
+                grouped_execution_partitions=4)
+    got = e.execute(sql)
+    assert e.last_grouped == {
+        "partitions": 4, "build_rows": e.last_grouped["build_rows"],
+        "keys": e.last_grouped["keys"]}
+    assert e.last_grouped["build_rows"] > 0
+    want = oracle.query(to_sqlite(parse_statement(sql)))
+    ok, msg = rows_equal(got, want, ordered=True)
+    assert ok, msg
+
+
+def test_grouped_execution_requires_cobucketed_sides(tpch_tiny):
+    # customer is not bucketed: grouped execution must not trigger
+    sql = ("select count(*) from customer, orders "
+           "where c_custkey = o_custkey")
+    e = _engine(tpch_tiny, grouped_execution=True)
+    e.execute(sql)
+    assert getattr(e, "last_grouped", None) is None

@@ -87,7 +87,11 @@ def test_partitioned_aggregation_matches(tpch_tiny, oracle, mesh):
     sql = ("select l_orderkey, count(*) as c, sum(l_quantity) as q "
            "from lineitem group by l_orderkey order by c desc, "
            "l_orderkey limit 20")
-    e = make_engine(tpch_tiny, partitioned_agg_min_groups=1)
+    # connector partitioning would co-locate l_orderkey groups and skip
+    # the exchange (tested in test_connector_partitioning.py); disable it
+    # here so this test pins the partial->final repartition path itself
+    e = make_engine(tpch_tiny, partitioned_agg_min_groups=1,
+                    use_connector_partitioning=False)
     got = e.execute(sql, mesh=mesh)
     kinds = {k for (_, k) in e.last_dist_meta["used_capacity"]}
     assert "agg_exch" in kinds

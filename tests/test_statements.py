@@ -199,3 +199,24 @@ def test_insert_invalidates_device_cache(eng):
     eng.execute("select x from memory.dc2")
     eng.execute("insert into memory.dc2 select 5")
     assert sorted(eng.execute("select x from memory.dc2")) == [(1,), (5,)]
+
+
+def test_scaled_writers(eng):
+    """Writer task count grows with produced data (ScaledWriterScheduler
+    analog applied to host materialization)."""
+    import presto_tpu.engine as E
+    eng.execute("create table memory.small as select 1 as x")
+    assert eng.last_write["writer_tasks"] == 1
+    old = E.WRITER_SCALING_CELLS
+    E.WRITER_SCALING_CELLS = 64  # tiny threshold: force scaling
+    try:
+        eng.execute("create table memory.big as "
+                    "select l_orderkey, l_partkey, l_quantity "
+                    "from lineitem")
+        assert eng.last_write["writer_tasks"] > 1
+        assert eng.last_write["rows"] > 0
+    finally:
+        E.WRITER_SCALING_CELLS = old
+    n1 = eng.execute("select count(*) from memory.big")[0][0]
+    n2 = eng.execute("select count(*) from lineitem")[0][0]
+    assert n1 == n2
