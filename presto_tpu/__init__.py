@@ -40,6 +40,13 @@ if _cache_dir:
     jax.config.update("jax_compilation_cache_dir",
                       os.path.abspath(_cache_dir))
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    # pin the entry codec to zlib: the zstandard one-shot C compressor
+    # segfaults on the multi-hundred-MB serialized executables long
+    # pytest sessions produce (observed deterministically ~60 compiled
+    # programs in); zlib is slower but never crashes the process
+    from jax._src import compilation_cache as _jcc
+    _jcc.zstd = None
+    _jcc.zstandard = None
 
 from presto_tpu.types import (  # noqa: E402
     BIGINT,

@@ -30,6 +30,7 @@ SCHEMAS: dict[str, dict[str, T.DataType]] = {
         "d_qoy": T.BIGINT, "d_dow": T.BIGINT,
         "d_day_name": T.VARCHAR,
         "d_month_seq": T.BIGINT, "d_week_seq": T.BIGINT,
+        "d_quarter_name": T.VARCHAR,
     },
     "item": {
         "i_item_sk": T.BIGINT, "i_item_id": T.VARCHAR,
@@ -39,6 +40,8 @@ SCHEMAS: dict[str, dict[str, T.DataType]] = {
         "i_class": T.VARCHAR, "i_category_id": T.BIGINT,
         "i_category": T.VARCHAR, "i_manufact_id": T.BIGINT,
         "i_manufact": T.VARCHAR, "i_manager_id": T.BIGINT,
+        "i_color": T.VARCHAR, "i_product_name": T.VARCHAR,
+        "i_size": T.VARCHAR, "i_units": T.VARCHAR,
     },
     "customer": {
         "c_customer_sk": T.BIGINT, "c_customer_id": T.VARCHAR,
@@ -46,12 +49,20 @@ SCHEMAS: dict[str, dict[str, T.DataType]] = {
         "c_current_addr_sk": T.BIGINT, "c_first_name": T.VARCHAR,
         "c_last_name": T.VARCHAR, "c_birth_year": T.BIGINT,
         "c_birth_country": T.VARCHAR, "c_email_address": T.VARCHAR,
+        "c_salutation": T.VARCHAR, "c_preferred_cust_flag": T.VARCHAR,
+        "c_birth_month": T.BIGINT, "c_birth_day": T.BIGINT,
+        "c_login": T.VARCHAR, "c_first_sales_date_sk": T.BIGINT,
+        "c_first_shipto_date_sk": T.BIGINT,
+        "c_last_review_date_sk": T.BIGINT,
     },
     "customer_address": {
         "ca_address_sk": T.BIGINT, "ca_address_id": T.VARCHAR,
         "ca_city": T.VARCHAR, "ca_county": T.VARCHAR,
         "ca_state": T.VARCHAR, "ca_zip": T.VARCHAR,
         "ca_country": T.VARCHAR, "ca_gmt_offset": T.DecimalType(5, 2),
+        "ca_street_number": T.VARCHAR, "ca_street_name": T.VARCHAR,
+        "ca_street_type": T.VARCHAR, "ca_suite_number": T.VARCHAR,
+        "ca_location_type": T.VARCHAR,
     },
     "customer_demographics": {
         "cd_demo_sk": T.BIGINT, "cd_gender": T.VARCHAR,
@@ -59,6 +70,8 @@ SCHEMAS: dict[str, dict[str, T.DataType]] = {
         "cd_education_status": T.VARCHAR,
         "cd_purchase_estimate": T.BIGINT,
         "cd_credit_rating": T.VARCHAR, "cd_dep_count": T.BIGINT,
+        "cd_dep_employed_count": T.BIGINT,
+        "cd_dep_college_count": T.BIGINT,
     },
     "household_demographics": {
         "hd_demo_sk": T.BIGINT, "hd_income_band_sk": T.BIGINT,
@@ -70,16 +83,22 @@ SCHEMAS: dict[str, dict[str, T.DataType]] = {
         "s_store_name": T.VARCHAR, "s_number_employees": T.BIGINT,
         "s_city": T.VARCHAR, "s_county": T.VARCHAR,
         "s_state": T.VARCHAR, "s_gmt_offset": T.DecimalType(5, 2),
+        "s_company_id": T.BIGINT, "s_company_name": T.VARCHAR,
+        "s_zip": T.VARCHAR, "s_market_id": T.BIGINT,
+        "s_street_number": T.VARCHAR, "s_street_name": T.VARCHAR,
+        "s_street_type": T.VARCHAR, "s_suite_number": T.VARCHAR,
     },
     "warehouse": {
         "w_warehouse_sk": T.BIGINT, "w_warehouse_id": T.VARCHAR,
         "w_warehouse_name": T.VARCHAR, "w_warehouse_sq_ft": T.BIGINT,
         "w_city": T.VARCHAR, "w_state": T.VARCHAR,
+        "w_county": T.VARCHAR, "w_country": T.VARCHAR,
     },
     "promotion": {
         "p_promo_sk": T.BIGINT, "p_promo_id": T.VARCHAR,
         "p_channel_dmail": T.VARCHAR, "p_channel_email": T.VARCHAR,
         "p_channel_tv": T.VARCHAR, "p_promo_name": T.VARCHAR,
+        "p_channel_event": T.VARCHAR,
     },
     "store_sales": {
         "ss_sold_date_sk": T.BIGINT, "ss_sold_time_sk": T.BIGINT,
@@ -93,6 +112,7 @@ SCHEMAS: dict[str, dict[str, T.DataType]] = {
         "ss_ext_sales_price": DEC2, "ss_ext_wholesale_cost": DEC2,
         "ss_ext_list_price": DEC2, "ss_coupon_amt": DEC2,
         "ss_net_paid": DEC2, "ss_net_profit": DEC2,
+        "ss_ext_tax": DEC2,
     },
     "catalog_sales": {
         "cs_sold_date_sk": T.BIGINT, "cs_item_sk": T.BIGINT,
@@ -107,6 +127,9 @@ SCHEMAS: dict[str, dict[str, T.DataType]] = {
         "cs_ext_wholesale_cost": DEC2, "cs_ext_list_price": DEC2,
         "cs_ext_ship_cost": DEC2, "cs_coupon_amt": DEC2,
         "cs_net_paid": DEC2, "cs_net_profit": DEC2,
+        "cs_bill_addr_sk": T.BIGINT, "cs_ship_addr_sk": T.BIGINT,
+        "cs_sold_time_sk": T.BIGINT, "cs_catalog_page_sk": T.BIGINT,
+        "cs_net_paid_inc_tax": DEC2,
     },
     "web_sales": {
         "ws_sold_date_sk": T.BIGINT, "ws_sold_time_sk": T.BIGINT,
@@ -122,6 +145,8 @@ SCHEMAS: dict[str, dict[str, T.DataType]] = {
         "ws_ext_discount_amt": DEC2, "ws_ext_sales_price": DEC2,
         "ws_ext_wholesale_cost": DEC2, "ws_ext_ship_cost": DEC2,
         "ws_net_paid": DEC2, "ws_net_profit": DEC2,
+        "ws_bill_addr_sk": T.BIGINT, "ws_wholesale_cost": DEC2,
+        "ws_ext_list_price": DEC2,
     },
     "catalog_returns": {
         "cr_returned_date_sk": T.BIGINT, "cr_item_sk": T.BIGINT,
@@ -130,6 +155,9 @@ SCHEMAS: dict[str, dict[str, T.DataType]] = {
         "cr_call_center_sk": T.BIGINT,
         "cr_return_quantity": T.BIGINT, "cr_return_amount": DEC2,
         "cr_refunded_cash": DEC2, "cr_net_loss": DEC2,
+        "cr_returning_addr_sk": T.BIGINT, "cr_reversed_charge": DEC2,
+        "cr_catalog_page_sk": T.BIGINT, "cr_return_amt_inc_tax": DEC2,
+        "cr_store_credit": DEC2,
     },
     "web_returns": {
         "wr_returned_date_sk": T.BIGINT, "wr_item_sk": T.BIGINT,
@@ -137,6 +165,12 @@ SCHEMAS: dict[str, dict[str, T.DataType]] = {
         "wr_returning_customer_sk": T.BIGINT,
         "wr_return_quantity": T.BIGINT, "wr_return_amt": DEC2,
         "wr_refunded_cash": DEC2, "wr_net_loss": DEC2,
+        "wr_refunded_cdemo_sk": T.BIGINT,
+        "wr_returning_addr_sk": T.BIGINT,
+        "wr_returning_cdemo_sk": T.BIGINT,
+        "wr_refunded_addr_sk": T.BIGINT,
+        "wr_reason_sk": T.BIGINT, "wr_web_page_sk": T.BIGINT,
+        "wr_fee": DEC2,
     },
     "web_site": {
         "web_site_sk": T.BIGINT, "web_site_id": T.VARCHAR,
@@ -162,7 +196,8 @@ SCHEMAS: dict[str, dict[str, T.DataType]] = {
         "sr_customer_sk": T.BIGINT, "sr_ticket_number": T.BIGINT,
         "sr_reason_sk": T.BIGINT,
         "sr_return_quantity": T.BIGINT, "sr_return_amt": DEC2,
-        "sr_net_loss": DEC2,
+        "sr_net_loss": DEC2, "sr_store_sk": T.BIGINT,
+        "sr_cdemo_sk": T.BIGINT,
     },
     "inventory": {
         "inv_date_sk": T.BIGINT, "inv_item_sk": T.BIGINT,
@@ -224,8 +259,19 @@ _UNIQUE = {
 _CATEGORIES = ["Home", "Books", "Electronics", "Shoes", "Women", "Men",
                "Jewelry", "Sports", "Music", "Children"]
 _CLASSES = ["accent", "classical", "fiction", "fitness", "athletic",
-            "portable", "dresses", "pants", "birdal", "estate"]
+            "portable", "dresses", "pants", "birdal", "estate",
+            "maternity", "infants", "swimwear", "country", "rock"]
 _STATES = ["TN", "GA", "OH", "TX", "CA", "NY", "WA", "IL", "MI", "NC"]
+# dsdgen-style syllable brands referenced verbatim by official query
+# filters (q53/q63/q89 and kin)
+_BRANDS = ["amalgimporto #1", "importoamalg #1", "scholaramalgamalg #7",
+           "scholaramalgamalg #9", "scholaramalgamalg #14",
+           "exportiunivamalg #9", "edu packscholar #1", "exportischolar #1",
+           "exportiexporti #1", "amalgamalg #1", "univamalgamalg #10",
+           "maxinameless #4"]
+_COLORS = ["red", "blue", "green", "yellow", "black", "white", "purple",
+           "orange", "pink", "brown", "chartreuse", "ivory", "slate",
+           "khaki", "salmon", "plum"]
 _CITIES = ["Midway", "Fairview", "Oak Grove", "Five Points", "Centerville",
            "Liberty", "Pleasant Hill", "Riverside", "Salem", "Union"]
 _DAYNAMES = ["Sunday", "Monday", "Tuesday", "Wednesday", "Thursday",
@@ -284,8 +330,11 @@ class TpcdsGenerator:
             "d_qoy": (months - 1) // 3 + 1,
             "d_dow": dow,
             "d_day_name": np.array(_DAYNAMES, object)[dow],
-            "d_month_seq": (years - 1998) * 12 + months - 1,
+            "d_month_seq": (years - 1900) * 12 + months - 1,
             "d_week_seq": (dates - self.START) // 7,
+            "d_quarter_name": np.array(
+                [f"{y}Q{q}" for y, q in
+                 zip(years, (months - 1) // 3 + 1)], object),
         }
 
     def _g_item(self):
@@ -305,8 +354,11 @@ class TpcdsGenerator:
             "i_current_price": rng.integers(100, 10000, n),
             "i_wholesale_cost": rng.integers(50, 7000, n),
             "i_brand_id": brand_id,
-            "i_brand": np.array(
-                [f"brand#{b}" for b in brand_id % 500], object),
+            "i_brand": np.where(
+                brand_id % 7 < 3,
+                np.array(_BRANDS, object)[brand_id % len(_BRANDS)],
+                np.array([f"brand#{b}" for b in brand_id % 500],
+                         object)),
             "i_class_id": cls + 1,
             "i_class": np.array(_CLASSES, object)[cls],
             "i_category_id": cat + 1,
@@ -315,6 +367,16 @@ class TpcdsGenerator:
             "i_manufact": np.array(
                 [f"manufact#{m}" for m in manu % 200], object),
             "i_manager_id": rng.integers(1, 100, n),
+            "i_color": np.array(_COLORS, object)[
+                rng.integers(0, len(_COLORS), n)],
+            "i_product_name": np.array(
+                [f"product{sk_ % 4999}n" for sk_ in sk], object),
+            "i_size": np.array(
+                ["small", "medium", "large", "extra large", "economy",
+                 "N/A", "petite"], object)[rng.integers(0, 7, n)],
+            "i_units": np.array(
+                ["Each", "Dozen", "Case", "Pallet", "Box"], object)[
+                rng.integers(0, 5, n)],
         }
 
     def _g_customer(self):
@@ -341,6 +403,20 @@ class TpcdsGenerator:
                  "GERMANY"], object)[rng.integers(0, 5, n)],
             "c_email_address": np.array(
                 [f"c{sk_}@example.com" for sk_ in sk], object),
+            "c_salutation": np.array(
+                ["Mr.", "Mrs.", "Ms.", "Dr.", "Miss", "Sir"], object)[
+                rng.integers(0, 6, n)],
+            "c_preferred_cust_flag": np.array(["N", "Y"], object)[
+                rng.integers(0, 2, n)],
+            "c_birth_month": rng.integers(1, 13, n),
+            "c_birth_day": rng.integers(1, 29, n),
+            "c_login": np.array([f"login{sk_}" for sk_ in sk], object),
+            "c_first_sales_date_sk": rng.integers(
+                1, self.rows("date_dim") + 1, n),
+            "c_first_shipto_date_sk": rng.integers(
+                1, self.rows("date_dim") + 1, n),
+            "c_last_review_date_sk": rng.integers(
+                1, self.rows("date_dim") + 1, n),
         }
 
     def _g_customer_address(self):
@@ -364,6 +440,20 @@ class TpcdsGenerator:
             "ca_country": np.full(n, "United States", object),
             "ca_gmt_offset": rng.choice(
                 np.array([-800, -700, -600, -500]), n),
+            "ca_street_number": np.array(
+                [str(x) for x in rng.integers(1, 1000, n)], object),
+            "ca_street_name": np.array(
+                [f"{c} Street" for c in
+                 np.array(_CITIES)[rng.integers(0, len(_CITIES), n)]],
+                object),
+            "ca_street_type": np.array(
+                ["Street", "Ave", "Blvd", "Way", "Ct", "Dr", "Ln"],
+                object)[rng.integers(0, 7, n)],
+            "ca_suite_number": np.array(
+                [f"Suite {x}" for x in rng.integers(0, 100, n)], object),
+            "ca_location_type": np.array(
+                ["apartment", "condo", "single family"], object)[
+                rng.integers(0, 3, n)],
         }
 
     def _g_customer_demographics(self):
@@ -383,6 +473,8 @@ class TpcdsGenerator:
                 ["Low Risk", "Good", "High Risk", "Unknown"],
                 object)[(i // 70) % 4],
             "cd_dep_count": i % 7,
+            "cd_dep_employed_count": (i // 7) % 7,
+            "cd_dep_college_count": (i // 49) % 7,
         }
 
     def _g_household_demographics(self):
@@ -419,6 +511,23 @@ class TpcdsGenerator:
             "s_state": np.array(_STATES, object)[
                 rng.integers(0, len(_STATES), n)],
             "s_gmt_offset": rng.choice(np.array([-600, -500]), n),
+            "s_company_id": np.ones(n, dtype=np.int64),
+            "s_company_name": np.array(["Unknown"] * n, object),
+            "s_zip": np.array(
+                [f"{z:05d}" for z in rng.integers(10000, 99999, n)],
+                object),
+            "s_market_id": rng.integers(1, 11, n),
+            "s_street_number": np.array(
+                [str(x) for x in rng.integers(1, 1000, n)], object),
+            "s_street_name": np.array(
+                [f"{c} Street" for c in
+                 np.array(_CITIES)[rng.integers(0, len(_CITIES), n)]],
+                object),
+            "s_street_type": np.array(
+                ["Street", "Ave", "Blvd", "Way"], object)[
+                rng.integers(0, 4, n)],
+            "s_suite_number": np.array(
+                [f"Suite {x}" for x in rng.integers(0, 100, n)], object),
         }
 
     def _g_warehouse(self):
@@ -436,6 +545,10 @@ class TpcdsGenerator:
                 rng.integers(0, len(_CITIES), n)],
             "w_state": np.array(_STATES, object)[
                 rng.integers(0, len(_STATES), n)],
+            "w_county": np.array(
+                [f"{c} County" for c in _CITIES], object)[
+                rng.integers(0, len(_CITIES), n)],
+            "w_country": np.full(n, "United States", object),
         }
 
     def _g_promotion(self):
@@ -452,6 +565,7 @@ class TpcdsGenerator:
             "p_channel_tv": yn[rng.integers(0, 2, n)],
             "p_promo_name": np.array(
                 [f"promo {sk_ % 50}" for sk_ in sk], object),
+            "p_channel_event": yn[rng.integers(0, 2, n)],
         }
 
     def _sales_common(self, n, rng, n_dates):
@@ -499,6 +613,7 @@ class TpcdsGenerator:
             "ss_ext_sales_price": ext_sales,
             "ss_ext_wholesale_cost": ext_wholesale,
             "ss_ext_list_price": ext_list,
+            "ss_ext_tax": (ext_sales * rng.integers(0, 9, n)) // 100,
             "ss_coupon_amt": coupon,
             "ss_net_paid": net_paid,
             "ss_net_profit": net_paid - ext_wholesale,
@@ -550,6 +665,17 @@ class TpcdsGenerator:
             "cs_coupon_amt": coupon,
             "cs_net_paid": net_paid,
             "cs_net_profit": net_paid - wholesale * qty,
+            "cs_bill_addr_sk": rng.integers(
+                1, self.rows("customer_address") + 1, n),
+            "cs_ship_addr_sk": rng.integers(
+                1, self.rows("customer_address") + 1, n),
+            "cs_sold_time_sk": rng.integers(
+                1, self.rows("time_dim") + 1, n),
+            "cs_catalog_page_sk": rng.integers(
+                1, self.rows("catalog_page") + 1, n),
+            "cs_net_paid_inc_tax": net_paid + (ext_sales
+                                               * rng.integers(0, 9, n)
+                                               ) // 100,
         }
 
     def _g_web_sales(self):
@@ -594,6 +720,10 @@ class TpcdsGenerator:
             "ws_ext_ship_cost": (ext_sales * rng.integers(2, 10, n)) // 100,
             "ws_net_paid": ext_sales,
             "ws_net_profit": ext_sales - wholesale * qty,
+            "ws_bill_addr_sk": rng.integers(
+                1, self.rows("customer_address") + 1, n),
+            "ws_wholesale_cost": wholesale,
+            "ws_ext_list_price": ext_list,
         }
 
     def _g_store_returns(self):
@@ -611,6 +741,9 @@ class TpcdsGenerator:
             "sr_item_sk": ss["ss_item_sk"][idx],
             "sr_customer_sk": ss["ss_customer_sk"][idx],
             "sr_ticket_number": ss["ss_ticket_number"][idx],
+            "sr_store_sk": rng.integers(1, self.rows("store") + 1, n),
+            "sr_cdemo_sk": rng.integers(
+                1, self.rows("customer_demographics") + 1, n),
             "sr_reason_sk": rng.integers(
                 1, self.rows("reason") + 1, n),
             "sr_return_quantity": np.minimum(
@@ -642,6 +775,14 @@ class TpcdsGenerator:
             "cr_return_amount": amt,
             "cr_refunded_cash": (amt * rng.integers(50, 100, n)) // 100,
             "cr_net_loss": rng.integers(50, 20000, n),
+            "cr_returning_addr_sk": rng.integers(
+                1, self.rows("customer_address") + 1, n),
+            "cr_reversed_charge": (amt * rng.integers(0, 40, n)) // 100,
+            "cr_catalog_page_sk": rng.integers(
+                1, self.rows("catalog_page") + 1, n),
+            "cr_return_amt_inc_tax": amt + (amt * rng.integers(0, 9, n)
+                                            ) // 100,
+            "cr_store_credit": (amt * rng.integers(0, 30, n)) // 100,
         }
 
     def _g_web_returns(self):
@@ -662,6 +803,18 @@ class TpcdsGenerator:
             "wr_return_amt": amt,
             "wr_refunded_cash": (amt * rng.integers(50, 100, n)) // 100,
             "wr_net_loss": rng.integers(50, 20000, n),
+            "wr_refunded_cdemo_sk": rng.integers(
+                1, self.rows("customer_demographics") + 1, n),
+            "wr_returning_addr_sk": rng.integers(
+                1, self.rows("customer_address") + 1, n),
+            "wr_returning_cdemo_sk": rng.integers(
+                1, self.rows("customer_demographics") + 1, n),
+            "wr_refunded_addr_sk": rng.integers(
+                1, self.rows("customer_address") + 1, n),
+            "wr_reason_sk": rng.integers(1, self.rows("reason") + 1, n),
+            "wr_web_page_sk": rng.integers(
+                1, self.rows("web_page") + 1, n),
+            "wr_fee": rng.integers(50, 10000, n),
         }
 
     def _g_web_site(self):

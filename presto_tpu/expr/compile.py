@@ -250,6 +250,30 @@ def cast_val(v: Val, to: T.DataType) -> Val:
         return Val(to, d.astype(jnp.int64), v.valid)
     if isinstance(to, T.IntegerType):
         return Val(to, d.astype(jnp.int32), v.valid)
+    if isinstance(to, T.DateType) and v.is_string:
+        # per-dictionary-entry ISO date parse (one parse per unique
+        # string, rows gather by code); malformed strings become NULL
+        # (reference operator/scalar/DateTimeFunctions castToDate)
+        epoch = np.datetime64("1970-01-01")
+        days = np.empty(len(v.dictionary), dtype=np.int32)
+        ok = np.zeros(len(v.dictionary), dtype=bool)
+        for i, s in enumerate(v.dictionary):
+            try:
+                d64 = np.datetime64(str(s).strip()[:10])
+                # '' / 'NaT' parse to NaT without raising; NaT - epoch
+                # is INT64_MIN which overflows the int32 store
+                if not np.isnat(d64):
+                    days[i] = int((d64 - epoch).astype(int))
+                    ok[i] = True
+                else:
+                    days[i] = 0
+            except (ValueError, OverflowError):
+                days[i] = 0
+        data = jnp.asarray(days)[jnp.clip(d, 0, max(len(days) - 1, 0))] \
+            if len(days) else jnp.zeros_like(d, dtype=jnp.int32)
+        okrow = (jnp.asarray(ok)[jnp.clip(d, 0, max(len(ok) - 1, 0))]
+                 if len(ok) else jnp.zeros_like(d, dtype=bool))
+        return Val(to, data, and_valid(v.valid, okrow))
     if isinstance(to, T.UnknownType) or isinstance(v.dtype, T.UnknownType):
         return Val(to, jnp.zeros_like(d, dtype=to.physical_dtype), v.valid)
     raise NotImplementedError(f"cast {v.dtype} -> {to}")

@@ -179,8 +179,17 @@ def parse_type_name(name: str) -> T.DataType:
         params = [int(p) for p in rest.rstrip(")").split(",")]
         base = base.strip()
         if base == "decimal":
-            return T.DecimalType(params[0], params[1] if len(params) > 1
-                                 else 0)
+            # long decimals (p > 18) clamp to the widest short decimal:
+            # the physical store is int64 either way, so a wider
+            # nominal precision only removes an overflow guard the
+            # engine does not enforce yet (documented int128 gap). A
+            # scale past 18 has no int64 representation at all.
+            scale = params[1] if len(params) > 1 else 0
+            if scale > 18:
+                raise SemanticError(
+                    f"decimal scale {scale} exceeds the int64 short-"
+                    "decimal store")
+            return T.DecimalType(min(params[0], 18), scale)
         if base in ("varchar", "char"):
             return T.VarcharType(params[0])
         raise SemanticError(f"unknown type {name}")
@@ -371,7 +380,7 @@ class ExprPlanner:
 
     def _p_functioncall(self, e: A.FunctionCall) -> ir.Expr:
         name = e.name
-        if name in AGG_FUNCTIONS:
+        if name in AGG_FUNCTIONS or name == "grouping":
             if self.ctx.agg_syms is None:
                 raise SemanticError(
                     f"aggregate {name}() not allowed in this context")
@@ -380,6 +389,8 @@ class ExprPlanner:
                 raise SemanticError(
                     f"aggregate {name}() not collected for this block")
             sym, dtype = entry
+            if sym is None:  # grouping() under plain GROUP BY
+                return ir.Literal(dtype, 0)
             return ir.ColumnRef(dtype, sym)
         if e.agg_order_by:
             raise SemanticError(
@@ -520,6 +531,36 @@ def _collect_calls(e: A.Expression | None, pred) -> list[A.FunctionCall]:
     if e is not None:
         walk(e)
     return out
+
+
+def _substitute_order_aliases(e: A.Expression, spec: A.QuerySpec,
+                              from_scope) -> A.Expression:
+    """Replace output-alias references inside an ORDER BY expression
+    with the aliased select expression (names already resolvable in
+    the FROM scope win; aggregate arguments are never touched)."""
+    from presto_tpu.sql.grouping import rewrite_ast
+    aliases = {i.alias: i.expression for i in spec.select_items
+               if i.alias is not None}
+    if not aliases:
+        return e
+
+    def sub(node):
+        if (isinstance(node, A.Identifier) and node.name in aliases
+                and from_scope.try_resolve((node.name,)) is None):
+            return aliases[node.name]
+        return None
+
+    def skip(node):
+        return (isinstance(node, A.FunctionCall)
+                and node.name in AGG_FUNCTIONS and node.window is None)
+
+    return rewrite_ast(e, sub, skip)
+
+
+def _find_calls_named(e, name: str) -> list:
+    """All FunctionCall nodes with the given name (no window)."""
+    return _collect_calls(
+        e, lambda x: x.name == name and x.window is None)
 
 
 def find_agg_calls(e: A.Expression | None) -> list[A.FunctionCall]:
@@ -1120,11 +1161,15 @@ class LogicalPlanner:
                         if not isinstance(i.expression, A.Star)]
         order_exprs = [i.expression for i in order_by]
         agg_calls: list[A.FunctionCall] = []
+        grouping_calls: list[A.FunctionCall] = []
         for e in select_exprs + ([spec.having] if spec.having else []) \
                 + order_exprs:
             for c in find_agg_calls(e):
                 if c not in agg_calls:
                     agg_calls.append(c)
+            for c in _find_calls_named(e, "grouping"):
+                if c not in grouping_calls:
+                    grouping_calls.append(c)
         group_exprs = self._resolve_group_by(spec)
         has_agg = bool(agg_calls) or bool(group_exprs)
 
@@ -1133,7 +1178,9 @@ class LogicalPlanner:
         if has_agg:
             ctx = self._plan_aggregation(qs, spec, group_exprs, agg_calls,
                                          ctes, outer, decorrelate,
-                                         group_map)
+                                         group_map, grouping_calls)
+        elif grouping_calls:
+            raise SemanticError("grouping() requires GROUP BY")
 
         # ---- HAVING ----
         if spec.having is not None:
@@ -1203,6 +1250,12 @@ class LogicalPlanner:
                 if f is not None:
                     sym = f.symbol
             if sym is None:
+                # ORDER BY expressions may reference output aliases
+                # (q36's `case when lochierarchy = 0 ...`): substitute
+                # the aliased select expression for names that do not
+                # resolve in the FROM scope (reference StatementAnalyzer
+                # resolves the output scope first)
+                e = _substitute_order_aliases(e, spec, qs.scope)
                 planned = self._plan_scalar_expr(qs, e, ctx, ctes,
                                                  group_map)
                 if isinstance(planned, ir.ColumnRef):
@@ -1493,54 +1546,23 @@ class LogicalPlanner:
 
     def _resolve_ordinal(self, e: A.Expression,
                          spec: A.QuerySpec) -> A.Expression:
-        if isinstance(e, A.NumericLiteral):
-            return spec.select_items[int(e.text) - 1].expression
-        return e
+        from presto_tpu.sql.grouping import resolve_ordinal
+        return resolve_ordinal(e, spec)
 
     def _resolve_grouping_sets(
             self, spec: A.QuerySpec) -> list[list[A.Expression]] | None:
         """None for plain GROUP BY; else the expanded list of grouping
-        sets (reference sql/analyzer computes the cross product of
-        element-wise sets the same way, StatementAnalyzer.analyzeGroupBy)."""
-        import itertools
-        if all(g.kind == "simple" for g in spec.group_by):
-            return None
-        per_element: list[list[list[A.Expression]]] = []
-        for g in spec.group_by:
-            exprs = [self._resolve_ordinal(e, spec)
-                     for e in (g.expressions if g.kind != "sets" else [])]
-            if g.kind == "simple":
-                per_element.append([exprs])
-            elif g.kind == "rollup":
-                per_element.append(
-                    [exprs[:k] for k in range(len(exprs), -1, -1)])
-            elif g.kind == "cube":
-                sets = []
-                for mask in range(1 << len(exprs)):
-                    sets.append([e for i, e in enumerate(exprs)
-                                 if mask >> i & 1])
-                per_element.append(sets)
-            else:  # explicit GROUPING SETS
-                sets = []
-                for s in g.expressions:
-                    sets.append([self._resolve_ordinal(e, spec)
-                                 for e in s])
-                per_element.append(sets)
-        out: list[list[A.Expression]] = []
-        for combo in itertools.product(*per_element):
-            merged: list[A.Expression] = []
-            for part in combo:
-                for e in part:
-                    if e not in merged:
-                        merged.append(e)
-            out.append(merged)
-        return out
-
+        sets — shared with the sqlite oracle dialect so engine and
+        oracle cannot disagree (sql/grouping.py)."""
+        from presto_tpu.sql.grouping import expand_grouping_sets
+        return expand_grouping_sets(spec)
     def _plan_aggregation(self, qs: QState, spec: A.QuerySpec,
                           group_exprs: list[A.Expression],
                           agg_calls: list[A.FunctionCall],
                           ctes, outer, decorrelate,
-                          group_map: dict[ir.Expr, str]) -> ExprCtx:
+                          group_map: dict[ir.Expr, str],
+                          grouping_calls: list[A.FunctionCall] = ()
+                          ) -> ExprCtx:
         pre_ctx = ExprCtx(qs.scope, self, outer)
         planner = ExprPlanner(pre_ctx)
 
@@ -1671,9 +1693,28 @@ class LogicalPlanner:
             if distinct_calls:
                 raise SemanticError(
                     "DISTINCT aggregates with grouping sets unsupported")
+            # grouping(a, b, ...) is a per-branch CONSTANT: bit i set
+            # when argument i is rolled away in that grouping set
+            # (reference GroupingOperationRewriter)
+            gmeta = []
+            for call in grouping_calls:
+                sym = self.symbols.fresh("grouping")
+                args = [self._resolve_ordinal(a, spec)
+                        for a in call.args]
+                for a in args:
+                    if a not in ast_to_sym:
+                        raise SemanticError(
+                            "grouping() argument must be a grouping "
+                            "expression")
+                gmeta.append((sym, args))
+                agg_syms[call] = (sym, T.BIGINT)
             self._plan_grouping_sets(qs, gsets, ast_to_sym, group_syms,
-                                     aggs)
+                                     aggs, gmeta)
             return ExprCtx(qs.scope, self, outer, agg_syms=agg_syms)
+        for call in grouping_calls:
+            # plain GROUP BY: nothing is rolled away, grouping() == 0
+            # (sym None -> the expression planner emits a 0 literal)
+            agg_syms[call] = (None, T.BIGINT)
 
         if distinct_calls and (len(agg_calls) != len(distinct_calls)
                                or len(distinct_calls) > 1):
@@ -1745,7 +1786,8 @@ class LogicalPlanner:
                             gsets: list[list[A.Expression]],
                             ast_to_sym: dict[A.Expression, str],
                             group_syms: list[str],
-                            aggs: dict[str, AggCall]) -> None:
+                            aggs: dict[str, AggCall],
+                            gmeta: list[tuple] = ()) -> None:
         """ROLLUP/CUBE/GROUPING SETS as a UNION ALL of one aggregation
         per set, with ungrouped keys projected as typed NULLs (reference
         AggregationNode carries groupingSets natively,
@@ -1754,7 +1796,8 @@ class LogicalPlanner:
         types = source.output_types()
         branches: list[N.PlanNode] = []
         mappings: list[dict[str, str]] = []
-        out_syms = list(group_syms) + list(aggs)
+        out_syms = list(group_syms) + list(aggs) \
+            + [sym for sym, _ in gmeta]
         for s in gsets:
             keys_b = [ast_to_sym[e] for e in s]
             # keep decorrelation keys grouped in every branch
@@ -1773,9 +1816,16 @@ class LogicalPlanner:
                     assigns[sym] = ir.Literal(types[sym], None)
             for a in aggs:
                 assigns[a] = ir.ColumnRef(atypes[a], a)
+            for gsym, gargs in gmeta:
+                bits = 0
+                for a in gargs:
+                    bits = (bits << 1) | (0 if a in s else 1)
+                assigns[gsym] = ir.Literal(T.BIGINT, bits)
             branches.append(N.Project(agg_node, assigns))
             mappings.append({sym: sym for sym in out_syms})
-        utypes = {s: (types[s] if s in group_syms
+        gsym_set = {sym for sym, _ in gmeta}
+        utypes = {s: (T.BIGINT if s in gsym_set
+                      else types[s] if s in group_syms
                       else branches[0].output_types()[s])
                   for s in out_syms}
         union = N.Union(branches, out_syms, utypes, mappings)

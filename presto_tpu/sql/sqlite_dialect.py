@@ -58,7 +58,16 @@ def _body(body: A.Relation) -> str:
     raise NotImplementedError(type(body).__name__)
 
 
-def _spec(s: A.QuerySpec) -> str:
+def _skip_agg(e) -> bool:
+    """Skip NON-window aggregate calls (window calls named like
+    aggregates must still be descended for key substitution). The
+    aggregate name set is the planner's — one source of truth."""
+    from presto_tpu.plan.planner import AGG_FUNCTIONS
+    return (isinstance(e, A.FunctionCall) and e.name in AGG_FUNCTIONS
+            and e.window is None)
+
+
+def _spec_one(s: A.QuerySpec, group_exprs: list | None) -> str:
     items = ", ".join(
         (_expr(i.expression)
          + (f" AS {i.alias}" if i.alias else ""))
@@ -68,16 +77,81 @@ def _spec(s: A.QuerySpec) -> str:
         out += " FROM " + _rel(s.from_relation)
     if s.where is not None:
         out += " WHERE " + _expr(s.where)
-    if s.group_by:
-        gs = []
-        for g in s.group_by:
-            if g.kind != "simple":
-                raise NotImplementedError("grouping sets in oracle")
-            gs.append(_expr(g.expressions[0]))
-        out += " GROUP BY " + ", ".join(gs)
+    if group_exprs:
+        out += " GROUP BY " + ", ".join(_expr(g) for g in group_exprs)
     if s.having is not None:
         out += " HAVING " + _expr(s.having)
     return out
+
+
+def _fold_plain_grouping(node):
+    # plain GROUP BY: nothing is ever rolled away -> grouping() == 0
+    # (sqlite has no grouping() at all; the engine folds it the same
+    # way, plan/planner.py)
+    if isinstance(node, A.FunctionCall) and node.name == "grouping":
+        return A.NumericLiteral("0")
+    return None
+
+
+def _spec(s: A.QuerySpec) -> str:
+    import dataclasses as _dc
+    from presto_tpu.sql.grouping import (expand_grouping_sets,
+                                         resolve_ordinal, rewrite_ast)
+    gsets = expand_grouping_sets(s)
+    if gsets is None:
+        if s.group_by:
+            items = tuple(
+                A.SelectItem(rewrite_ast(i.expression,
+                                         _fold_plain_grouping,
+                                         _skip_agg), i.alias)
+                for i in s.select_items)
+            having = (rewrite_ast(s.having, _fold_plain_grouping,
+                                  _skip_agg)
+                      if s.having is not None else None)
+            s = _dc.replace(s, select_items=items, having=having)
+        return _spec_one(s, [resolve_ordinal(e, s) for g in s.group_by
+                             for e in g.expressions])
+    # sqlite has no ROLLUP/CUBE: emulate with a UNION ALL of one plain
+    # GROUP BY per expanded grouping set, substituting NULL for
+    # rolled-away keys and constant-folding grouping() per set (the
+    # expansion is SHARED with the engine planner, sql/grouping.py)
+    all_exprs = []
+    for gset in gsets:
+        for e in gset:
+            if e not in all_exprs:
+                all_exprs.append(e)
+    parts = []
+    for gset in gsets:
+        def sub(node, _gset=gset):
+            if (isinstance(node, A.FunctionCall)
+                    and node.name == "grouping"):
+                bits = 0
+                for a in node.args:
+                    bits = (bits << 1) | (0 if a in _gset else 1)
+                return A.NumericLiteral(str(bits))
+            if node in all_exprs and node not in _gset:
+                return A.NullLiteral()
+            return None
+
+        from presto_tpu.sql.grouping import rewrite_ast as _ra
+        items = tuple(
+            A.SelectItem(_ra(i.expression, sub, _skip_agg), i.alias)
+            for i in s.select_items)
+        having = (_ra(s.having, sub, _skip_agg)
+                  if s.having is not None else None)
+        import dataclasses as _dc
+        variant = _dc.replace(s, select_items=items, having=having,
+                              group_by=())
+        parts.append(_spec_one(variant, gset))
+    # KNOWN LIMIT: window functions evaluate PER BRANCH here; that is
+    # only correct when every window partition includes the grouping-
+    # distinguishing keys/bits (true of the rollup+rank TPC-DS shapes,
+    # q36/q70/q86) — windows spanning grouping sets would need the
+    # union materialized first.
+    # wrapped as a subquery: a bare A UNION ALL B would mis-associate
+    # when this spec is itself an operand of INTERSECT/EXCEPT (sqlite
+    # set ops are left-associative with equal precedence)
+    return "SELECT * FROM (" + " UNION ALL ".join(parts) + ")"
 
 
 def _rel(r: A.Relation) -> str:

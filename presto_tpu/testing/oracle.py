@@ -22,9 +22,42 @@ from presto_tpu.block import _decode_column
 from presto_tpu.connectors.base import Connector
 
 
+class _Variance:
+    """Welford accumulator registered as sqlite UDAs (sqlite ships no
+    statistical aggregates)."""
+
+    def __init__(self, ddof: int, sqrt: bool):
+        self.ddof = ddof
+        self.sqrt = sqrt
+        self.n = 0
+        self.mean = 0.0
+        self.m2 = 0.0
+
+    def step(self, value):
+        if value is None:
+            return
+        self.n += 1
+        d = value - self.mean
+        self.mean += d / self.n
+        self.m2 += d * (value - self.mean)
+
+    def finalize(self):
+        if self.n <= self.ddof:
+            return None
+        v = self.m2 / (self.n - self.ddof)
+        return v ** 0.5 if self.sqrt else v
+
+
 class SqliteOracle:
     def __init__(self) -> None:
         self.conn = sqlite3.connect(":memory:")
+        mk = lambda ddof, sqrt: (  # noqa: E731
+            lambda: _Variance(ddof, sqrt))
+        for name, ddof, sqrt in (
+                ("stddev", 1, True), ("stddev_samp", 1, True),
+                ("stddev_pop", 0, True), ("variance", 1, False),
+                ("var_samp", 1, False), ("var_pop", 0, False)):
+            self.conn.create_aggregate(name, 1, mk(ddof, sqrt))
 
     def load_connector(self, connector: Connector) -> None:
         for name in connector.table_names():

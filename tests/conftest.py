@@ -15,6 +15,14 @@ import jax  # noqa: E402
 # this environment; the config API wins.
 jax.config.update("jax_platforms", "cpu")
 
+# The persistent XLA cache is disabled under pytest (the env gate is
+# read by presto_tpu/__init__, imported after this line): XLA's CPU
+# executable serializer segfaults deterministically after ~60
+# serializations in one long-lived process (observed at the 61st
+# compiled program of a full tpcds session; single-query processes and
+# the TPU backend are unaffected).
+os.environ["PRESTO_TPU_XLA_CACHE"] = ""
+
 import pytest  # noqa: E402
 
 from presto_tpu.connectors.tpch import TpchConnector  # noqa: E402

@@ -69,3 +69,20 @@ def test_mixed_simple_and_rollup(engine, oracle):
           union all select n_regionkey, null, count(*) from nation
             group by n_regionkey)
         order by 1 nulls last, 2 nulls last""")
+
+
+def test_grouping_function(engine, oracle):
+    """grouping() bitmask per expanded set (reference
+    GroupingOperationRewriter); plain GROUP BY folds to 0."""
+    from presto_tpu.testing.oracle import assert_query
+    assert_query(engine, oracle,
+                 "select n_regionkey, grouping(n_regionkey), count(*) "
+                 "from nation group by rollup(n_regionkey) order by 2, 1")
+    assert_query(engine, oracle,
+                 "select n_regionkey, n_name, "
+                 "grouping(n_regionkey, n_name), count(*) "
+                 "from nation group by cube(n_regionkey, n_name) "
+                 "order by 3, 1, 2")
+    rows = engine.execute("select grouping(n_regionkey) from nation "
+                       "group by n_regionkey limit 1")
+    assert rows[0][0] == 0

@@ -363,7 +363,7 @@ QUERIES = {
            sum(case when (ws_ship_date_sk - ws_sold_date_sk > 60)
                then 1 else 0 end) as d90
         from web_sales, warehouse, ship_mode, web_site, date_dim
-        where d_month_seq between 24 and 35
+        where d_month_seq between 1200 and 1211
           and ws_ship_date_sk = d_date_sk
           and ws_warehouse_sk = w_warehouse_sk
           and ws_ship_mode_sk = sm_ship_mode_sk
@@ -379,14 +379,14 @@ QUERIES = {
                            sum(ss_sales_price) as revenue
                     from store_sales, date_dim
                     where ss_sold_date_sk = d_date_sk
-                      and d_month_seq between 24 and 35
+                      and d_month_seq between 1200 and 1211
                     group by ss_store_sk, ss_item_sk) sa
               group by ss_store_sk) sb,
              (select ss_store_sk, ss_item_sk,
                      sum(ss_sales_price) as revenue
               from store_sales, date_dim
               where ss_sold_date_sk = d_date_sk
-                and d_month_seq between 24 and 35
+                and d_month_seq between 1200 and 1211
               group by ss_store_sk, ss_item_sk) sc
         where sb.ss_store_sk = sc.ss_store_sk
           and sc.revenue <= 0.1 * sb.ave
@@ -610,6 +610,2388 @@ QUERIES = {
           group by i_category, i_brand
         ) t where rk <= 3
         order by i_category, rk, i_brand""",
+    "q09": """
+        SELECT
+          (CASE WHEN ((
+              SELECT "count"(*)
+              FROM
+                store_sales
+              WHERE ("ss_quantity" BETWEEN 1 AND 20)
+           ) > 74129) THEN (
+           SELECT "avg"("ss_ext_discount_amt")
+           FROM
+             store_sales
+           WHERE ("ss_quantity" BETWEEN 1 AND 20)
+        ) ELSE (
+           SELECT "avg"("ss_net_paid")
+           FROM
+             store_sales
+           WHERE ("ss_quantity" BETWEEN 1 AND 20)
+        ) END) "bucket1"
+        , (CASE WHEN ((
+              SELECT "count"(*)
+              FROM
+                store_sales
+              WHERE ("ss_quantity" BETWEEN 21 AND 40)
+           ) > 122840) THEN (
+           SELECT "avg"("ss_ext_discount_amt")
+           FROM
+             store_sales
+           WHERE ("ss_quantity" BETWEEN 21 AND 40)
+        ) ELSE (
+           SELECT "avg"("ss_net_paid")
+           FROM
+             store_sales
+           WHERE ("ss_quantity" BETWEEN 21 AND 40)
+        ) END) "bucket2"
+        , (CASE WHEN ((
+              SELECT "count"(*)
+              FROM
+                store_sales
+              WHERE ("ss_quantity" BETWEEN 41 AND 60)
+           ) > 56580) THEN (
+           SELECT "avg"("ss_ext_discount_amt")
+           FROM
+             store_sales
+           WHERE ("ss_quantity" BETWEEN 41 AND 60)
+        ) ELSE (
+           SELECT "avg"("ss_net_paid")
+           FROM
+             store_sales
+           WHERE ("ss_quantity" BETWEEN 41 AND 60)
+        ) END) "bucket3"
+        , (CASE WHEN ((
+              SELECT "count"(*)
+              FROM
+                store_sales
+              WHERE ("ss_quantity" BETWEEN 61 AND 80)
+           ) > 10097) THEN (
+           SELECT "avg"("ss_ext_discount_amt")
+           FROM
+             store_sales
+           WHERE ("ss_quantity" BETWEEN 61 AND 80)
+        ) ELSE (
+           SELECT "avg"("ss_net_paid")
+           FROM
+             store_sales
+           WHERE ("ss_quantity" BETWEEN 61 AND 80)
+        ) END) "bucket4"
+        , (CASE WHEN ((
+              SELECT "count"(*)
+              FROM
+                store_sales
+              WHERE ("ss_quantity" BETWEEN 81 AND 100)
+           ) > 165306) THEN (
+           SELECT "avg"("ss_ext_discount_amt")
+           FROM
+             store_sales
+           WHERE ("ss_quantity" BETWEEN 81 AND 100)
+        ) ELSE (
+           SELECT "avg"("ss_net_paid")
+           FROM
+             store_sales
+           WHERE ("ss_quantity" BETWEEN 81 AND 100)
+        ) END) "bucket5"
+        FROM
+          reason
+        WHERE ("r_reason_sk" = 1)""",
+    "q28": """
+        SELECT *
+        FROM
+          (
+           SELECT
+             "avg"("ss_list_price") "b1_lp"
+           , "count"("ss_list_price") "b1_cnt"
+           , "count"(DISTINCT "ss_list_price") "b1_cntd"
+           FROM
+             store_sales
+           WHERE ("ss_quantity" BETWEEN 0 AND 5)
+              AND (("ss_list_price" BETWEEN 8 AND (8 + 10))
+                 OR ("ss_coupon_amt" BETWEEN 459 AND (459 + 1000))
+                 OR ("ss_wholesale_cost" BETWEEN 57 AND (57 + 20)))
+        )  b1
+        , (
+           SELECT
+             "avg"("ss_list_price") "b2_lp"
+           , "count"("ss_list_price") "b2_cnt"
+           , "count"(DISTINCT "ss_list_price") "b2_cntd"
+           FROM
+             store_sales
+           WHERE ("ss_quantity" BETWEEN 6 AND 10)
+              AND (("ss_list_price" BETWEEN 90 AND (90 + 10))
+                 OR ("ss_coupon_amt" BETWEEN 2323 AND (2323 + 1000))
+                 OR ("ss_wholesale_cost" BETWEEN 31 AND (31 + 20)))
+        )  b2
+        , (
+           SELECT
+             "avg"("ss_list_price") "b3_lp"
+           , "count"("ss_list_price") "b3_cnt"
+           , "count"(DISTINCT "ss_list_price") "b3_cntd"
+           FROM
+             store_sales
+           WHERE ("ss_quantity" BETWEEN 11 AND 15)
+              AND (("ss_list_price" BETWEEN 142 AND (142 + 10))
+                 OR ("ss_coupon_amt" BETWEEN 12214 AND (12214 + 1000))
+                 OR ("ss_wholesale_cost" BETWEEN 79 AND (79 + 20)))
+        )  b3
+        , (
+           SELECT
+             "avg"("ss_list_price") "b4_lp"
+           , "count"("ss_list_price") "b4_cnt"
+           , "count"(DISTINCT "ss_list_price") "b4_cntd"
+           FROM
+             store_sales
+           WHERE ("ss_quantity" BETWEEN 16 AND 20)
+              AND (("ss_list_price" BETWEEN 135 AND (135 + 10))
+                 OR ("ss_coupon_amt" BETWEEN 6071 AND (6071 + 1000))
+                 OR ("ss_wholesale_cost" BETWEEN 38 AND (38 + 20)))
+        )  b4
+        , (
+           SELECT
+             "avg"("ss_list_price") "b5_lp"
+           , "count"("ss_list_price") "b5_cnt"
+           , "count"(DISTINCT "ss_list_price") "b5_cntd"
+           FROM
+             store_sales
+           WHERE ("ss_quantity" BETWEEN 21 AND 25)
+              AND (("ss_list_price" BETWEEN 122 AND (122 + 10))
+                 OR ("ss_coupon_amt" BETWEEN 836 AND (836 + 1000))
+                 OR ("ss_wholesale_cost" BETWEEN 17 AND (17 + 20)))
+        )  b5
+        , (
+           SELECT
+             "avg"("ss_list_price") "b6_lp"
+           , "count"("ss_list_price") "b6_cnt"
+           , "count"(DISTINCT "ss_list_price") "b6_cntd"
+           FROM
+             store_sales
+           WHERE ("ss_quantity" BETWEEN 26 AND 30)
+              AND (("ss_list_price" BETWEEN 154 AND (154 + 10))
+                 OR ("ss_coupon_amt" BETWEEN 7326 AND (7326 + 1000))
+                 OR ("ss_wholesale_cost" BETWEEN 7 AND (7 + 20)))
+        )  b6
+        LIMIT 100""",
+    "q38": """
+        SELECT "count"(*)
+        FROM
+          (
+           SELECT DISTINCT
+             "c_last_name"
+           , "c_first_name"
+           , "d_date"
+           FROM
+             store_sales
+           , date_dim
+           , customer
+           WHERE ("store_sales"."ss_sold_date_sk" = "date_dim"."d_date_sk")
+              AND ("store_sales"."ss_customer_sk" = "customer"."c_customer_sk")
+              AND ("d_month_seq" BETWEEN 1200 AND (1200 + 11))
+        INTERSECT    SELECT DISTINCT
+             "c_last_name"
+           , "c_first_name"
+           , "d_date"
+           FROM
+             catalog_sales
+           , date_dim
+           , customer
+           WHERE ("catalog_sales"."cs_sold_date_sk" = "date_dim"."d_date_sk")
+              AND ("catalog_sales"."cs_bill_customer_sk" = "customer"."c_customer_sk")
+              AND ("d_month_seq" BETWEEN 1200 AND (1200 + 11))
+        INTERSECT    SELECT DISTINCT
+             "c_last_name"
+           , "c_first_name"
+           , "d_date"
+           FROM
+             web_sales
+           , date_dim
+           , customer
+           WHERE ("web_sales"."ws_sold_date_sk" = "date_dim"."d_date_sk")
+              AND ("web_sales"."ws_bill_customer_sk" = "customer"."c_customer_sk")
+              AND ("d_month_seq" BETWEEN 1200 AND (1200 + 11))
+        )  hot_cust
+        LIMIT 100""",
+    "q54": """
+        WITH
+          my_customers AS (
+           SELECT DISTINCT
+             "c_customer_sk"
+           , "c_current_addr_sk"
+           FROM
+             (
+              SELECT
+                "cs_sold_date_sk" "sold_date_sk"
+              , "cs_bill_customer_sk" "customer_sk"
+              , "cs_item_sk" "item_sk"
+              FROM
+                catalog_sales
+        UNION ALL       SELECT
+                "ws_sold_date_sk" "sold_date_sk"
+              , "ws_bill_customer_sk" "customer_sk"
+              , "ws_item_sk" "item_sk"
+              FROM
+                web_sales
+           )  cs_or_ws_sales
+           , item
+           , date_dim
+           , customer
+           WHERE ("sold_date_sk" = "d_date_sk")
+              AND ("item_sk" = "i_item_sk")
+              AND ("i_category" = 'Women')
+              AND ("i_class" = 'maternity')
+              AND ("c_customer_sk" = "cs_or_ws_sales"."customer_sk")
+              AND ("d_moy" = 12)
+              AND ("d_year" = 1998)
+        ) 
+        , my_revenue AS (
+           SELECT
+             "c_customer_sk"
+           , "sum"("ss_ext_sales_price") "revenue"
+           FROM
+             my_customers
+           , store_sales
+           , customer_address
+           , store
+           , date_dim
+           WHERE ("c_current_addr_sk" = "ca_address_sk")
+              AND ("ca_county" = "s_county")
+              AND ("ca_state" = "s_state")
+              AND ("ss_sold_date_sk" = "d_date_sk")
+              AND ("c_customer_sk" = "ss_customer_sk")
+              AND ("d_month_seq" BETWEEN (
+              SELECT DISTINCT ("d_month_seq" + 1)
+              FROM
+                date_dim
+              WHERE ("d_year" = 1998)
+                 AND ("d_moy" = 12)
+           ) AND (
+              SELECT DISTINCT ("d_month_seq" + 3)
+              FROM
+                date_dim
+              WHERE ("d_year" = 1998)
+                 AND ("d_moy" = 12)
+           ))
+           GROUP BY "c_customer_sk"
+        ) 
+        , segments AS (
+           SELECT CAST(("revenue" / 50) AS INTEGER) "segment"
+           FROM
+             my_revenue
+        ) 
+        SELECT
+          "segment"
+        , "count"(*) "num_customers"
+        , ("segment" * 50) "segment_base"
+        FROM
+          segments
+        GROUP BY "segment"
+        ORDER BY "segment" ASC, "num_customers" ASC
+        LIMIT 100""",
+    "q57": """
+        WITH
+          v1 AS (
+           SELECT
+             "i_category"
+           , "i_brand"
+           , "cc_name"
+           , "d_year"
+           , "d_moy"
+           , "sum"("cs_sales_price") "sum_sales"
+           , "avg"("sum"("cs_sales_price")) OVER (PARTITION BY "i_category", "i_brand", "cc_name", "d_year") "avg_monthly_sales"
+           , "rank"() OVER (PARTITION BY "i_category", "i_brand", "cc_name" ORDER BY "d_year" ASC, "d_moy" ASC) "rn"
+           FROM
+             item
+           , catalog_sales
+           , date_dim
+           , call_center
+           WHERE ("cs_item_sk" = "i_item_sk")
+              AND ("cs_sold_date_sk" = "d_date_sk")
+              AND ("cc_call_center_sk" = "cs_call_center_sk")
+              AND (("d_year" = 1999)
+                 OR (("d_year" = (1999 - 1))
+                    AND ("d_moy" = 12))
+                 OR (("d_year" = (1999 + 1))
+                    AND ("d_moy" = 1)))
+           GROUP BY "i_category", "i_brand", "cc_name", "d_year", "d_moy"
+        ) 
+        , v2 AS (
+           SELECT
+             "v1"."i_category"
+           , "v1"."i_brand"
+           , "v1"."cc_name"
+           , "v1"."d_year"
+           , "v1"."d_moy"
+           , "v1"."avg_monthly_sales"
+           , "v1"."sum_sales"
+           , "v1_lag"."sum_sales" "psum"
+           , "v1_lead"."sum_sales" "nsum"
+           FROM
+             v1
+           , v1 v1_lag
+           , v1 v1_lead
+           WHERE ("v1"."i_category" = "v1_lag"."i_category")
+              AND ("v1"."i_category" = "v1_lead"."i_category")
+              AND ("v1"."i_brand" = "v1_lag"."i_brand")
+              AND ("v1"."i_brand" = "v1_lead"."i_brand")
+              AND ("v1"."cc_name" = "v1_lag"."cc_name")
+              AND ("v1"."cc_name" = "v1_lead"."cc_name")
+              AND ("v1"."rn" = ("v1_lag"."rn" + 1))
+              AND ("v1"."rn" = ("v1_lead"."rn" - 1))
+        ) 
+        SELECT *
+        FROM
+          v2
+        WHERE ("d_year" = 1999)
+           AND ("avg_monthly_sales" > 0)
+           AND ((CASE WHEN ("avg_monthly_sales" > 0) THEN ("abs"(("sum_sales" - "avg_monthly_sales")) / "avg_monthly_sales") ELSE null END) > DECIMAL '0.1')
+        ORDER BY ("sum_sales" - "avg_monthly_sales") ASC, 3 ASC
+        LIMIT 100""",
+    "q59": """
+        WITH
+          wss AS (
+           SELECT
+             "d_week_seq"
+           , "ss_store_sk"
+           , "sum"((CASE WHEN ("d_day_name" = 'Sunday') THEN "ss_sales_price" ELSE null END)) "sun_sales"
+           , "sum"((CASE WHEN ("d_day_name" = 'Monday') THEN "ss_sales_price" ELSE null END)) "mon_sales"
+           , "sum"((CASE WHEN ("d_day_name" = 'Tuesday') THEN "ss_sales_price" ELSE null END)) "tue_sales"
+           , "sum"((CASE WHEN ("d_day_name" = 'Wednesday') THEN "ss_sales_price" ELSE null END)) "wed_sales"
+           , "sum"((CASE WHEN ("d_day_name" = 'Thursday') THEN "ss_sales_price" ELSE null END)) "thu_sales"
+           , "sum"((CASE WHEN ("d_day_name" = 'Friday') THEN "ss_sales_price" ELSE null END)) "fri_sales"
+           , "sum"((CASE WHEN ("d_day_name" = 'Saturday') THEN "ss_sales_price" ELSE null END)) "sat_sales"
+           FROM
+             store_sales
+           , date_dim
+           WHERE ("d_date_sk" = "ss_sold_date_sk")
+           GROUP BY "d_week_seq", "ss_store_sk"
+        ) 
+        SELECT
+          "s_store_name1"
+        , "s_store_id1"
+        , "d_week_seq1"
+        , ("sun_sales1" / "sun_sales2")
+        , ("mon_sales1" / "mon_sales2")
+        , ("tue_sales1" / "tue_sales2")
+        , ("wed_sales1" / "wed_sales2")
+        , ("thu_sales1" / "thu_sales2")
+        , ("fri_sales1" / "fri_sales2")
+        , ("sat_sales1" / "sat_sales2")
+        FROM
+          (
+           SELECT
+             "s_store_name" "s_store_name1"
+           , "wss"."d_week_seq" "d_week_seq1"
+           , "s_store_id" "s_store_id1"
+           , "sun_sales" "sun_sales1"
+           , "mon_sales" "mon_sales1"
+           , "tue_sales" "tue_sales1"
+           , "wed_sales" "wed_sales1"
+           , "thu_sales" "thu_sales1"
+           , "fri_sales" "fri_sales1"
+           , "sat_sales" "sat_sales1"
+           FROM
+             wss
+           , store
+           , date_dim d
+           WHERE ("d"."d_week_seq" = "wss"."d_week_seq")
+              AND ("ss_store_sk" = "s_store_sk")
+              AND ("d_month_seq" BETWEEN 1212 AND (1212 + 11))
+        )  y
+        , (
+           SELECT
+             "s_store_name" "s_store_name2"
+           , "wss"."d_week_seq" "d_week_seq2"
+           , "s_store_id" "s_store_id2"
+           , "sun_sales" "sun_sales2"
+           , "mon_sales" "mon_sales2"
+           , "tue_sales" "tue_sales2"
+           , "wed_sales" "wed_sales2"
+           , "thu_sales" "thu_sales2"
+           , "fri_sales" "fri_sales2"
+           , "sat_sales" "sat_sales2"
+           FROM
+             wss
+           , store
+           , date_dim d
+           WHERE ("d"."d_week_seq" = "wss"."d_week_seq")
+              AND ("ss_store_sk" = "s_store_sk")
+              AND ("d_month_seq" BETWEEN (1212 + 12) AND (1212 + 23))
+        )  x
+        WHERE ("s_store_id1" = "s_store_id2")
+           AND ("d_week_seq1" = ("d_week_seq2" - 52))
+        ORDER BY "s_store_name1" ASC, "s_store_id1" ASC, "d_week_seq1" ASC
+        LIMIT 100""",
+    "q61": """
+        SELECT
+          "promotions"
+        , "total"
+        , ((CAST("promotions" AS DECIMAL(15,4)) / CAST("total" AS DECIMAL(15,4))) * 100)
+        FROM
+          (
+           SELECT "sum"("ss_ext_sales_price") "promotions"
+           FROM
+             store_sales
+           , store
+           , promotion
+           , date_dim
+           , customer
+           , customer_address
+           , item
+           WHERE ("ss_sold_date_sk" = "d_date_sk")
+              AND ("ss_store_sk" = "s_store_sk")
+              AND ("ss_promo_sk" = "p_promo_sk")
+              AND ("ss_customer_sk" = "c_customer_sk")
+              AND ("ca_address_sk" = "c_current_addr_sk")
+              AND ("ss_item_sk" = "i_item_sk")
+              AND ("ca_gmt_offset" = -5)
+              AND ("i_category" = 'Jewelry')
+              AND (("p_channel_dmail" = 'Y')
+                 OR ("p_channel_email" = 'Y')
+                 OR ("p_channel_tv" = 'Y'))
+              AND ("s_gmt_offset" = -5)
+              AND ("d_year" = 1998)
+              AND ("d_moy" = 11)
+        )  promotional_sales
+        , (
+           SELECT "sum"("ss_ext_sales_price") "total"
+           FROM
+             store_sales
+           , store
+           , date_dim
+           , customer
+           , customer_address
+           , item
+           WHERE ("ss_sold_date_sk" = "d_date_sk")
+              AND ("ss_store_sk" = "s_store_sk")
+              AND ("ss_customer_sk" = "c_customer_sk")
+              AND ("ca_address_sk" = "c_current_addr_sk")
+              AND ("ss_item_sk" = "i_item_sk")
+              AND ("ca_gmt_offset" = -5)
+              AND ("i_category" = 'Jewelry')
+              AND ("s_gmt_offset" = -5)
+              AND ("d_year" = 1998)
+              AND ("d_moy" = 11)
+        )  all_sales
+        ORDER BY "promotions" ASC, "total" ASC
+        LIMIT 100""",
+    "q63": """
+        SELECT *
+        FROM
+          (
+           SELECT
+             "i_manager_id"
+           , "sum"("ss_sales_price") "sum_sales"
+           , "avg"("sum"("ss_sales_price")) OVER (PARTITION BY "i_manager_id") "avg_monthly_sales"
+           FROM
+             item
+           , store_sales
+           , date_dim
+           , store
+           WHERE ("ss_item_sk" = "i_item_sk")
+              AND ("ss_sold_date_sk" = "d_date_sk")
+              AND ("ss_store_sk" = "s_store_sk")
+              AND ("d_month_seq" IN (1200   , (1200 + 1)   , (1200 + 2)   , (1200 + 3)   , (1200 + 4)   , (1200 + 5)   , (1200 + 6)   , (1200 + 7)   , (1200 + 8)   , (1200 + 9)   , (1200 + 10)   , (1200 + 11)))
+              AND ((("i_category" IN ('Books'         , 'Children'         , 'Electronics'))
+                    AND ("i_class" IN ('personal'         , 'portable'         , 'refernece'         , 'self-help'))
+                    AND ("i_brand" IN ('scholaramalgamalg #14'         , 'scholaramalgamalg #7'         , 'exportiunivamalg #9'         , 'scholaramalgamalg #9')))
+                 OR (("i_category" IN ('Women'         , 'Music'         , 'Men'))
+                    AND ("i_class" IN ('accessories'         , 'classical'         , 'fragrances'         , 'pants'))
+                    AND ("i_brand" IN ('amalgimporto #1'         , 'edu packscholar #1'         , 'exportiimporto #1'         , 'importoamalg #1'))))
+           GROUP BY "i_manager_id", "d_moy"
+        )  tmp1
+        WHERE ((CASE WHEN ("avg_monthly_sales" > 0) THEN ("abs"(("sum_sales" - "avg_monthly_sales")) / "avg_monthly_sales") ELSE null END) > DECIMAL '0.1')
+        ORDER BY "i_manager_id" ASC, "avg_monthly_sales" ASC, "sum_sales" ASC
+        LIMIT 100""",
+    "q69": """
+        SELECT
+          "cd_gender"
+        , "cd_marital_status"
+        , "cd_education_status"
+        , "count"(*) "cnt1"
+        , "cd_purchase_estimate"
+        , "count"(*) "cnt2"
+        , "cd_credit_rating"
+        , "count"(*) "cnt3"
+        FROM
+          customer c
+        , customer_address ca
+        , customer_demographics
+        WHERE ("c"."c_current_addr_sk" = "ca"."ca_address_sk")
+           AND ("ca_state" IN ('KY', 'GA', 'NM'))
+           AND ("cd_demo_sk" = "c"."c_current_cdemo_sk")
+           AND (EXISTS (
+           SELECT *
+           FROM
+             store_sales
+           , date_dim
+           WHERE ("c"."c_customer_sk" = "ss_customer_sk")
+              AND ("ss_sold_date_sk" = "d_date_sk")
+              AND ("d_year" = 2001)
+              AND ("d_moy" BETWEEN 4 AND (4 + 2))
+        ))
+           AND (NOT (EXISTS (
+           SELECT *
+           FROM
+             web_sales
+           , date_dim
+           WHERE ("c"."c_customer_sk" = "ws_bill_customer_sk")
+              AND ("ws_sold_date_sk" = "d_date_sk")
+              AND ("d_year" = 2001)
+              AND ("d_moy" BETWEEN 4 AND (4 + 2))
+        )))
+           AND (NOT (EXISTS (
+           SELECT *
+           FROM
+             catalog_sales
+           , date_dim
+           WHERE ("c"."c_customer_sk" = "cs_ship_customer_sk")
+              AND ("cs_sold_date_sk" = "d_date_sk")
+              AND ("d_year" = 2001)
+              AND ("d_moy" BETWEEN 4 AND (4 + 2))
+        )))
+        GROUP BY "cd_gender", "cd_marital_status", "cd_education_status", "cd_purchase_estimate", "cd_credit_rating"
+        ORDER BY "cd_gender" ASC, "cd_marital_status" ASC, "cd_education_status" ASC, "cd_purchase_estimate" ASC, "cd_credit_rating" ASC
+        LIMIT 100""",
+    "q75": """
+        WITH
+          all_sales AS (
+           SELECT
+             "d_year"
+           , "i_brand_id"
+           , "i_class_id"
+           , "i_category_id"
+           , "i_manufact_id"
+           , "sum"("sales_cnt") "sales_cnt"
+           , "sum"("sales_amt") "sales_amt"
+           FROM
+             (
+              SELECT
+                "d_year"
+              , "i_brand_id"
+              , "i_class_id"
+              , "i_category_id"
+              , "i_manufact_id"
+              , ("cs_quantity" - COALESCE("cr_return_quantity", 0)) "sales_cnt"
+              , ("cs_ext_sales_price" - COALESCE("cr_return_amount", DECIMAL '0.0')) "sales_amt"
+              FROM
+                (((catalog_sales
+              INNER JOIN item ON ("i_item_sk" = "cs_item_sk"))
+              INNER JOIN date_dim ON ("d_date_sk" = "cs_sold_date_sk"))
+              LEFT JOIN catalog_returns ON ("cs_order_number" = "cr_order_number")
+                 AND ("cs_item_sk" = "cr_item_sk"))
+              WHERE ("i_category" = 'Books')
+        UNION       SELECT
+                "d_year"
+              , "i_brand_id"
+              , "i_class_id"
+              , "i_category_id"
+              , "i_manufact_id"
+              , ("ss_quantity" - COALESCE("sr_return_quantity", 0)) "sales_cnt"
+              , ("ss_ext_sales_price" - COALESCE("sr_return_amt", DECIMAL '0.0')) "sales_amt"
+              FROM
+                (((store_sales
+              INNER JOIN item ON ("i_item_sk" = "ss_item_sk"))
+              INNER JOIN date_dim ON ("d_date_sk" = "ss_sold_date_sk"))
+              LEFT JOIN store_returns ON ("ss_ticket_number" = "sr_ticket_number")
+                 AND ("ss_item_sk" = "sr_item_sk"))
+              WHERE ("i_category" = 'Books')
+        UNION       SELECT
+                "d_year"
+              , "i_brand_id"
+              , "i_class_id"
+              , "i_category_id"
+              , "i_manufact_id"
+              , ("ws_quantity" - COALESCE("wr_return_quantity", 0)) "sales_cnt"
+              , ("ws_ext_sales_price" - COALESCE("wr_return_amt", DECIMAL '0.0')) "sales_amt"
+              FROM
+                (((web_sales
+              INNER JOIN item ON ("i_item_sk" = "ws_item_sk"))
+              INNER JOIN date_dim ON ("d_date_sk" = "ws_sold_date_sk"))
+              LEFT JOIN web_returns ON ("ws_order_number" = "wr_order_number")
+                 AND ("ws_item_sk" = "wr_item_sk"))
+              WHERE ("i_category" = 'Books')
+           )  sales_detail
+           GROUP BY "d_year", "i_brand_id", "i_class_id", "i_category_id", "i_manufact_id"
+        ) 
+        SELECT
+          "prev_yr"."d_year" "prev_year"
+        , "curr_yr"."d_year" "year"
+        , "curr_yr"."i_brand_id"
+        , "curr_yr"."i_class_id"
+        , "curr_yr"."i_category_id"
+        , "curr_yr"."i_manufact_id"
+        , "prev_yr"."sales_cnt" "prev_yr_cnt"
+        , "curr_yr"."sales_cnt" "curr_yr_cnt"
+        , ("curr_yr"."sales_cnt" - "prev_yr"."sales_cnt") "sales_cnt_diff"
+        , ("curr_yr"."sales_amt" - "prev_yr"."sales_amt") "sales_amt_diff"
+        FROM
+          all_sales curr_yr
+        , all_sales prev_yr
+        WHERE ("curr_yr"."i_brand_id" = "prev_yr"."i_brand_id")
+           AND ("curr_yr"."i_class_id" = "prev_yr"."i_class_id")
+           AND ("curr_yr"."i_category_id" = "prev_yr"."i_category_id")
+           AND ("curr_yr"."i_manufact_id" = "prev_yr"."i_manufact_id")
+           AND ("curr_yr"."d_year" = 2002)
+           AND ("prev_yr"."d_year" = (2002 - 1))
+           AND ((CAST("curr_yr"."sales_cnt" AS DECIMAL(17,2)) / CAST("prev_yr"."sales_cnt" AS DECIMAL(17,2))) < DECIMAL '0.9')
+        ORDER BY "sales_cnt_diff" ASC, "sales_amt_diff" ASC
+        LIMIT 100""",
+    "q88": """
+        SELECT *
+        FROM
+          (
+           SELECT "count"(*) "h8_30_to_9"
+           FROM
+             store_sales
+           , household_demographics
+           , time_dim
+           , store
+           WHERE ("ss_sold_time_sk" = "time_dim"."t_time_sk")
+              AND ("ss_hdemo_sk" = "household_demographics"."hd_demo_sk")
+              AND ("ss_store_sk" = "s_store_sk")
+              AND ("time_dim"."t_hour" = 8)
+              AND ("time_dim"."t_minute" >= 30)
+              AND ((("household_demographics"."hd_dep_count" = 4)
+                    AND ("household_demographics"."hd_vehicle_count" <= (4 + 2)))
+                 OR (("household_demographics"."hd_dep_count" = 2)
+                    AND ("household_demographics"."hd_vehicle_count" <= (2 + 2)))
+                 OR (("household_demographics"."hd_dep_count" = 0)
+                    AND ("household_demographics"."hd_vehicle_count" <= (0 + 2))))
+              AND ("store"."s_store_name" = 'ese')
+        )  s1
+        , (
+           SELECT "count"(*) "h9_to_9_30"
+           FROM
+             store_sales
+           , household_demographics
+           , time_dim
+           , store
+           WHERE ("ss_sold_time_sk" = "time_dim"."t_time_sk")
+              AND ("ss_hdemo_sk" = "household_demographics"."hd_demo_sk")
+              AND ("ss_store_sk" = "s_store_sk")
+              AND ("time_dim"."t_hour" = 9)
+              AND ("time_dim"."t_minute" < 30)
+              AND ((("household_demographics"."hd_dep_count" = 4)
+                    AND ("household_demographics"."hd_vehicle_count" <= (4 + 2)))
+                 OR (("household_demographics"."hd_dep_count" = 2)
+                    AND ("household_demographics"."hd_vehicle_count" <= (2 + 2)))
+                 OR (("household_demographics"."hd_dep_count" = 0)
+                    AND ("household_demographics"."hd_vehicle_count" <= (0 + 2))))
+              AND ("store"."s_store_name" = 'ese')
+        )  s2
+        , (
+           SELECT "count"(*) "h9_30_to_10"
+           FROM
+             store_sales
+           , household_demographics
+           , time_dim
+           , store
+           WHERE ("ss_sold_time_sk" = "time_dim"."t_time_sk")
+              AND ("ss_hdemo_sk" = "household_demographics"."hd_demo_sk")
+              AND ("ss_store_sk" = "s_store_sk")
+              AND ("time_dim"."t_hour" = 9)
+              AND ("time_dim"."t_minute" >= 30)
+              AND ((("household_demographics"."hd_dep_count" = 4)
+                    AND ("household_demographics"."hd_vehicle_count" <= (4 + 2)))
+                 OR (("household_demographics"."hd_dep_count" = 2)
+                    AND ("household_demographics"."hd_vehicle_count" <= (2 + 2)))
+                 OR (("household_demographics"."hd_dep_count" = 0)
+                    AND ("household_demographics"."hd_vehicle_count" <= (0 + 2))))
+              AND ("store"."s_store_name" = 'ese')
+        )  s3
+        , (
+           SELECT "count"(*) "h10_to_10_30"
+           FROM
+             store_sales
+           , household_demographics
+           , time_dim
+           , store
+           WHERE ("ss_sold_time_sk" = "time_dim"."t_time_sk")
+              AND ("ss_hdemo_sk" = "household_demographics"."hd_demo_sk")
+              AND ("ss_store_sk" = "s_store_sk")
+              AND ("time_dim"."t_hour" = 10)
+              AND ("time_dim"."t_minute" < 30)
+              AND ((("household_demographics"."hd_dep_count" = 4)
+                    AND ("household_demographics"."hd_vehicle_count" <= (4 + 2)))
+                 OR (("household_demographics"."hd_dep_count" = 2)
+                    AND ("household_demographics"."hd_vehicle_count" <= (2 + 2)))
+                 OR (("household_demographics"."hd_dep_count" = 0)
+                    AND ("household_demographics"."hd_vehicle_count" <= (0 + 2))))
+              AND ("store"."s_store_name" = 'ese')
+        )  s4
+        , (
+           SELECT "count"(*) "h10_30_to_11"
+           FROM
+             store_sales
+           , household_demographics
+           , time_dim
+           , store
+           WHERE ("ss_sold_time_sk" = "time_dim"."t_time_sk")
+              AND ("ss_hdemo_sk" = "household_demographics"."hd_demo_sk")
+              AND ("ss_store_sk" = "s_store_sk")
+              AND ("time_dim"."t_hour" = 10)
+              AND ("time_dim"."t_minute" >= 30)
+              AND ((("household_demographics"."hd_dep_count" = 4)
+                    AND ("household_demographics"."hd_vehicle_count" <= (4 + 2)))
+                 OR (("household_demographics"."hd_dep_count" = 2)
+                    AND ("household_demographics"."hd_vehicle_count" <= (2 + 2)))
+                 OR (("household_demographics"."hd_dep_count" = 0)
+                    AND ("household_demographics"."hd_vehicle_count" <= (0 + 2))))
+              AND ("store"."s_store_name" = 'ese')
+        )  s5
+        , (
+           SELECT "count"(*) "h11_to_11_30"
+           FROM
+             store_sales
+           , household_demographics
+           , time_dim
+           , store
+           WHERE ("ss_sold_time_sk" = "time_dim"."t_time_sk")
+              AND ("ss_hdemo_sk" = "household_demographics"."hd_demo_sk")
+              AND ("ss_store_sk" = "s_store_sk")
+              AND ("time_dim"."t_hour" = 11)
+              AND ("time_dim"."t_minute" < 30)
+              AND ((("household_demographics"."hd_dep_count" = 4)
+                    AND ("household_demographics"."hd_vehicle_count" <= (4 + 2)))
+                 OR (("household_demographics"."hd_dep_count" = 2)
+                    AND ("household_demographics"."hd_vehicle_count" <= (2 + 2)))
+                 OR (("household_demographics"."hd_dep_count" = 0)
+                    AND ("household_demographics"."hd_vehicle_count" <= (0 + 2))))
+              AND ("store"."s_store_name" = 'ese')
+        )  s6
+        , (
+           SELECT "count"(*) "h11_30_to_12"
+           FROM
+             store_sales
+           , household_demographics
+           , time_dim
+           , store
+           WHERE ("ss_sold_time_sk" = "time_dim"."t_time_sk")
+              AND ("ss_hdemo_sk" = "household_demographics"."hd_demo_sk")
+              AND ("ss_store_sk" = "s_store_sk")
+              AND ("time_dim"."t_hour" = 11)
+              AND ("time_dim"."t_minute" >= 30)
+              AND ((("household_demographics"."hd_dep_count" = 4)
+                    AND ("household_demographics"."hd_vehicle_count" <= (4 + 2)))
+                 OR (("household_demographics"."hd_dep_count" = 2)
+                    AND ("household_demographics"."hd_vehicle_count" <= (2 + 2)))
+                 OR (("household_demographics"."hd_dep_count" = 0)
+                    AND ("household_demographics"."hd_vehicle_count" <= (0 + 2))))
+              AND ("store"."s_store_name" = 'ese')
+        )  s7
+        , (
+           SELECT "count"(*) "h12_to_12_30"
+           FROM
+             store_sales
+           , household_demographics
+           , time_dim
+           , store
+           WHERE ("ss_sold_time_sk" = "time_dim"."t_time_sk")
+              AND ("ss_hdemo_sk" = "household_demographics"."hd_demo_sk")
+              AND ("ss_store_sk" = "s_store_sk")
+              AND ("time_dim"."t_hour" = 12)
+              AND ("time_dim"."t_minute" < 30)
+              AND ((("household_demographics"."hd_dep_count" = 4)
+                    AND ("household_demographics"."hd_vehicle_count" <= (4 + 2)))
+                 OR (("household_demographics"."hd_dep_count" = 2)
+                    AND ("household_demographics"."hd_vehicle_count" <= (2 + 2)))
+                 OR (("household_demographics"."hd_dep_count" = 0)
+                    AND ("household_demographics"."hd_vehicle_count" <= (0 + 2))))
+              AND ("store"."s_store_name" = 'ese')
+        )  s8""",
+    "q01": """
+        WITH
+          customer_total_return AS (
+           SELECT
+             "sr_customer_sk" "ctr_customer_sk"
+           , "sr_store_sk" "ctr_store_sk"
+           , "sum"("sr_return_amt") "ctr_total_return"
+           FROM
+             store_returns
+           , date_dim
+           WHERE ("sr_returned_date_sk" = "d_date_sk")
+              AND ("d_year" = 2000)
+           GROUP BY "sr_customer_sk", "sr_store_sk"
+        ) 
+        SELECT "c_customer_id"
+        FROM
+          customer_total_return ctr1
+        , store
+        , customer
+        WHERE ("ctr1"."ctr_total_return" > (
+              SELECT ("avg"("ctr_total_return") * DECIMAL '1.2')
+              FROM
+                customer_total_return ctr2
+              WHERE ("ctr1"."ctr_store_sk" = "ctr2"."ctr_store_sk")
+           ))
+           AND ("s_store_sk" = "ctr1"."ctr_store_sk")
+           AND ("s_state" = 'TN')
+           AND ("ctr1"."ctr_customer_sk" = "c_customer_sk")
+        ORDER BY "c_customer_id" ASC
+        LIMIT 100""",
+    "q05": """
+        WITH
+          ssr AS (
+           SELECT
+             "s_store_id"
+           , "sum"("sales_price") "sales"
+           , "sum"("profit") "profit"
+           , "sum"("return_amt") "returns"
+           , "sum"("net_loss") "profit_loss"
+           FROM
+             (
+              SELECT
+                "ss_store_sk" "store_sk"
+              , "ss_sold_date_sk" "date_sk"
+              , "ss_ext_sales_price" "sales_price"
+              , "ss_net_profit" "profit"
+              , CAST(0 AS DECIMAL(7,2)) "return_amt"
+              , CAST(0 AS DECIMAL(7,2)) "net_loss"
+              FROM
+                store_sales
+        UNION ALL       SELECT
+                "sr_store_sk" "store_sk"
+              , "sr_returned_date_sk" "date_sk"
+              , CAST(0 AS DECIMAL(7,2)) "sales_price"
+              , CAST(0 AS DECIMAL(7,2)) "profit"
+              , "sr_return_amt" "return_amt"
+              , "sr_net_loss" "net_loss"
+              FROM
+                store_returns
+           )  salesreturns
+           , date_dim
+           , store
+           WHERE ("date_sk" = "d_date_sk")
+              AND ("d_date" BETWEEN CAST('2000-08-23' AS DATE) AND (CAST('2000-08-23' AS DATE) + INTERVAL  '14' DAY))
+              AND ("store_sk" = "s_store_sk")
+           GROUP BY "s_store_id"
+        ) 
+        , csr AS (
+           SELECT
+             "cp_catalog_page_id"
+           , "sum"("sales_price") "sales"
+           , "sum"("profit") "profit"
+           , "sum"("return_amt") "returns"
+           , "sum"("net_loss") "profit_loss"
+           FROM
+             (
+              SELECT
+                "cs_catalog_page_sk" "page_sk"
+              , "cs_sold_date_sk" "date_sk"
+              , "cs_ext_sales_price" "sales_price"
+              , "cs_net_profit" "profit"
+              , CAST(0 AS DECIMAL(7,2)) "return_amt"
+              , CAST(0 AS DECIMAL(7,2)) "net_loss"
+              FROM
+                catalog_sales
+        UNION ALL       SELECT
+                "cr_catalog_page_sk" "page_sk"
+              , "cr_returned_date_sk" "date_sk"
+              , CAST(0 AS DECIMAL(7,2)) "sales_price"
+              , CAST(0 AS DECIMAL(7,2)) "profit"
+              , "cr_return_amount" "return_amt"
+              , "cr_net_loss" "net_loss"
+              FROM
+                catalog_returns
+           )  salesreturns
+           , date_dim
+           , catalog_page
+           WHERE ("date_sk" = "d_date_sk")
+              AND ("d_date" BETWEEN CAST('2000-08-23' AS DATE) AND (CAST('2000-08-23' AS DATE) + INTERVAL  '14' DAY))
+              AND ("page_sk" = "cp_catalog_page_sk")
+           GROUP BY "cp_catalog_page_id"
+        ) 
+        , wsr AS (
+           SELECT
+             "web_site_id"
+           , "sum"("sales_price") "sales"
+           , "sum"("profit") "profit"
+           , "sum"("return_amt") "returns"
+           , "sum"("net_loss") "profit_loss"
+           FROM
+             (
+              SELECT
+                "ws_web_site_sk" "wsr_web_site_sk"
+              , "ws_sold_date_sk" "date_sk"
+              , "ws_ext_sales_price" "sales_price"
+              , "ws_net_profit" "profit"
+              , CAST(0 AS DECIMAL(7,2)) "return_amt"
+              , CAST(0 AS DECIMAL(7,2)) "net_loss"
+              FROM
+                web_sales
+        UNION ALL       SELECT
+                "ws_web_site_sk" "wsr_web_site_sk"
+              , "wr_returned_date_sk" "date_sk"
+              , CAST(0 AS DECIMAL(7,2)) "sales_price"
+              , CAST(0 AS DECIMAL(7,2)) "profit"
+              , "wr_return_amt" "return_amt"
+              , "wr_net_loss" "net_loss"
+              FROM
+                (web_returns
+              LEFT JOIN web_sales ON ("wr_item_sk" = "ws_item_sk")
+                 AND ("wr_order_number" = "ws_order_number"))
+           )  salesreturns
+           , date_dim
+           , web_site
+           WHERE ("date_sk" = "d_date_sk")
+              AND ("d_date" BETWEEN CAST('2000-08-23' AS DATE) AND (CAST('2000-08-23' AS DATE) + INTERVAL  '14' DAY))
+              AND ("wsr_web_site_sk" = "web_site_sk")
+           GROUP BY "web_site_id"
+        ) 
+        SELECT
+          "channel"
+        , "id"
+        , "sum"("sales") "sales"
+        , "sum"("returns") "returns"
+        , "sum"("profit") "profit"
+        FROM
+          (
+           SELECT
+             'store channel' "channel"
+           , "concat"('store', "s_store_id") "id"
+           , "sales"
+           , "returns"
+           , ("profit" - "profit_loss") "profit"
+           FROM
+             ssr
+        UNION ALL    SELECT
+             'catalog channel' "channel"
+           , "concat"('catalog_page', "cp_catalog_page_id") "id"
+           , "sales"
+           , "returns"
+           , ("profit" - "profit_loss") "profit"
+           FROM
+             csr
+        UNION ALL    SELECT
+             'web channel' "channel"
+           , "concat"('web_site', "web_site_id") "id"
+           , "sales"
+           , "returns"
+           , ("profit" - "profit_loss") "profit"
+           FROM
+             wsr
+        )  x
+        GROUP BY ROLLUP (channel, id)
+        ORDER BY "channel" ASC, "id" ASC
+        LIMIT 100""",
+    "q17": """
+        SELECT
+          "i_item_id"
+        , "i_item_desc"
+        , "s_state"
+        , "count"("ss_quantity") "store_sales_quantitycount"
+        , "avg"("ss_quantity") "store_sales_quantityave"
+        , "stddev_samp"("ss_quantity") "store_sales_quantitystdev"
+        , ("stddev_samp"("ss_quantity") / "avg"("ss_quantity")) "store_sales_quantitycov"
+        , "count"("sr_return_quantity") "store_returns_quantitycount"
+        , "avg"("sr_return_quantity") "store_returns_quantityave"
+        , "stddev_samp"("sr_return_quantity") "store_returns_quantitystdev"
+        , ("stddev_samp"("sr_return_quantity") / "avg"("sr_return_quantity")) "store_returns_quantitycov"
+        , "count"("cs_quantity") "catalog_sales_quantitycount"
+        , "avg"("cs_quantity") "catalog_sales_quantityave"
+        , "stddev_samp"("cs_quantity") "catalog_sales_quantitystdev"
+        , ("stddev_samp"("cs_quantity") / "avg"("cs_quantity")) "catalog_sales_quantitycov"
+        FROM
+          store_sales
+        , store_returns
+        , catalog_sales
+        , date_dim d1
+        , date_dim d2
+        , date_dim d3
+        , store
+        , item
+        WHERE ("d1"."d_quarter_name" = '2001Q1')
+           AND ("d1"."d_date_sk" = "ss_sold_date_sk")
+           AND ("i_item_sk" = "ss_item_sk")
+           AND ("s_store_sk" = "ss_store_sk")
+           AND ("ss_customer_sk" = "sr_customer_sk")
+           AND ("ss_item_sk" = "sr_item_sk")
+           AND ("ss_ticket_number" = "sr_ticket_number")
+           AND ("sr_returned_date_sk" = "d2"."d_date_sk")
+           AND ("d2"."d_quarter_name" IN ('2001Q1', '2001Q2', '2001Q3'))
+           AND ("sr_customer_sk" = "cs_bill_customer_sk")
+           AND ("sr_item_sk" = "cs_item_sk")
+           AND ("cs_sold_date_sk" = "d3"."d_date_sk")
+           AND ("d3"."d_quarter_name" IN ('2001Q1', '2001Q2', '2001Q3'))
+        GROUP BY "i_item_id", "i_item_desc", "s_state"
+        ORDER BY "i_item_id" ASC, "i_item_desc" ASC, "s_state" ASC
+        LIMIT 100""",
+    "q18": """
+        SELECT
+          "i_item_id"
+        , "ca_country"
+        , "ca_state"
+        , "ca_county"
+        , "avg"(CAST("cs_quantity" AS DECIMAL(12,2))) "agg1"
+        , "avg"(CAST("cs_list_price" AS DECIMAL(12,2))) "agg2"
+        , "avg"(CAST("cs_coupon_amt" AS DECIMAL(12,2))) "agg3"
+        , "avg"(CAST("cs_sales_price" AS DECIMAL(12,2))) "agg4"
+        , "avg"(CAST("cs_net_profit" AS DECIMAL(12,2))) "agg5"
+        , "avg"(CAST("c_birth_year" AS DECIMAL(12,2))) "agg6"
+        , "avg"(CAST("cd1"."cd_dep_count" AS DECIMAL(12,2))) "agg7"
+        FROM
+          catalog_sales
+        , customer_demographics cd1
+        , customer_demographics cd2
+        , customer
+        , customer_address
+        , date_dim
+        , item
+        WHERE ("cs_sold_date_sk" = "d_date_sk")
+           AND ("cs_item_sk" = "i_item_sk")
+           AND ("cs_bill_cdemo_sk" = "cd1"."cd_demo_sk")
+           AND ("cs_bill_customer_sk" = "c_customer_sk")
+           AND ("cd1"."cd_gender" = 'F')
+           AND ("cd1"."cd_education_status" = 'Unknown')
+           AND ("c_current_cdemo_sk" = "cd2"."cd_demo_sk")
+           AND ("c_current_addr_sk" = "ca_address_sk")
+           AND ("c_birth_month" IN (1, 6, 8, 9, 12, 2))
+           AND ("d_year" = 1998)
+           AND ("ca_state" IN ('MS', 'IN', 'ND', 'OK', 'NM', 'VA', 'MS'))
+        GROUP BY ROLLUP (i_item_id, ca_country, ca_state, ca_county)
+        ORDER BY "ca_country" ASC, "ca_state" ASC, "ca_county" ASC, "i_item_id" ASC
+        LIMIT 100""",
+    "q21": """
+        SELECT *
+        FROM
+          (
+           SELECT
+             "w_warehouse_name"
+           , "i_item_id"
+           , "sum"((CASE WHEN (CAST("d_date" AS DATE) < CAST('2000-03-11' AS DATE)) THEN "inv_quantity_on_hand" ELSE 0 END)) "inv_before"
+           , "sum"((CASE WHEN (CAST("d_date" AS DATE) >= CAST('2000-03-11' AS DATE)) THEN "inv_quantity_on_hand" ELSE 0 END)) "inv_after"
+           FROM
+             inventory
+           , warehouse
+           , item
+           , date_dim
+           WHERE ("i_current_price" BETWEEN DECIMAL '0.99' AND DECIMAL '1.49')
+              AND ("i_item_sk" = "inv_item_sk")
+              AND ("inv_warehouse_sk" = "w_warehouse_sk")
+              AND ("inv_date_sk" = "d_date_sk")
+              AND ("d_date" BETWEEN (CAST('2000-03-11' AS DATE) - INTERVAL  '30' DAY) AND (CAST('2000-03-11' AS DATE) + INTERVAL  '30' DAY))
+           GROUP BY "w_warehouse_name", "i_item_id"
+        )  x
+        WHERE ((CASE WHEN ("inv_before" > 0) THEN (CAST("inv_after" AS DECIMAL(7,2)) / "inv_before") ELSE null END) BETWEEN (DECIMAL '2.00' / DECIMAL '3.00') AND (DECIMAL '3.00' / DECIMAL '2.00'))
+        ORDER BY "w_warehouse_name" ASC, "i_item_id" ASC
+        LIMIT 100""",
+    "q22": """
+        SELECT
+          "i_product_name"
+        , "i_brand"
+        , "i_class"
+        , "i_category"
+        , "avg"("inv_quantity_on_hand") "qoh"
+        FROM
+          inventory
+        , date_dim
+        , item
+        WHERE ("inv_date_sk" = "d_date_sk")
+           AND ("inv_item_sk" = "i_item_sk")
+           AND ("d_month_seq" BETWEEN 1200 AND (1200 + 11))
+        GROUP BY ROLLUP (i_product_name, i_brand, i_class, i_category)
+        ORDER BY "qoh" ASC, "i_product_name" ASC, "i_brand" ASC, "i_class" ASC, "i_category" ASC
+        LIMIT 100""",
+    "q30": """
+        WITH
+          customer_total_return AS (
+           SELECT
+             "wr_returning_customer_sk" "ctr_customer_sk"
+           , "ca_state" "ctr_state"
+           , "sum"("wr_return_amt") "ctr_total_return"
+           FROM
+             web_returns
+           , date_dim
+           , customer_address
+           WHERE ("wr_returned_date_sk" = "d_date_sk")
+              AND ("d_year" = 2002)
+              AND ("wr_returning_addr_sk" = "ca_address_sk")
+           GROUP BY "wr_returning_customer_sk", "ca_state"
+        ) 
+        SELECT
+          "c_customer_id"
+        , "c_salutation"
+        , "c_first_name"
+        , "c_last_name"
+        , "c_preferred_cust_flag"
+        , "c_birth_day"
+        , "c_birth_month"
+        , "c_birth_year"
+        , "c_birth_country"
+        , "c_login"
+        , "c_email_address"
+        , "c_last_review_date_sk"
+        , "ctr_total_return"
+        FROM
+          customer_total_return ctr1
+        , customer_address
+        , customer
+        WHERE ("ctr1"."ctr_total_return" > (
+              SELECT ("avg"("ctr_total_return") * DECIMAL '1.2')
+              FROM
+                customer_total_return ctr2
+              WHERE ("ctr1"."ctr_state" = "ctr2"."ctr_state")
+           ))
+           AND ("ca_address_sk" = "c_current_addr_sk")
+           AND ("ca_state" = 'GA')
+           AND ("ctr1"."ctr_customer_sk" = "c_customer_sk")
+        ORDER BY "c_customer_id" ASC, "c_salutation" ASC, "c_first_name" ASC, "c_last_name" ASC, "c_preferred_cust_flag" ASC, "c_birth_day" ASC, "c_birth_month" ASC, "c_birth_year" ASC, "c_birth_country" ASC, "c_login" ASC, "c_email_address" ASC, "c_last_review_date_sk" ASC, "ctr_total_return" ASC
+        LIMIT 100""",
+    "q33": """
+        WITH
+          ss AS (
+           SELECT
+             "i_manufact_id"
+           , "sum"("ss_ext_sales_price") "total_sales"
+           FROM
+             store_sales
+           , date_dim
+           , customer_address
+           , item
+           WHERE ("i_manufact_id" IN (
+              SELECT "i_manufact_id"
+              FROM
+                item
+              WHERE ("i_category" IN ('Electronics'))
+           ))
+              AND ("ss_item_sk" = "i_item_sk")
+              AND ("ss_sold_date_sk" = "d_date_sk")
+              AND ("d_year" = 1998)
+              AND ("d_moy" = 5)
+              AND ("ss_addr_sk" = "ca_address_sk")
+              AND ("ca_gmt_offset" = -5)
+           GROUP BY "i_manufact_id"
+        ) 
+        , cs AS (
+           SELECT
+             "i_manufact_id"
+           , "sum"("cs_ext_sales_price") "total_sales"
+           FROM
+             catalog_sales
+           , date_dim
+           , customer_address
+           , item
+           WHERE ("i_manufact_id" IN (
+              SELECT "i_manufact_id"
+              FROM
+                item
+              WHERE ("i_category" IN ('Electronics'))
+           ))
+              AND ("cs_item_sk" = "i_item_sk")
+              AND ("cs_sold_date_sk" = "d_date_sk")
+              AND ("d_year" = 1998)
+              AND ("d_moy" = 5)
+              AND ("cs_bill_addr_sk" = "ca_address_sk")
+              AND ("ca_gmt_offset" = -5)
+           GROUP BY "i_manufact_id"
+        ) 
+        , ws AS (
+           SELECT
+             "i_manufact_id"
+           , "sum"("ws_ext_sales_price") "total_sales"
+           FROM
+             web_sales
+           , date_dim
+           , customer_address
+           , item
+           WHERE ("i_manufact_id" IN (
+              SELECT "i_manufact_id"
+              FROM
+                item
+              WHERE ("i_category" IN ('Electronics'))
+           ))
+              AND ("ws_item_sk" = "i_item_sk")
+              AND ("ws_sold_date_sk" = "d_date_sk")
+              AND ("d_year" = 1998)
+              AND ("d_moy" = 5)
+              AND ("ws_bill_addr_sk" = "ca_address_sk")
+              AND ("ca_gmt_offset" = -5)
+           GROUP BY "i_manufact_id"
+        ) 
+        SELECT
+          "i_manufact_id"
+        , "sum"("total_sales") "total_sales"
+        FROM
+          (
+           SELECT *
+           FROM
+             ss
+        UNION ALL    SELECT *
+           FROM
+             cs
+        UNION ALL    SELECT *
+           FROM
+             ws
+        )  tmp1
+        GROUP BY "i_manufact_id"
+        ORDER BY "total_sales" ASC
+        LIMIT 100""",
+    "q34": """
+        SELECT
+          "c_last_name"
+        , "c_first_name"
+        , "c_salutation"
+        , "c_preferred_cust_flag"
+        , "ss_ticket_number"
+        , "cnt"
+        FROM
+          (
+           SELECT
+             "ss_ticket_number"
+           , "ss_customer_sk"
+           , "count"(*) "cnt"
+           FROM
+             store_sales
+           , date_dim
+           , store
+           , household_demographics
+           WHERE ("store_sales"."ss_sold_date_sk" = "date_dim"."d_date_sk")
+              AND ("store_sales"."ss_store_sk" = "store"."s_store_sk")
+              AND ("store_sales"."ss_hdemo_sk" = "household_demographics"."hd_demo_sk")
+              AND (("date_dim"."d_dom" BETWEEN 1 AND 3)
+                 OR ("date_dim"."d_dom" BETWEEN 25 AND 28))
+              AND (("household_demographics"."hd_buy_potential" = '>10000')
+                 OR ("household_demographics"."hd_buy_potential" = 'Unknown'))
+              AND ("household_demographics"."hd_vehicle_count" > 0)
+              AND ((CASE WHEN ("household_demographics"."hd_vehicle_count" > 0) THEN (CAST("household_demographics"."hd_dep_count" AS DECIMAL(7,2)) / "household_demographics"."hd_vehicle_count") ELSE null END) > DECIMAL '1.2')
+              AND ("date_dim"."d_year" IN (1999   , (1999 + 1)   , (1999 + 2)))
+              AND ("store"."s_county" IN ('Williamson County'   , 'Williamson County'   , 'Williamson County'   , 'Williamson County'   , 'Williamson County'   , 'Williamson County'   , 'Williamson County'   , 'Williamson County'))
+           GROUP BY "ss_ticket_number", "ss_customer_sk"
+        )  dn
+        , customer
+        WHERE ("ss_customer_sk" = "c_customer_sk")
+           AND ("cnt" BETWEEN 15 AND 20)
+        ORDER BY "c_last_name" ASC, "c_first_name" ASC, "c_salutation" ASC, "c_preferred_cust_flag" DESC, "ss_ticket_number" ASC""",
+    "q39": """
+        WITH
+          inv AS (
+           SELECT
+             "w_warehouse_name"
+           , "w_warehouse_sk"
+           , "i_item_sk"
+           , "d_moy"
+           , "stdev"
+           , "mean"
+           , (CASE "mean" WHEN 0 THEN null ELSE ("stdev" / "mean") END) "cov"
+           FROM
+             (
+              SELECT
+                "w_warehouse_name"
+              , "w_warehouse_sk"
+              , "i_item_sk"
+              , "d_moy"
+              , "stddev_samp"("inv_quantity_on_hand") "stdev"
+              , "avg"("inv_quantity_on_hand") "mean"
+              FROM
+                inventory
+              , item
+              , warehouse
+              , date_dim
+              WHERE ("inv_item_sk" = "i_item_sk")
+                 AND ("inv_warehouse_sk" = "w_warehouse_sk")
+                 AND ("inv_date_sk" = "d_date_sk")
+                 AND ("d_year" = 2001)
+              GROUP BY "w_warehouse_name", "w_warehouse_sk", "i_item_sk", "d_moy"
+           )  foo
+           WHERE ((CASE "mean" WHEN 0 THEN 0 ELSE ("stdev" / "mean") END) > 1)
+        ) 
+        SELECT
+          "inv1"."w_warehouse_sk"
+        , "inv1"."i_item_sk"
+        , "inv1"."d_moy"
+        , "inv1"."mean"
+        , "inv1"."cov"
+        , "inv2"."w_warehouse_sk"
+        , "inv2"."i_item_sk"
+        , "inv2"."d_moy"
+        , "inv2"."mean"
+        , "inv2"."cov"
+        FROM
+          inv inv1
+        , inv inv2
+        WHERE ("inv1"."i_item_sk" = "inv2"."i_item_sk")
+           AND ("inv1"."w_warehouse_sk" = "inv2"."w_warehouse_sk")
+           AND ("inv1"."d_moy" = 1)
+           AND ("inv2"."d_moy" = (1 + 1))
+           AND ("inv1"."cov" > DECIMAL '1.5')
+        ORDER BY "inv1"."w_warehouse_sk" ASC, "inv1"."i_item_sk" ASC, "inv1"."d_moy" ASC, "inv1"."mean" ASC, "inv1"."cov" ASC, "inv2"."d_moy" ASC, "inv2"."mean" ASC, "inv2"."cov" ASC""",
+    "q41": """
+        SELECT DISTINCT "i_product_name"
+        FROM
+          item i1
+        WHERE ("i_manufact_id" BETWEEN 738 AND (738 + 40))
+           AND ((
+              SELECT "count"(*) "item_cnt"
+              FROM
+                item
+              WHERE (("i_manufact" = "i1"."i_manufact")
+                    AND ((("i_category" = 'Women')
+                          AND (("i_color" = 'powder')
+                             OR ("i_color" = 'khaki'))
+                          AND (("i_units" = 'Ounce')
+                             OR ("i_units" = 'Oz'))
+                          AND (("i_size" = 'medium')
+                             OR ("i_size" = 'extra large')))
+                       OR (("i_category" = 'Women')
+                          AND (("i_color" = 'brown')
+                             OR ("i_color" = 'honeydew'))
+                          AND (("i_units" = 'Bunch')
+                             OR ("i_units" = 'Ton'))
+                          AND (("i_size" = 'N/A')
+                             OR ("i_size" = 'small')))
+                       OR (("i_category" = 'Men')
+                          AND (("i_color" = 'floral')
+                             OR ("i_color" = 'deep'))
+                          AND (("i_units" = 'N/A')
+                             OR ("i_units" = 'Dozen'))
+                          AND (("i_size" = 'petite')
+                             OR ("i_size" = 'large')))
+                       OR (("i_category" = 'Men')
+                          AND (("i_color" = 'light')
+                             OR ("i_color" = 'cornflower'))
+                          AND (("i_units" = 'Box')
+                             OR ("i_units" = 'Pound'))
+                          AND (("i_size" = 'medium')
+                             OR ("i_size" = 'extra large')))))
+                 OR (("i_manufact" = "i1"."i_manufact")
+                    AND ((("i_category" = 'Women')
+                          AND (("i_color" = 'midnight')
+                             OR ("i_color" = 'snow'))
+                          AND (("i_units" = 'Pallet')
+                             OR ("i_units" = 'Gross'))
+                          AND (("i_size" = 'medium')
+                             OR ("i_size" = 'extra large')))
+                       OR (("i_category" = 'Women')
+                          AND (("i_color" = 'cyan')
+                             OR ("i_color" = 'papaya'))
+                          AND (("i_units" = 'Cup')
+                             OR ("i_units" = 'Dram'))
+                          AND (("i_size" = 'N/A')
+                             OR ("i_size" = 'small')))
+                       OR (("i_category" = 'Men')
+                          AND (("i_color" = 'orange')
+                             OR ("i_color" = 'frosted'))
+                          AND (("i_units" = 'Each')
+                             OR ("i_units" = 'Tbl'))
+                          AND (("i_size" = 'petite')
+                             OR ("i_size" = 'large')))
+                       OR (("i_category" = 'Men')
+                          AND (("i_color" = 'forest')
+                             OR ("i_color" = 'ghost'))
+                          AND (("i_units" = 'Lb')
+                             OR ("i_units" = 'Bundle'))
+                          AND (("i_size" = 'medium')
+                             OR ("i_size" = 'extra large')))))
+           ) > 0)
+        ORDER BY "i_product_name" ASC
+        LIMIT 100""",
+    "q44": """
+        SELECT
+          "asceding"."rnk"
+        , "i1"."i_product_name" "best_performing"
+        , "i2"."i_product_name" "worst_performing"
+        FROM
+          (
+           SELECT *
+           FROM
+             (
+              SELECT
+                "item_sk"
+              , "rank"() OVER (ORDER BY "rank_col" ASC) "rnk"
+              FROM
+                (
+                 SELECT
+                   "ss_item_sk" "item_sk"
+                 , "avg"("ss_net_profit") "rank_col"
+                 FROM
+                   store_sales ss1
+                 WHERE ("ss_store_sk" = 4)
+                 GROUP BY "ss_item_sk"
+                 HAVING ("avg"("ss_net_profit") > (DECIMAL '0.9' * (
+                          SELECT "avg"("ss_net_profit") "rank_col"
+                          FROM
+                            store_sales
+                          WHERE ("ss_store_sk" = 4)
+                             AND ("ss_addr_sk" IS NULL)
+                          GROUP BY "ss_store_sk"
+                       )))
+              )  v1
+           )  v11
+           WHERE ("rnk" < 11)
+        )  asceding
+        , (
+           SELECT *
+           FROM
+             (
+              SELECT
+                "item_sk"
+              , "rank"() OVER (ORDER BY "rank_col" DESC) "rnk"
+              FROM
+                (
+                 SELECT
+                   "ss_item_sk" "item_sk"
+                 , "avg"("ss_net_profit") "rank_col"
+                 FROM
+                   store_sales ss1
+                 WHERE ("ss_store_sk" = 4)
+                 GROUP BY "ss_item_sk"
+                 HAVING ("avg"("ss_net_profit") > (DECIMAL '0.9' * (
+                          SELECT "avg"("ss_net_profit") "rank_col"
+                          FROM
+                            store_sales
+                          WHERE ("ss_store_sk" = 4)
+                             AND ("ss_addr_sk" IS NULL)
+                          GROUP BY "ss_store_sk"
+                       )))
+              )  v2
+           )  v21
+           WHERE ("rnk" < 11)
+        )  descending
+        , item i1
+        , item i2
+        WHERE ("asceding"."rnk" = "descending"."rnk")
+           AND ("i1"."i_item_sk" = "asceding"."item_sk")
+           AND ("i2"."i_item_sk" = "descending"."item_sk")
+        ORDER BY "asceding"."rnk" ASC
+        LIMIT 100""",
+    "q47": """
+        WITH
+          v1 AS (
+           SELECT
+             "i_category"
+           , "i_brand"
+           , "s_store_name"
+           , "s_company_name"
+           , "d_year"
+           , "d_moy"
+           , "sum"("ss_sales_price") "sum_sales"
+           , "avg"("sum"("ss_sales_price")) OVER (PARTITION BY "i_category", "i_brand", "s_store_name", "s_company_name", "d_year") "avg_monthly_sales"
+           , "rank"() OVER (PARTITION BY "i_category", "i_brand", "s_store_name", "s_company_name" ORDER BY "d_year" ASC, "d_moy" ASC) "rn"
+           FROM
+             item
+           , store_sales
+           , date_dim
+           , store
+           WHERE ("ss_item_sk" = "i_item_sk")
+              AND ("ss_sold_date_sk" = "d_date_sk")
+              AND ("ss_store_sk" = "s_store_sk")
+              AND (("d_year" = 1999)
+                 OR (("d_year" = (1999 - 1))
+                    AND ("d_moy" = 12))
+                 OR (("d_year" = (1999 + 1))
+                    AND ("d_moy" = 1)))
+           GROUP BY "i_category", "i_brand", "s_store_name", "s_company_name", "d_year", "d_moy"
+        ) 
+        , v2 AS (
+           SELECT
+             "v1"."i_category"
+           , "v1"."i_brand"
+           , "v1"."s_store_name"
+           , "v1"."s_company_name"
+           , "v1"."d_year"
+           , "v1"."d_moy"
+           , "v1"."avg_monthly_sales"
+           , "v1"."sum_sales"
+           , "v1_lag"."sum_sales" "psum"
+           , "v1_lead"."sum_sales" "nsum"
+           FROM
+             v1
+           , v1 v1_lag
+           , v1 v1_lead
+           WHERE ("v1"."i_category" = "v1_lag"."i_category")
+              AND ("v1"."i_category" = "v1_lead"."i_category")
+              AND ("v1"."i_brand" = "v1_lag"."i_brand")
+              AND ("v1"."i_brand" = "v1_lead"."i_brand")
+              AND ("v1"."s_store_name" = "v1_lag"."s_store_name")
+              AND ("v1"."s_store_name" = "v1_lead"."s_store_name")
+              AND ("v1"."s_company_name" = "v1_lag"."s_company_name")
+              AND ("v1"."s_company_name" = "v1_lead"."s_company_name")
+              AND ("v1"."rn" = ("v1_lag"."rn" + 1))
+              AND ("v1"."rn" = ("v1_lead"."rn" - 1))
+        ) 
+        SELECT *
+        FROM
+          v2
+        WHERE ("d_year" = 1999)
+           AND ("avg_monthly_sales" > 0)
+           AND ((CASE WHEN ("avg_monthly_sales" > 0) THEN ("abs"(("sum_sales" - "avg_monthly_sales")) / "avg_monthly_sales") ELSE null END) > DECIMAL '0.1')
+        ORDER BY ("sum_sales" - "avg_monthly_sales") ASC, 3 ASC
+        LIMIT 100""",
+    "q56": """
+        WITH
+          ss AS (
+           SELECT
+             "i_item_id"
+           , "sum"("ss_ext_sales_price") "total_sales"
+           FROM
+             store_sales
+           , date_dim
+           , customer_address
+           , item
+           WHERE ("i_item_id" IN (
+              SELECT "i_item_id"
+              FROM
+                item
+              WHERE ("i_color" IN ('slate'      , 'blanched'      , 'burnished'))
+           ))
+              AND ("ss_item_sk" = "i_item_sk")
+              AND ("ss_sold_date_sk" = "d_date_sk")
+              AND ("d_year" = 2001)
+              AND ("d_moy" = 2)
+              AND ("ss_addr_sk" = "ca_address_sk")
+              AND ("ca_gmt_offset" = -5)
+           GROUP BY "i_item_id"
+        ) 
+        , cs AS (
+           SELECT
+             "i_item_id"
+           , "sum"("cs_ext_sales_price") "total_sales"
+           FROM
+             catalog_sales
+           , date_dim
+           , customer_address
+           , item
+           WHERE ("i_item_id" IN (
+              SELECT "i_item_id"
+              FROM
+                item
+              WHERE ("i_color" IN ('slate'      , 'blanched'      , 'burnished'))
+           ))
+              AND ("cs_item_sk" = "i_item_sk")
+              AND ("cs_sold_date_sk" = "d_date_sk")
+              AND ("d_year" = 2001)
+              AND ("d_moy" = 2)
+              AND ("cs_bill_addr_sk" = "ca_address_sk")
+              AND ("ca_gmt_offset" = -5)
+           GROUP BY "i_item_id"
+        ) 
+        , ws AS (
+           SELECT
+             "i_item_id"
+           , "sum"("ws_ext_sales_price") "total_sales"
+           FROM
+             web_sales
+           , date_dim
+           , customer_address
+           , item
+           WHERE ("i_item_id" IN (
+              SELECT "i_item_id"
+              FROM
+                item
+              WHERE ("i_color" IN ('slate'      , 'blanched'      , 'burnished'))
+           ))
+              AND ("ws_item_sk" = "i_item_sk")
+              AND ("ws_sold_date_sk" = "d_date_sk")
+              AND ("d_year" = 2001)
+              AND ("d_moy" = 2)
+              AND ("ws_bill_addr_sk" = "ca_address_sk")
+              AND ("ca_gmt_offset" = -5)
+           GROUP BY "i_item_id"
+        ) 
+        SELECT
+          "i_item_id"
+        , "sum"("total_sales") "total_sales"
+        FROM
+          (
+           SELECT *
+           FROM
+             ss
+        UNION ALL    SELECT *
+           FROM
+             cs
+        UNION ALL    SELECT *
+           FROM
+             ws
+        )  tmp1
+        GROUP BY "i_item_id"
+        ORDER BY "total_sales" ASC, "i_item_id" ASC
+        LIMIT 100""",
+    "q58": """
+        WITH
+          ss_items AS (
+           SELECT
+             "i_item_id" "item_id"
+           , "sum"("ss_ext_sales_price") "ss_item_rev"
+           FROM
+             store_sales
+           , item
+           , date_dim
+           WHERE ("ss_item_sk" = "i_item_sk")
+              AND ("d_date" IN (
+              SELECT "d_date"
+              FROM
+                date_dim
+              WHERE ("d_week_seq" = (
+                    SELECT "d_week_seq"
+                    FROM
+                      date_dim
+                    WHERE ("d_date" = CAST('2000-01-03' AS DATE))
+                 ))
+           ))
+              AND ("ss_sold_date_sk" = "d_date_sk")
+           GROUP BY "i_item_id"
+        ) 
+        , cs_items AS (
+           SELECT
+             "i_item_id" "item_id"
+           , "sum"("cs_ext_sales_price") "cs_item_rev"
+           FROM
+             catalog_sales
+           , item
+           , date_dim
+           WHERE ("cs_item_sk" = "i_item_sk")
+              AND ("d_date" IN (
+              SELECT "d_date"
+              FROM
+                date_dim
+              WHERE ("d_week_seq" = (
+                    SELECT "d_week_seq"
+                    FROM
+                      date_dim
+                    WHERE ("d_date" = CAST('2000-01-03' AS DATE))
+                 ))
+           ))
+              AND ("cs_sold_date_sk" = "d_date_sk")
+           GROUP BY "i_item_id"
+        ) 
+        , ws_items AS (
+           SELECT
+             "i_item_id" "item_id"
+           , "sum"("ws_ext_sales_price") "ws_item_rev"
+           FROM
+             web_sales
+           , item
+           , date_dim
+           WHERE ("ws_item_sk" = "i_item_sk")
+              AND ("d_date" IN (
+              SELECT "d_date"
+              FROM
+                date_dim
+              WHERE ("d_week_seq" = (
+                    SELECT "d_week_seq"
+                    FROM
+                      date_dim
+                    WHERE ("d_date" = CAST('2000-01-03' AS DATE))
+                 ))
+           ))
+              AND ("ws_sold_date_sk" = "d_date_sk")
+           GROUP BY "i_item_id"
+        ) 
+        SELECT
+          "ss_items"."item_id"
+        , "ss_item_rev"
+        , CAST(((("ss_item_rev" / ((CAST("ss_item_rev" AS DECIMAL(16,7)) + "cs_item_rev") + "ws_item_rev")) / 3) * 100) AS DECIMAL(7,2)) "ss_dev"
+        , "cs_item_rev"
+        , CAST(((("cs_item_rev" / ((CAST("ss_item_rev" AS DECIMAL(16,7)) + "cs_item_rev") + "ws_item_rev")) / 3) * 100) AS DECIMAL(7,2)) "cs_dev"
+        , "ws_item_rev"
+        , CAST(((("ws_item_rev" / ((CAST("ss_item_rev" AS DECIMAL(16,7)) + "cs_item_rev") + "ws_item_rev")) / 3) * 100) AS DECIMAL(7,2)) "ws_dev"
+        , ((("ss_item_rev" + "cs_item_rev") + "ws_item_rev") / 3) "average"
+        FROM
+          ss_items
+        , cs_items
+        , ws_items
+        WHERE ("ss_items"."item_id" = "cs_items"."item_id")
+           AND ("ss_items"."item_id" = "ws_items"."item_id")
+           AND ("ss_item_rev" BETWEEN (DECIMAL '0.9' * "cs_item_rev") AND (DECIMAL '1.1' * "cs_item_rev"))
+           AND ("ss_item_rev" BETWEEN (DECIMAL '0.9' * "ws_item_rev") AND (DECIMAL '1.1' * "ws_item_rev"))
+           AND ("cs_item_rev" BETWEEN (DECIMAL '0.9' * "ss_item_rev") AND (DECIMAL '1.1' * "ss_item_rev"))
+           AND ("cs_item_rev" BETWEEN (DECIMAL '0.9' * "ws_item_rev") AND (DECIMAL '1.1' * "ws_item_rev"))
+           AND ("ws_item_rev" BETWEEN (DECIMAL '0.9' * "ss_item_rev") AND (DECIMAL '1.1' * "ss_item_rev"))
+           AND ("ws_item_rev" BETWEEN (DECIMAL '0.9' * "cs_item_rev") AND (DECIMAL '1.1' * "cs_item_rev"))
+        ORDER BY "ss_items"."item_id" ASC, "ss_item_rev" ASC
+        LIMIT 100""",
+    "q60": """
+        WITH
+          ss AS (
+           SELECT
+             "i_item_id"
+           , "sum"("ss_ext_sales_price") "total_sales"
+           FROM
+             store_sales
+           , date_dim
+           , customer_address
+           , item
+           WHERE ("i_item_id" IN (
+              SELECT "i_item_id"
+              FROM
+                item
+              WHERE ("i_category" IN ('Music'))
+           ))
+              AND ("ss_item_sk" = "i_item_sk")
+              AND ("ss_sold_date_sk" = "d_date_sk")
+              AND ("d_year" = 1998)
+              AND ("d_moy" = 9)
+              AND ("ss_addr_sk" = "ca_address_sk")
+              AND ("ca_gmt_offset" = -5)
+           GROUP BY "i_item_id"
+        ) 
+        , cs AS (
+           SELECT
+             "i_item_id"
+           , "sum"("cs_ext_sales_price") "total_sales"
+           FROM
+             catalog_sales
+           , date_dim
+           , customer_address
+           , item
+           WHERE ("i_item_id" IN (
+              SELECT "i_item_id"
+              FROM
+                item
+              WHERE ("i_category" IN ('Music'))
+           ))
+              AND ("cs_item_sk" = "i_item_sk")
+              AND ("cs_sold_date_sk" = "d_date_sk")
+              AND ("d_year" = 1998)
+              AND ("d_moy" = 9)
+              AND ("cs_bill_addr_sk" = "ca_address_sk")
+              AND ("ca_gmt_offset" = -5)
+           GROUP BY "i_item_id"
+        ) 
+        , ws AS (
+           SELECT
+             "i_item_id"
+           , "sum"("ws_ext_sales_price") "total_sales"
+           FROM
+             web_sales
+           , date_dim
+           , customer_address
+           , item
+           WHERE ("i_item_id" IN (
+              SELECT "i_item_id"
+              FROM
+                item
+              WHERE ("i_category" IN ('Music'))
+           ))
+              AND ("ws_item_sk" = "i_item_sk")
+              AND ("ws_sold_date_sk" = "d_date_sk")
+              AND ("d_year" = 1998)
+              AND ("d_moy" = 9)
+              AND ("ws_bill_addr_sk" = "ca_address_sk")
+              AND ("ca_gmt_offset" = -5)
+           GROUP BY "i_item_id"
+        ) 
+        SELECT
+          "i_item_id"
+        , "sum"("total_sales") "total_sales"
+        FROM
+          (
+           SELECT *
+           FROM
+             ss
+        UNION ALL    SELECT *
+           FROM
+             cs
+        UNION ALL    SELECT *
+           FROM
+             ws
+        )  tmp1
+        GROUP BY "i_item_id"
+        ORDER BY "i_item_id" ASC, "total_sales" ASC
+        LIMIT 100""",
+    "q67": """
+        SELECT *
+        FROM
+          (
+           SELECT
+             "i_category"
+           , "i_class"
+           , "i_brand"
+           , "i_product_name"
+           , "d_year"
+           , "d_qoy"
+           , "d_moy"
+           , "s_store_id"
+           , "sumsales"
+           , "rank"() OVER (PARTITION BY "i_category" ORDER BY "sumsales" DESC) "rk"
+           FROM
+             (
+              SELECT
+                "i_category"
+              , "i_class"
+              , "i_brand"
+              , "i_product_name"
+              , "d_year"
+              , "d_qoy"
+              , "d_moy"
+              , "s_store_id"
+              , "sum"(COALESCE(("ss_sales_price" * "ss_quantity"), 0)) "sumsales"
+              FROM
+                store_sales
+              , date_dim
+              , store
+              , item
+              WHERE ("ss_sold_date_sk" = "d_date_sk")
+                 AND ("ss_item_sk" = "i_item_sk")
+                 AND ("ss_store_sk" = "s_store_sk")
+                 AND ("d_month_seq" BETWEEN 1200 AND (1200 + 11))
+              GROUP BY ROLLUP (i_category, i_class, i_brand, i_product_name, d_year, d_qoy, d_moy, s_store_id)
+           )  dw1
+        )  dw2
+        WHERE ("rk" <= 100)
+        ORDER BY "i_category" ASC, "i_class" ASC, "i_brand" ASC, "i_product_name" ASC, "d_year" ASC, "d_qoy" ASC, "d_moy" ASC, "s_store_id" ASC, "sumsales" ASC, "rk" ASC
+        LIMIT 100""",
+    "q68": """
+        SELECT
+          "c_last_name"
+        , "c_first_name"
+        , "ca_city"
+        , "bought_city"
+        , "ss_ticket_number"
+        , "extended_price"
+        , "extended_tax"
+        , "list_price"
+        FROM
+          (
+           SELECT
+             "ss_ticket_number"
+           , "ss_customer_sk"
+           , "ca_city" "bought_city"
+           , "sum"("ss_ext_sales_price") "extended_price"
+           , "sum"("ss_ext_list_price") "list_price"
+           , "sum"("ss_ext_tax") "extended_tax"
+           FROM
+             store_sales
+           , date_dim
+           , store
+           , household_demographics
+           , customer_address
+           WHERE ("store_sales"."ss_sold_date_sk" = "date_dim"."d_date_sk")
+              AND ("store_sales"."ss_store_sk" = "store"."s_store_sk")
+              AND ("store_sales"."ss_hdemo_sk" = "household_demographics"."hd_demo_sk")
+              AND ("store_sales"."ss_addr_sk" = "customer_address"."ca_address_sk")
+              AND ("date_dim"."d_dom" BETWEEN 1 AND 2)
+              AND (("household_demographics"."hd_dep_count" = 4)
+                 OR ("household_demographics"."hd_vehicle_count" = 3))
+              AND ("date_dim"."d_year" IN (1999   , (1999 + 1)   , (1999 + 2)))
+              AND ("store"."s_city" IN ('Midway'   , 'Fairview'))
+           GROUP BY "ss_ticket_number", "ss_customer_sk", "ss_addr_sk", "ca_city"
+        )  dn
+        , customer
+        , customer_address current_addr
+        WHERE ("ss_customer_sk" = "c_customer_sk")
+           AND ("customer"."c_current_addr_sk" = "current_addr"."ca_address_sk")
+           AND ("current_addr"."ca_city" <> "bought_city")
+        ORDER BY "c_last_name" ASC, "ss_ticket_number" ASC
+        LIMIT 100""",
+    "q71": """
+        SELECT
+          "i_brand_id" "brand_id"
+        , "i_brand" "brand"
+        , "t_hour"
+        , "t_minute"
+        , "sum"("ext_price") "ext_price"
+        FROM
+          item
+        , (
+           SELECT
+             "ws_ext_sales_price" "ext_price"
+           , "ws_sold_date_sk" "sold_date_sk"
+           , "ws_item_sk" "sold_item_sk"
+           , "ws_sold_time_sk" "time_sk"
+           FROM
+             web_sales
+           , date_dim
+           WHERE ("d_date_sk" = "ws_sold_date_sk")
+              AND ("d_moy" = 11)
+              AND ("d_year" = 1999)
+        UNION ALL    SELECT
+             "cs_ext_sales_price" "ext_price"
+           , "cs_sold_date_sk" "sold_date_sk"
+           , "cs_item_sk" "sold_item_sk"
+           , "cs_sold_time_sk" "time_sk"
+           FROM
+             catalog_sales
+           , date_dim
+           WHERE ("d_date_sk" = "cs_sold_date_sk")
+              AND ("d_moy" = 11)
+              AND ("d_year" = 1999)
+        UNION ALL    SELECT
+             "ss_ext_sales_price" "ext_price"
+           , "ss_sold_date_sk" "sold_date_sk"
+           , "ss_item_sk" "sold_item_sk"
+           , "ss_sold_time_sk" "time_sk"
+           FROM
+             store_sales
+           , date_dim
+           WHERE ("d_date_sk" = "ss_sold_date_sk")
+              AND ("d_moy" = 11)
+              AND ("d_year" = 1999)
+        )  tmp
+        , time_dim
+        WHERE ("sold_item_sk" = "i_item_sk")
+           AND ("i_manager_id" = 1)
+           AND ("time_sk" = "t_time_sk")
+           AND (("t_meal_time" = 'breakfast')
+              OR ("t_meal_time" = 'dinner'))
+        GROUP BY "i_brand", "i_brand_id", "t_hour", "t_minute"
+        ORDER BY "ext_price" DESC, "i_brand_id" ASC""",
+    "q73": """
+        SELECT
+          "c_last_name"
+        , "c_first_name"
+        , "c_salutation"
+        , "c_preferred_cust_flag"
+        , "ss_ticket_number"
+        , "cnt"
+        FROM
+          (
+           SELECT
+             "ss_ticket_number"
+           , "ss_customer_sk"
+           , "count"(*) "cnt"
+           FROM
+             store_sales
+           , date_dim
+           , store
+           , household_demographics
+           WHERE ("store_sales"."ss_sold_date_sk" = "date_dim"."d_date_sk")
+              AND ("store_sales"."ss_store_sk" = "store"."s_store_sk")
+              AND ("store_sales"."ss_hdemo_sk" = "household_demographics"."hd_demo_sk")
+              AND ("date_dim"."d_dom" BETWEEN 1 AND 2)
+              AND (("household_demographics"."hd_buy_potential" = '>10000')
+                 OR ("household_demographics"."hd_buy_potential" = 'Unknown'))
+              AND ("household_demographics"."hd_vehicle_count" > 0)
+              AND ((CASE WHEN ("household_demographics"."hd_vehicle_count" > 0) THEN (CAST("household_demographics"."hd_dep_count" AS DECIMAL(7,2)) / "household_demographics"."hd_vehicle_count") ELSE null END) > 1)
+              AND ("date_dim"."d_year" IN (1999   , (1999 + 1)   , (1999 + 2)))
+              AND ("store"."s_county" IN ('Williamson County'   , 'Franklin Parish'   , 'Bronx County'   , 'Orange County'))
+           GROUP BY "ss_ticket_number", "ss_customer_sk"
+        )  dj
+        , customer
+        WHERE ("ss_customer_sk" = "c_customer_sk")
+           AND ("cnt" BETWEEN 1 AND 5)
+        ORDER BY "cnt" DESC, "c_last_name" ASC""",
+    "q76": """
+        SELECT
+          "channel"
+        , "col_name"
+        , "d_year"
+        , "d_qoy"
+        , "i_category"
+        , "count"(*) "sales_cnt"
+        , "sum"("ext_sales_price") "sales_amt"
+        FROM
+          (
+           SELECT
+             'store' "channel"
+           , 'ss_store_sk' "col_name"
+           , "d_year"
+           , "d_qoy"
+           , "i_category"
+           , "ss_ext_sales_price" "ext_sales_price"
+           FROM
+             store_sales
+           , item
+           , date_dim
+           WHERE ("ss_store_sk" IS NULL)
+              AND ("ss_sold_date_sk" = "d_date_sk")
+              AND ("ss_item_sk" = "i_item_sk")
+        UNION ALL    SELECT
+             'web' "channel"
+           , 'ws_ship_customer_sk' "col_name"
+           , "d_year"
+           , "d_qoy"
+           , "i_category"
+           , "ws_ext_sales_price" "ext_sales_price"
+           FROM
+             web_sales
+           , item
+           , date_dim
+           WHERE ("ws_ship_customer_sk" IS NULL)
+              AND ("ws_sold_date_sk" = "d_date_sk")
+              AND ("ws_item_sk" = "i_item_sk")
+        UNION ALL    SELECT
+             'catalog' "channel"
+           , 'cs_ship_addr_sk' "col_name"
+           , "d_year"
+           , "d_qoy"
+           , "i_category"
+           , "cs_ext_sales_price" "ext_sales_price"
+           FROM
+             catalog_sales
+           , item
+           , date_dim
+           WHERE ("cs_ship_addr_sk" IS NULL)
+              AND ("cs_sold_date_sk" = "d_date_sk")
+              AND ("cs_item_sk" = "i_item_sk")
+        )  foo
+        GROUP BY "channel", "col_name", "d_year", "d_qoy", "i_category"
+        ORDER BY "channel" ASC, "col_name" ASC, "d_year" ASC, "d_qoy" ASC, "i_category" ASC
+        LIMIT 100""",
+    "q80": """
+        WITH
+          ssr AS (
+           SELECT
+             "s_store_id" "store_id"
+           , "sum"("ss_ext_sales_price") "sales"
+           , "sum"(COALESCE("sr_return_amt", 0)) "returns"
+           , "sum"(("ss_net_profit" - COALESCE("sr_net_loss", 0))) "profit"
+           FROM
+             (store_sales
+           LEFT JOIN store_returns ON ("ss_item_sk" = "sr_item_sk")
+              AND ("ss_ticket_number" = "sr_ticket_number"))
+           , date_dim
+           , store
+           , item
+           , promotion
+           WHERE ("ss_sold_date_sk" = "d_date_sk")
+              AND (CAST("d_date" AS DATE) BETWEEN CAST('2000-08-23' AS DATE) AND (CAST('2000-08-23' AS DATE) + INTERVAL  '30' DAY))
+              AND ("ss_store_sk" = "s_store_sk")
+              AND ("ss_item_sk" = "i_item_sk")
+              AND ("i_current_price" > 50)
+              AND ("ss_promo_sk" = "p_promo_sk")
+              AND ("p_channel_tv" = 'N')
+           GROUP BY "s_store_id"
+        ) 
+        , csr AS (
+           SELECT
+             "cp_catalog_page_id" "catalog_page_id"
+           , "sum"("cs_ext_sales_price") "sales"
+           , "sum"(COALESCE("cr_return_amount", 0)) "returns"
+           , "sum"(("cs_net_profit" - COALESCE("cr_net_loss", 0))) "profit"
+           FROM
+             (catalog_sales
+           LEFT JOIN catalog_returns ON ("cs_item_sk" = "cr_item_sk")
+              AND ("cs_order_number" = "cr_order_number"))
+           , date_dim
+           , catalog_page
+           , item
+           , promotion
+           WHERE ("cs_sold_date_sk" = "d_date_sk")
+              AND (CAST("d_date" AS DATE) BETWEEN CAST('2000-08-23' AS DATE) AND (CAST('2000-08-23' AS DATE) + INTERVAL  '30' DAY))
+              AND ("cs_catalog_page_sk" = "cp_catalog_page_sk")
+              AND ("cs_item_sk" = "i_item_sk")
+              AND ("i_current_price" > 50)
+              AND ("cs_promo_sk" = "p_promo_sk")
+              AND ("p_channel_tv" = 'N')
+           GROUP BY "cp_catalog_page_id"
+        ) 
+        , wsr AS (
+           SELECT
+             "web_site_id"
+           , "sum"("ws_ext_sales_price") "sales"
+           , "sum"(COALESCE("wr_return_amt", 0)) "returns"
+           , "sum"(("ws_net_profit" - COALESCE("wr_net_loss", 0))) "profit"
+           FROM
+             (web_sales
+           LEFT JOIN web_returns ON ("ws_item_sk" = "wr_item_sk")
+              AND ("ws_order_number" = "wr_order_number"))
+           , date_dim
+           , web_site
+           , item
+           , promotion
+           WHERE ("ws_sold_date_sk" = "d_date_sk")
+              AND (CAST("d_date" AS DATE) BETWEEN CAST('2000-08-23' AS DATE) AND (CAST('2000-08-23' AS DATE) + INTERVAL  '30' DAY))
+              AND ("ws_web_site_sk" = "web_site_sk")
+              AND ("ws_item_sk" = "i_item_sk")
+              AND ("i_current_price" > 50)
+              AND ("ws_promo_sk" = "p_promo_sk")
+              AND ("p_channel_tv" = 'N')
+           GROUP BY "web_site_id"
+        ) 
+        SELECT
+          "channel"
+        , "id"
+        , "sum"("sales") "sales"
+        , "sum"("returns") "returns"
+        , "sum"("profit") "profit"
+        FROM
+          (
+           SELECT
+             'store channel' "channel"
+           , "concat"('store', "store_id") "id"
+           , "sales"
+           , "returns"
+           , "profit"
+           FROM
+             ssr
+        UNION ALL    SELECT
+             'catalog channel' "channel"
+           , "concat"('catalog_page', "catalog_page_id") "id"
+           , "sales"
+           , "returns"
+           , "profit"
+           FROM
+             csr
+        UNION ALL    SELECT
+             'web channel' "channel"
+           , "concat"('web_site', "web_site_id") "id"
+           , "sales"
+           , "returns"
+           , "profit"
+           FROM
+             wsr
+        )  x
+        GROUP BY ROLLUP (channel, id)
+        ORDER BY "channel" ASC, "id" ASC
+        LIMIT 100""",
+    "q81": """
+        WITH
+          customer_total_return AS (
+           SELECT
+             "cr_returning_customer_sk" "ctr_customer_sk"
+           , "ca_state" "ctr_state"
+           , "sum"("cr_return_amt_inc_tax") "ctr_total_return"
+           FROM
+             catalog_returns
+           , date_dim
+           , customer_address
+           WHERE ("cr_returned_date_sk" = "d_date_sk")
+              AND ("d_year" = 2000)
+              AND ("cr_returning_addr_sk" = "ca_address_sk")
+           GROUP BY "cr_returning_customer_sk", "ca_state"
+        ) 
+        SELECT
+          "c_customer_id"
+        , "c_salutation"
+        , "c_first_name"
+        , "c_last_name"
+        , "ca_street_number"
+        , "ca_street_name"
+        , "ca_street_type"
+        , "ca_suite_number"
+        , "ca_city"
+        , "ca_county"
+        , "ca_state"
+        , "ca_zip"
+        , "ca_country"
+        , "ca_gmt_offset"
+        , "ca_location_type"
+        , "ctr_total_return"
+        FROM
+          customer_total_return ctr1
+        , customer_address
+        , customer
+        WHERE ("ctr1"."ctr_total_return" > (
+              SELECT ("avg"("ctr_total_return") * DECIMAL '1.2')
+              FROM
+                customer_total_return ctr2
+              WHERE ("ctr1"."ctr_state" = "ctr2"."ctr_state")
+           ))
+           AND ("ca_address_sk" = "c_current_addr_sk")
+           AND ("ca_state" = 'GA')
+           AND ("ctr1"."ctr_customer_sk" = "c_customer_sk")
+        ORDER BY "c_customer_id" ASC, "c_salutation" ASC, "c_first_name" ASC, "c_last_name" ASC, "ca_street_number" ASC, "ca_street_name" ASC, "ca_street_type" ASC, "ca_suite_number" ASC, "ca_city" ASC, "ca_county" ASC, "ca_state" ASC, "ca_zip" ASC, "ca_country" ASC, "ca_gmt_offset" ASC, "ca_location_type" ASC, "ctr_total_return" ASC
+        LIMIT 100""",
+    "q83": """
+        WITH
+          sr_items AS (
+           SELECT
+             "i_item_id" "item_id"
+           , "sum"("sr_return_quantity") "sr_item_qty"
+           FROM
+             store_returns
+           , item
+           , date_dim
+           WHERE ("sr_item_sk" = "i_item_sk")
+              AND ("d_date" IN (
+              SELECT "d_date"
+              FROM
+                date_dim
+              WHERE ("d_week_seq" IN (
+                 SELECT "d_week_seq"
+                 FROM
+                   date_dim
+                 WHERE ("d_date" IN (CAST('2000-06-30' AS DATE)         , CAST('2000-09-27' AS DATE)         , CAST('2000-11-17' AS DATE)))
+              ))
+           ))
+              AND ("sr_returned_date_sk" = "d_date_sk")
+           GROUP BY "i_item_id"
+        ) 
+        , cr_items AS (
+           SELECT
+             "i_item_id" "item_id"
+           , "sum"("cr_return_quantity") "cr_item_qty"
+           FROM
+             catalog_returns
+           , item
+           , date_dim
+           WHERE ("cr_item_sk" = "i_item_sk")
+              AND ("d_date" IN (
+              SELECT "d_date"
+              FROM
+                date_dim
+              WHERE ("d_week_seq" IN (
+                 SELECT "d_week_seq"
+                 FROM
+                   date_dim
+                 WHERE ("d_date" IN (CAST('2000-06-30' AS DATE)         , CAST('2000-09-27' AS DATE)         , CAST('2000-11-17' AS DATE)))
+              ))
+           ))
+              AND ("cr_returned_date_sk" = "d_date_sk")
+           GROUP BY "i_item_id"
+        ) 
+        , wr_items AS (
+           SELECT
+             "i_item_id" "item_id"
+           , "sum"("wr_return_quantity") "wr_item_qty"
+           FROM
+             web_returns
+           , item
+           , date_dim
+           WHERE ("wr_item_sk" = "i_item_sk")
+              AND ("d_date" IN (
+              SELECT "d_date"
+              FROM
+                date_dim
+              WHERE ("d_week_seq" IN (
+                 SELECT "d_week_seq"
+                 FROM
+                   date_dim
+                 WHERE ("d_date" IN (CAST('2000-06-30' AS DATE)         , CAST('2000-09-27' AS DATE)         , CAST('2000-11-17' AS DATE)))
+              ))
+           ))
+              AND ("wr_returned_date_sk" = "d_date_sk")
+           GROUP BY "i_item_id"
+        ) 
+        SELECT
+          "sr_items"."item_id"
+        , "sr_item_qty"
+        , CAST(((("sr_item_qty" / ((CAST("sr_item_qty" AS DECIMAL(9,4)) + "cr_item_qty") + "wr_item_qty")) / DECIMAL '3.0') * 100) AS DECIMAL(7,2)) "sr_dev"
+        , "cr_item_qty"
+        , CAST(((("cr_item_qty" / ((CAST("sr_item_qty" AS DECIMAL(9,4)) + "cr_item_qty") + "wr_item_qty")) / DECIMAL '3.0') * 100) AS DECIMAL(7,2)) "cr_dev"
+        , "wr_item_qty"
+        , CAST(((("wr_item_qty" / ((CAST("sr_item_qty" AS DECIMAL(9,4)) + "cr_item_qty") + "wr_item_qty")) / DECIMAL '3.0') * 100) AS DECIMAL(7,2)) "wr_dev"
+        , ((("sr_item_qty" + "cr_item_qty") + "wr_item_qty") / DECIMAL '3.00') "average"
+        FROM
+          sr_items
+        , cr_items
+        , wr_items
+        WHERE ("sr_items"."item_id" = "cr_items"."item_id")
+           AND ("sr_items"."item_id" = "wr_items"."item_id")
+        ORDER BY "sr_items"."item_id" ASC, "sr_item_qty" ASC
+        LIMIT 100""",
+    "q85": """
+        SELECT
+          "substr"("r_reason_desc", 1, 20)
+        , "avg"("ws_quantity")
+        , "avg"("wr_refunded_cash")
+        , "avg"("wr_fee")
+        FROM
+          web_sales
+        , web_returns
+        , web_page
+        , customer_demographics cd1
+        , customer_demographics cd2
+        , customer_address
+        , date_dim
+        , reason
+        WHERE ("ws_web_page_sk" = "wp_web_page_sk")
+           AND ("ws_item_sk" = "wr_item_sk")
+           AND ("ws_order_number" = "wr_order_number")
+           AND ("ws_sold_date_sk" = "d_date_sk")
+           AND ("d_year" = 2000)
+           AND ("cd1"."cd_demo_sk" = "wr_refunded_cdemo_sk")
+           AND ("cd2"."cd_demo_sk" = "wr_returning_cdemo_sk")
+           AND ("ca_address_sk" = "wr_refunded_addr_sk")
+           AND ("r_reason_sk" = "wr_reason_sk")
+           AND ((("cd1"."cd_marital_status" = 'M')
+                 AND ("cd1"."cd_marital_status" = "cd2"."cd_marital_status")
+                 AND ("cd1"."cd_education_status" = 'Advanced Degree')
+                 AND ("cd1"."cd_education_status" = "cd2"."cd_education_status")
+                 AND ("ws_sales_price" BETWEEN DECIMAL '100.00' AND DECIMAL '150.00'))
+              OR (("cd1"."cd_marital_status" = 'S')
+                 AND ("cd1"."cd_marital_status" = "cd2"."cd_marital_status")
+                 AND ("cd1"."cd_education_status" = 'College')
+                 AND ("cd1"."cd_education_status" = "cd2"."cd_education_status")
+                 AND ("ws_sales_price" BETWEEN DECIMAL '50.00' AND DECIMAL '100.00'))
+              OR (("cd1"."cd_marital_status" = 'W')
+                 AND ("cd1"."cd_marital_status" = "cd2"."cd_marital_status")
+                 AND ("cd1"."cd_education_status" = '2 yr Degree')
+                 AND ("cd1"."cd_education_status" = "cd2"."cd_education_status")
+                 AND ("ws_sales_price" BETWEEN DECIMAL '150.00' AND DECIMAL '200.00')))
+           AND ((("ca_country" = 'United States')
+                 AND ("ca_state" IN ('IN'      , 'OH'      , 'NJ'))
+                 AND ("ws_net_profit" BETWEEN 100 AND 200))
+              OR (("ca_country" = 'United States')
+                 AND ("ca_state" IN ('WI'      , 'CT'      , 'KY'))
+                 AND ("ws_net_profit" BETWEEN 150 AND 300))
+              OR (("ca_country" = 'United States')
+                 AND ("ca_state" IN ('LA'      , 'IA'      , 'AR'))
+                 AND ("ws_net_profit" BETWEEN 50 AND 250)))
+        GROUP BY "r_reason_desc"
+        ORDER BY "substr"("r_reason_desc", 1, 20) ASC, "avg"("ws_quantity") ASC, "avg"("wr_refunded_cash") ASC, "avg"("wr_fee") ASC
+        LIMIT 100""",
+    "q89": """
+        SELECT *
+        FROM
+          (
+           SELECT
+             "i_category"
+           , "i_class"
+           , "i_brand"
+           , "s_store_name"
+           , "s_company_name"
+           , "d_moy"
+           , "sum"("ss_sales_price") "sum_sales"
+           , "avg"("sum"("ss_sales_price")) OVER (PARTITION BY "i_category", "i_brand", "s_store_name", "s_company_name") "avg_monthly_sales"
+           FROM
+             item
+           , store_sales
+           , date_dim
+           , store
+           WHERE ("ss_item_sk" = "i_item_sk")
+              AND ("ss_sold_date_sk" = "d_date_sk")
+              AND ("ss_store_sk" = "s_store_sk")
+              AND ("d_year" IN (1999))
+              AND ((("i_category" IN ('Books'         , 'Electronics'         , 'Sports'))
+                    AND ("i_class" IN ('computers'         , 'stereo'         , 'football')))
+                 OR (("i_category" IN ('Men'         , 'Jewelry'         , 'Women'))
+                    AND ("i_class" IN ('shirts'         , 'birdal'         , 'dresses'))))
+           GROUP BY "i_category", "i_class", "i_brand", "s_store_name", "s_company_name", "d_moy"
+        )  tmp1
+        WHERE ((CASE WHEN ("avg_monthly_sales" <> 0) THEN ("abs"(("sum_sales" - "avg_monthly_sales")) / "avg_monthly_sales") ELSE null END) > DECIMAL '0.1')
+        ORDER BY ("sum_sales" - "avg_monthly_sales") ASC, "s_store_name" ASC
+        LIMIT 100""",
+    "q27": """
+        SELECT
+          "i_item_id"
+        , "s_state"
+        , GROUPING ("s_state") "g_state"
+        , "avg"("ss_quantity") "agg1"
+        , "avg"("ss_list_price") "agg2"
+        , "avg"("ss_coupon_amt") "agg3"
+        , "avg"("ss_sales_price") "agg4"
+        FROM
+          store_sales
+        , customer_demographics
+        , date_dim
+        , store
+        , item
+        WHERE ("ss_sold_date_sk" = "d_date_sk")
+           AND ("ss_item_sk" = "i_item_sk")
+           AND ("ss_store_sk" = "s_store_sk")
+           AND ("ss_cdemo_sk" = "cd_demo_sk")
+           AND ("cd_gender" = 'M')
+           AND ("cd_marital_status" = 'S')
+           AND ("cd_education_status" = 'College')
+           AND ("d_year" = 2002)
+           AND ("s_state" IN (
+             'TN'
+           , 'TN'
+           , 'TN'
+           , 'TN'
+           , 'TN'
+           , 'TN'))
+        GROUP BY ROLLUP (i_item_id, s_state)
+        ORDER BY "i_item_id" ASC, "s_state" ASC
+        LIMIT 100""",
+    "q86": """
+        SELECT
+          "sum"("ws_net_paid") "total_sum"
+        , "i_category"
+        , "i_class"
+        , (GROUPING ("i_category") + GROUPING ("i_class")) "lochierarchy"
+        , "rank"() OVER (PARTITION BY (GROUPING ("i_category") + GROUPING ("i_class")), (CASE WHEN (GROUPING ("i_class") = 0) THEN "i_category" END) ORDER BY "sum"("ws_net_paid") DESC) "rank_within_parent"
+        FROM
+          web_sales
+        , date_dim d1
+        , item
+        WHERE ("d1"."d_month_seq" BETWEEN 1200 AND (1200 + 11))
+           AND ("d1"."d_date_sk" = "ws_sold_date_sk")
+           AND ("i_item_sk" = "ws_item_sk")
+        GROUP BY ROLLUP (i_category, i_class)
+        ORDER BY "lochierarchy" DESC, (CASE WHEN ("lochierarchy" = 0) THEN "i_category" END) ASC, "rank_within_parent" ASC
+        LIMIT 100""",
 }
 
 
