@@ -311,7 +311,9 @@ class Parser:
         return tuple(items)
 
     def _set_operation(self) -> A.Relation:
-        left = self._query_term()
+        return self._set_op_rest(self._query_term())
+
+    def _set_op_rest(self, left: A.Relation) -> A.Relation:
         while self.at_keyword("union", "intersect", "except"):
             op = self.advance().value
             distinct = True
@@ -493,6 +495,25 @@ class Parser:
                 rel: A.Relation = A.SubqueryRelation(q)
             else:
                 rel = self._relation()
+                if self.at_keyword("union", "intersect", "except") \
+                        and isinstance(rel, A.SubqueryRelation):
+                    # ((select ...) EXCEPT (select ...)) as a FROM
+                    # subquery: continue the set-op chain (official
+                    # TPC-DS q08/q87 shape), with an optional
+                    # ORDER BY / LIMIT tail on the compound
+                    body = self._set_op_rest(rel)
+                    order: tuple[A.SortItem, ...] = ()
+                    limit = None
+                    offset = 0
+                    if self.accept_keyword("order"):
+                        self.expect_keyword("by")
+                        order = self._sort_items()
+                    if self.accept_keyword("limit"):
+                        limit = int(self.advance().value)
+                    if self.accept_keyword("offset"):
+                        offset = int(self.advance().value)
+                    rel = A.SubqueryRelation(
+                        A.Query(body, (), order, limit, offset))
                 self.expect_op(")")
             return self._maybe_alias(rel)
         if self.at_keyword("unnest"):

@@ -52,10 +52,26 @@ def _body(body: A.Relation) -> str:
         return _spec(body)
     if isinstance(body, A.SetOperation):
         op = body.op.upper() + ("" if body.distinct else " ALL")
-        return f"{_body(body.left)} {op} {_body(body.right)}"
+        return (f"{_setop_operand(body.left)} {op} "
+                f"{_setop_operand(body.right)}")
     if isinstance(body, A.SubqueryRelation):
         return f"({_query(body.query)})"
     raise NotImplementedError(type(body).__name__)
+
+
+def _setop_operand(body: A.Relation) -> str:
+    """sqlite rejects parenthesized compound operands: unwrap plain
+    subquery operands, wrap ordered/limited ones as SELECT * FROM."""
+    if isinstance(body, A.SubqueryRelation):
+        q = body.query
+        if not q.order_by and q.limit is None and not q.offset \
+                and not q.with_queries \
+                and isinstance(q.body, A.QuerySpec):
+            # unwrapping a COMPOUND body would re-associate the chain
+            # under sqlite's left-associative equal-precedence set ops
+            return _body(q.body)
+        return f"SELECT * FROM ({_query(q)})"
+    return _body(body)
 
 
 def _skip_agg(e) -> bool:
