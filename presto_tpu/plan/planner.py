@@ -112,6 +112,12 @@ class ExprCtx:
     outer: Scope | None = None
     correlated: list[Field] = dataclasses.field(default_factory=list)
     agg_syms: dict[A.FunctionCall, tuple[str, T.DataType]] | None = None
+    # AST of a grouping expression -> (output symbol, type): selecting
+    # or ordering by the VERBATIM group expression resolves to the
+    # aggregation output instead of re-planning base columns that are
+    # no longer in scope (reference TranslationMap's rewrite of
+    # groupings; official q99-style `substr(...) GROUP BY substr(...)`)
+    group_ast: dict[A.Expression, tuple[str, T.DataType]] | None = None
     subquery_syms: dict[A.Expression, ir.Expr] = dataclasses.field(
         default_factory=dict)
 
@@ -238,6 +244,10 @@ class ExprPlanner:
     def plan(self, e: A.Expression) -> ir.Expr:
         if e in self.ctx.subquery_syms:
             return self.ctx.subquery_syms[e]
+        if self.ctx.group_ast is not None:
+            hit = self.ctx.group_ast.get(e)
+            if hit is not None:
+                return ir.ColumnRef(hit[1], hit[0])
         m = getattr(self, "_p_" + type(e).__name__.lower(), None)
         if m is None:
             raise SemanticError(
@@ -1710,7 +1720,10 @@ class LogicalPlanner:
                 agg_syms[call] = (sym, T.BIGINT)
             self._plan_grouping_sets(qs, gsets, ast_to_sym, group_syms,
                                      aggs, gmeta)
-            return ExprCtx(qs.scope, self, outer, agg_syms=agg_syms)
+            gtypes = qs.node.output_types()
+            return ExprCtx(qs.scope, self, outer, agg_syms=agg_syms,
+                           group_ast={ast: (s, gtypes[s])
+                                      for ast, s in ast_to_sym.items()})
         for call in grouping_calls:
             # plain GROUP BY: nothing is rolled away, grouping() == 0
             # (sym None -> the expression planner emits a 0 literal)
@@ -1780,7 +1793,9 @@ class LogicalPlanner:
         qs.scope = Scope(fields)
         qs.est = agg_node.capacity or qs.est
         qs.unique = [frozenset(group_syms)] if group_syms else []
-        return ExprCtx(qs.scope, self, outer, agg_syms=agg_syms)
+        return ExprCtx(qs.scope, self, outer, agg_syms=agg_syms,
+                       group_ast={ast: (s, types[s])
+                                  for ast, s in ast_to_sym.items()})
 
     def _plan_grouping_sets(self, qs: QState,
                             gsets: list[list[A.Expression]],

@@ -83,10 +83,16 @@ def _skip_agg(e) -> bool:
             and e.window is None)
 
 
+def _alias(a: str) -> str:
+    # always quoted: covers "30 days" (official q99) AND keyword
+    # aliases like "order" that isidentifier() would wave through
+    return '"' + a + '"'
+
+
 def _spec_one(s: A.QuerySpec, group_exprs: list | None) -> str:
     items = ", ".join(
         (_expr(i.expression)
-         + (f" AS {i.alias}" if i.alias else ""))
+         + (f" AS {_alias(i.alias)}" if i.alias else ""))
         for i in s.select_items)
     out = "SELECT " + ("DISTINCT " if s.distinct else "") + items
     if s.from_relation is not None:

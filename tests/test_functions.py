@@ -470,3 +470,13 @@ def test_moments_small_groups_null(eng):
         "select skewness(n_nationkey), kurtosis(n_nationkey) "
         "from nation where n_nationkey < 2")  # n = 2
     assert rows[0] == (None, None)
+
+
+def test_select_verbatim_group_expression(eng, oracle):
+    """Selecting/ordering by the exact grouping expression resolves to
+    the aggregation output (TranslationMap analog; official q99 shape)."""
+    from presto_tpu.testing.oracle import assert_query
+    assert_query(eng, oracle,
+                 "select substring(n_name, 1, 2), count(*) from nation "
+                 "group by substring(n_name, 1, 2) "
+                 "order by substring(n_name, 1, 2)")
