@@ -23,10 +23,22 @@ class Client:
         self.base_url = base_url.rstrip("/")
         self.user = user
         self.password = password
+        # session properties accumulated from SET SESSION statements,
+        # replayed on every request via X-Trino-Session (the reference
+        # client's session accumulation, StatementClientV1)
+        self.session_properties: dict[str, object] = {}
 
     def _request(self, method: str, url: str, body: bytes | None = None):
         req = urllib.request.Request(url, data=body, method=method)
         req.add_header("X-Trino-User", self.user)
+        if self.session_properties:
+            from urllib.parse import quote
+            # values are URL-encoded so a comma/equals inside a value
+            # cannot corrupt the comma-separated header (the reference
+            # protocol encodes the same way)
+            req.add_header("X-Trino-Session", ",".join(
+                f"{k}={quote(str(v))}"
+                for k, v in self.session_properties.items()))
         if self.password is not None:
             import base64
             cred = base64.b64encode(
@@ -46,6 +58,8 @@ class Client:
                 raise QueryFailed(out["error"].get("message", "failed"))
             if out.get("columns"):
                 columns = out["columns"]
+            if out.get("setSession"):
+                self.session_properties.update(out["setSession"])
             rows.extend(out.get("data", []))
             next_uri = out.get("nextUri")
             if next_uri is None:

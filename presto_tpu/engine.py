@@ -307,6 +307,8 @@ class Engine:
             import numpy as np
 
             catalog, table = self._resolve_table(stmt.table)
+            self.access_control.check_can_write(
+                self.session.user, catalog, table)
             conn = self._connector(catalog)
             self.transactions.touch(conn)
             target = conn.table_schema(table)
@@ -333,6 +335,8 @@ class Engine:
 
         if isinstance(stmt, A.DropTable):
             catalog, table = self._resolve_table(stmt.table)
+            self.access_control.check_can_write(
+                self.session.user, catalog, table)
             conn = self._connector(catalog)
             if table not in conn.table_names():
                 if stmt.if_exists:

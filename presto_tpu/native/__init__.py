@@ -21,26 +21,45 @@ import threading
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_DIR, "src", "pageserde.cpp")
-_LIB = os.path.join(_DIR, "libpageserde.so")
 
 _lock = threading.Lock()
 _codec: "PageCodec | None | bool" = False  # False = not yet attempted
 
 
+def _lib_path() -> str:
+    """Artifact name keyed by a hash of the source: a stale binary can
+    never be picked up (mtimes are not preserved across git checkouts,
+    so an mtime staleness check is unreliable)."""
+    import hashlib
+    with open(_SRC, "rb") as f:
+        digest = hashlib.sha256(f.read()).hexdigest()[:16]
+    return os.path.join(_DIR, f"libpageserde-{digest}.so")
+
+
 def _build() -> str | None:
-    """Compile the shared library if missing/stale; returns its path."""
+    """Compile the shared library if missing; returns its path."""
     try:
-        if (not os.path.exists(_LIB)
-                or os.path.getmtime(_LIB) < os.path.getmtime(_SRC)):
+        lib = _lib_path()
+        if not os.path.exists(lib):
             # pid-unique temp: concurrent workers building at once must
             # not interleave writes into one file
-            tmp = f"{_LIB}.{os.getpid()}.tmp"
+            tmp = f"{lib}.{os.getpid()}.tmp"
             subprocess.run(
                 ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
                  "-o", tmp, _SRC],
                 check=True, capture_output=True, timeout=120)
-            os.replace(tmp, _LIB)
-        return _LIB
+            os.replace(tmp, lib)
+            # drop artifacts of superseded source versions; .so only —
+            # another process's in-flight .tmp must not be removed
+            import glob
+            for stale in glob.glob(
+                    os.path.join(_DIR, "libpageserde*.so")):
+                if os.path.abspath(stale) != os.path.abspath(lib):
+                    try:
+                        os.remove(stale)
+                    except OSError:
+                        pass
+        return lib
     except Exception:
         return None
 

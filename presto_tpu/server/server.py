@@ -47,6 +47,12 @@ class QueryInfo:
     finished: float | None = None
     rows_sent: int = 0
     cancel_token: object = None  # exec/cancel.CancelToken
+    # per-query property overrides from the X-Trino-Session header
+    session_properties: dict = dataclasses.field(default_factory=dict)
+    # SET SESSION result handed back to the client, which carries it on
+    # subsequent requests (reference: X-Trino-Set-Session response
+    # header + StatementClientV1 session accumulation)
+    set_session: dict | None = None
 
     def stats(self) -> dict:
         wall = ((self.finished or time.monotonic())
@@ -108,12 +114,14 @@ class QueryManager:
         self.lock = threading.Lock()
         self._tickets: dict[str, tuple] = {}  # qid -> (group, start_fn)
 
-    def submit(self, sql: str, user: str) -> QueryInfo:
+    def submit(self, sql: str, user: str,
+               session_properties: dict | None = None) -> QueryInfo:
         from presto_tpu.server.resource_groups import (
             NoMatchingGroupError, QueryQueueFullError)
 
         qid = f"{time.strftime('%Y%m%d_%H%M%S')}_{uuid.uuid4().hex[:5]}"
-        q = QueryInfo(qid, sql, user)
+        q = QueryInfo(qid, sql, user,
+                      session_properties=session_properties or {})
         with self.lock:
             self.queries[qid] = q
         try:
@@ -166,16 +174,41 @@ class QueryManager:
         from presto_tpu.sql import ast as A
         from presto_tpu.sql.parser import parse_statement
 
-        if not isinstance(parse_statement(q.sql), A.QueryStatement):
-            rows = self.engine.execute(q.sql, cancel_token=q.cancel_token)
+        stmt = parse_statement(q.sql)
+        if isinstance(stmt, (A.StartTransaction, A.CommitStatement,
+                             A.RollbackStatement)):
+            # the TransactionManager is process-global; over HTTP a
+            # transaction would be shared by every concurrent user's
+            # statements (the dbapi driver declares transactions
+            # unsupported over HTTP for the same reason)
+            raise ValueError(
+                "transactions are not supported over the HTTP protocol")
+        if isinstance(stmt, A.SetSession):
+            # never mutates the shared engine session: the validated
+            # property goes back to THIS client, which replays it via
+            # the X-Trino-Session header on its later queries
+            from presto_tpu.engine import _literal_value
+            from presto_tpu.session import coerce_property
+            value = coerce_property(stmt.name,
+                                    _literal_value(stmt.value))
+            q.set_session = {stmt.name: value}
+            q.columns = []
+            q.rows = []
+            return
+        overrides = dict(q.session_properties)
+        if not isinstance(stmt, A.QueryStatement):
+            with self.engine.session.as_user(q.user, overrides):
+                rows = self.engine.execute(q.sql,
+                                           cancel_token=q.cancel_token)
             width = len(rows[0]) if rows else 1
             q.columns = [{"name": f"_col{i}", "type": "varchar"}
                          for i in range(width)]
             q.rows = [[_json_value(v, T.VARCHAR) for v in row]
                       for row in rows]
             return
-        table = self.engine.execute_table(q.sql,
-                                          cancel_token=q.cancel_token)
+        with self.engine.session.as_user(q.user, overrides):
+            table = self.engine.execute_table(q.sql,
+                                              cancel_token=q.cancel_token)
         q.columns = [{"name": n, "type": str(c.dtype)}
                      for n, c in table.columns.items()]
         dtypes = [c.dtype for c in table.columns.values()]
@@ -307,6 +340,8 @@ class _Handler(JsonHandler):
                               f"{q.query_id}/{token}")
             return out
         if q.state == "FINISHED":
+            if q.set_session:
+                out["setSession"] = q.set_session
             out["columns"] = q.columns
             start = token * PAGE_ROWS
             chunk = (q.rows or [])[start:start + PAGE_ROWS]
@@ -325,12 +360,36 @@ class _Handler(JsonHandler):
             user = self._authenticated_user()
             if user is None:
                 return
+            try:
+                props = self._session_properties()
+            except (KeyError, ValueError) as e:
+                self._send_json({"error": {"message": str(e)}}, 400)
+                return
             length = int(self.headers.get("Content-Length", 0))
             sql = self.rfile.read(length).decode()
-            q = self.manager.submit(sql, user)
+            q = self.manager.submit(sql, user, session_properties=props)
             self._send_json(self._query_results(q, 0))
             return
         self._send_json({"error": "not found"}, 404)
+
+    def _session_properties(self) -> dict:
+        """Per-request property overrides from the X-Trino-Session
+        header (comma-separated name=value pairs), validated and typed."""
+        from urllib.parse import unquote
+
+        from presto_tpu.session import coerce_property
+        header = self.headers.get("X-Trino-Session", "")
+        props = {}
+        for pair in header.split(","):
+            pair = pair.strip()
+            if not pair:
+                continue
+            name, sep, value = pair.partition("=")
+            if not sep:
+                raise ValueError(f"malformed session header entry: {pair}")
+            props[name.strip()] = coerce_property(
+                name.strip(), unquote(value.strip()))
+        return props
 
     def do_GET(self):  # noqa: N802
         parts = self.path.strip("/").split("/")
@@ -385,14 +444,21 @@ class _Handler(JsonHandler):
             self.wfile.write(body)
             return
         if self.path == "/v1/query":
+            user = self._authenticated_user()
+            if user is None:
+                return
             self._send_json([
                 {"queryId": q.query_id, "state": q.state,
                  "query": q.sql, "user": q.user}
-                for q in self.manager.queries.values()])
+                for q in self.manager.queries.values()
+                if self._can_view(user, q)])
             return
         if len(parts) == 3 and parts[:2] == ["v1", "query"]:
+            user = self._authenticated_user()
+            if user is None:
+                return
             q = self.manager.get(parts[2])
-            if q is None:
+            if q is None or not self._can_view(user, q):
                 self._send_json({"error": "unknown query"}, 404)
                 return
             self._send_json({
@@ -402,18 +468,38 @@ class _Handler(JsonHandler):
             return
         if len(parts) == 5 and parts[:3] == ["v1", "statement",
                                              "executing"]:
+            user = self._authenticated_user()
+            if user is None:
+                return
             q = self.manager.get(parts[3])
-            if q is None:
+            if q is None or not self._can_view(user, q):
                 self._send_json({"error": "unknown query"}, 404)
                 return
             self._send_json(self._query_results(q, int(parts[4])))
             return
         self._send_json({"error": "not found"}, 404)
 
+    def _can_view(self, user: str, q: QueryInfo) -> bool:
+        """With an authenticator configured, query state/results are
+        owner-scoped (cross-user result disclosure otherwise: query ids
+        are guessable). Insecure mode trusts headers and shows all,
+        matching the reference's insecure-auth Web UI."""
+        return self.authenticator is None or q.user == user
+
     def do_DELETE(self):  # noqa: N802
         parts = self.path.strip("/").split("/")
         if len(parts) >= 4 and parts[:3] == ["v1", "statement",
                                              "executing"]:
+            user = self._authenticated_user()
+            if user is None:
+                return
+            q = self.manager.get(parts[3])
+            # unknown and not-owned answer identically (404): a
+            # status-code difference would be a query-id existence
+            # oracle for other users' queries
+            if q is None or not self._can_view(user, q):
+                self._send_json({"error": "unknown query"}, 404)
+                return
             self.manager.cancel(parts[3])
             self.send_response(204)
             self.end_headers()

@@ -8,8 +8,17 @@ TPU execution strategy instead of JVM task knobs.
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
+import threading
 from typing import Any
+
+# Per-thread user override: HTTP queries run concurrently on a shared
+# Engine, so the authenticated user is bound to the executing thread
+# for the query's duration rather than mutated on the shared session
+# (reference: Session is per-query; here the override restores that
+# scoping over a process-global Session).
+_USER_OVERRIDE = threading.local()
 
 
 # name -> (default, type, description). Every property is read by the
@@ -82,10 +91,38 @@ class Session:
     """Per-query session. ``catalog`` names the default connector."""
 
     catalog: str = "tpch"
-    user: str = "presto"
+    default_user: str = "presto"
     properties: dict[str, Any] = dataclasses.field(default_factory=dict)
 
+    @property
+    def user(self) -> str:
+        override = getattr(_USER_OVERRIDE, "user", None)
+        return override if override is not None else self.default_user
+
+    @user.setter
+    def user(self, value: str) -> None:
+        self.default_user = value
+
+    @contextlib.contextmanager
+    def as_user(self, user: str, properties: dict[str, Any] | None = None):
+        """Bind ``user`` (and optional per-query property overrides) on
+        this thread only (used by the HTTP dispatcher so access-control
+        checks and session properties are scoped to the authenticated
+        submitter's query, not the shared engine session)."""
+        prev = getattr(_USER_OVERRIDE, "user", None)
+        prev_props = getattr(_USER_OVERRIDE, "properties", None)
+        _USER_OVERRIDE.user = user
+        _USER_OVERRIDE.properties = properties or None
+        try:
+            yield
+        finally:
+            _USER_OVERRIDE.user = prev
+            _USER_OVERRIDE.properties = prev_props
+
     def get(self, name: str) -> Any:
+        override = getattr(_USER_OVERRIDE, "properties", None)
+        if override is not None and name in override:
+            return override[name]
         if name in self.properties:
             return self.properties[name]
         if name not in SYSTEM_SESSION_PROPERTIES:
@@ -93,9 +130,15 @@ class Session:
         return SYSTEM_SESSION_PROPERTIES[name][0]
 
     def set(self, name: str, value: Any) -> None:
-        if name not in SYSTEM_SESSION_PROPERTIES:
-            raise KeyError(f"unknown session property: {name}")
-        default, typ, _ = SYSTEM_SESSION_PROPERTIES[name]
-        if typ is bool and isinstance(value, str):
-            value = value.lower() in ("true", "1", "on")
-        self.properties[name] = typ(value)
+        self.properties[name] = coerce_property(name, value)
+
+
+def coerce_property(name: str, value: Any) -> Any:
+    """Validate a property name and convert ``value`` to its declared
+    type (used by SET SESSION and by the HTTP X-Trino-Session header)."""
+    if name not in SYSTEM_SESSION_PROPERTIES:
+        raise KeyError(f"unknown session property: {name}")
+    _default, typ, _ = SYSTEM_SESSION_PROPERTIES[name]
+    if typ is bool and isinstance(value, str):
+        value = value.lower() in ("true", "1", "on")
+    return typ(value)

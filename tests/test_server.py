@@ -204,3 +204,26 @@ def test_dbapi_comment_and_ident_handling(server):
     import datetime
     with pytest.raises(dbapi.NotSupportedError):
         cur.execute("select ?", (datetime.datetime(2026, 7, 30, 12, 0),))
+
+
+def test_http_set_session_scoped_per_client(server):
+    """SET SESSION over HTTP is client-scoped: the property rides the
+    X-Trino-Session header back in, and never leaks into other
+    clients' queries or the shared engine session (reference:
+    X-Trino-Set-Session + client session accumulation)."""
+    from presto_tpu.client import Client
+
+    url = f"http://127.0.0.1:{server.port}"
+    engine = server.httpd.RequestHandlerClass.manager.engine
+    a = Client(url)
+    b = Client(url)
+    a.execute("set session join_distribution_type = 'BROADCAST'")
+    assert a.session_properties == {
+        "join_distribution_type": "BROADCAST"}
+    # the shared engine session is untouched
+    assert engine.session.properties.get(
+        "join_distribution_type") is None
+    assert b.session_properties == {}
+    # a's later queries still execute fine with the override bound
+    _, rows = a.execute("select 1")
+    assert rows == [[1]]
