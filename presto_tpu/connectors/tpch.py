@@ -164,14 +164,26 @@ def _comments(rng: np.random.Generator, n: int) -> EncodedStrings:
 
 
 def _phone(nationkey: np.ndarray, rng: np.random.Generator) -> np.ndarray:
-    cc = (nationkey + 10).astype(int)
+    cc = (nationkey + 10).astype(np.int64)
     a = rng.integers(100, 1000, len(nationkey))
     b = rng.integers(100, 1000, len(nationkey))
     c = rng.integers(1000, 10000, len(nationkey))
-    return np.array(
-        [f"{cc[i]:02d}-{a[i]}-{b[i]}-{c[i]}" for i in range(len(cc))],
-        dtype=object,
-    )
+    dash = np.full(len(cc), "-", dtype="U1")
+    out = np.char.add(np.char.zfill(cc.astype("U2"), 2), dash)
+    out = np.char.add(np.char.add(out, a.astype("U3")), dash)
+    out = np.char.add(np.char.add(out, b.astype("U3")), dash)
+    out = np.char.add(out, c.astype("U4"))
+    return out.astype(object)
+
+
+def _keyed_names(prefix: str, keys: np.ndarray) -> "EncodedStrings":
+    """Vectorized '<prefix>#000000001'-style names. Zero-padded per-key
+    names ascend with the key, so the identity mapping over the
+    already-sorted dictionary avoids a unique/argsort pass."""
+    names = np.char.add(f"{prefix}#",
+                        np.char.zfill(keys.astype("U9"), 9))
+    return EncodedStrings(np.arange(len(keys), dtype=np.int32),
+                          names.astype(object))
 
 
 def _retailprice(partkey: np.ndarray) -> np.ndarray:
@@ -221,11 +233,7 @@ class TpchGenerator:
         nationkey = rng.integers(0, 25, n).astype(np.int64)
         return {
             "s_suppkey": keys,
-            # zero-padded per-key names ascend with the key: identity
-            # codes over the already-sorted dictionary
-            "s_name": EncodedStrings(
-                np.arange(n, dtype=np.int32),
-                np.array([f"Supplier#{k:09d}" for k in keys], object)),
+            "s_name": _keyed_names("Supplier", keys),
             "s_address": _comments(rng, n),
             "s_nationkey": nationkey,
             "s_phone": _phone(nationkey, rng),
@@ -293,9 +301,7 @@ class TpchGenerator:
         seg = rng.integers(0, len(SEGMENTS), n)
         return {
             "c_custkey": keys,
-            "c_name": EncodedStrings(
-                np.arange(n, dtype=np.int32),
-                np.array([f"Customer#{k:09d}" for k in keys], object)),
+            "c_name": _keyed_names("Customer", keys),
             "c_address": _comments(rng, n),
             "c_nationkey": nationkey,
             "c_phone": _phone(nationkey, rng),
@@ -323,7 +329,11 @@ class TpchGenerator:
         total_lines = int(counts.sum())
         l_orderkey = np.repeat(okeys, counts)
         l_odate = np.repeat(odate, counts)
-        ln = np.concatenate([np.arange(1, c + 1) for c in counts]).astype(np.int64)
+        # line number within its order, vectorized: global position minus
+        # the order's start offset
+        starts = np.concatenate([[0], np.cumsum(counts)[:-1]])
+        ln = (np.arange(total_lines, dtype=np.int64)
+              - np.repeat(starts, counts) + 1)
 
         lrng = self._rng(9)
         lpk = lrng.integers(1, self.n_part + 1, total_lines).astype(np.int64)
@@ -425,13 +435,72 @@ class TpchConnector(Connector):
 
     def _raw(self, name: str) -> dict[str, np.ndarray]:
         if name not in self._cache:
-            if name in ("orders", "lineitem"):
+            loaded = self._disk_load(name)
+            if loaded is not None:
+                self._cache[name] = loaded
+            elif name in ("orders", "lineitem"):
                 orders, lineitem = self.gen.orders_and_lineitem()
                 self._cache["orders"] = orders
                 self._cache["lineitem"] = lineitem
+                self._disk_store("orders", orders)
+                self._disk_store("lineitem", lineitem)
             else:
                 self._cache[name] = getattr(self.gen, name)()
+                self._disk_store(name, self._cache[name])
         return self._cache[name]
+
+    # Optional on-disk table cache (PRESTO_TPU_TPCH_CACHE=<dir>): the
+    # bench runs detail queries in subprocesses; regenerating SF10 per
+    # process would eat the bench budget. Arrays round-trip through one
+    # .npz per table (EncodedStrings split into codes + object dict).
+    def _disk_path(self, name: str):
+        import os
+        d = os.environ.get("PRESTO_TPU_TPCH_CACHE")
+        if not d:
+            return None
+        return os.path.join(
+            d, f"tpch_sf{self.scale:g}_s{self.gen.seed}_{name}.npz")
+
+    def _disk_load(self, name: str):
+        import os
+        path = self._disk_path(name)
+        if path is None or not os.path.exists(path):
+            return None
+        with np.load(path, allow_pickle=True) as z:
+            out: dict[str, np.ndarray] = {}
+            for col in SCHEMAS[name]:
+                if f"{col}$codes" in z:
+                    out[col] = EncodedStrings(z[f"{col}$codes"],
+                                              z[f"{col}$dict"])
+                else:
+                    out[col] = z[col]
+            return out
+
+    def _disk_store(self, name: str, raw: dict) -> None:
+        import os
+        import tempfile
+        path = self._disk_path(name)
+        if path is None:
+            return
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        flat: dict[str, np.ndarray] = {}
+        for col, a in raw.items():
+            if isinstance(a, EncodedStrings):
+                flat[f"{col}$codes"] = a.codes
+                flat[f"{col}$dict"] = a.dictionary
+            else:
+                flat[col] = a
+        fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path) or ".",
+                                   suffix=".npz.tmp")
+        os.close(fd)
+        try:
+            with open(tmp, "wb") as f:
+                np.savez(f, **flat)
+            os.replace(tmp, path)  # atomic vs concurrent subprocesses
+        except BaseException:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+            raise
 
     def table(self, name: str) -> Table:
         if name not in self._tables:

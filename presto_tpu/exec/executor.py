@@ -268,7 +268,9 @@ class PlanInterpreter:
             self._collect_dyn_filters(node, right)
         left = self.run(node.left)
         cap = self._capacity(node, next_pow2(2 * right.n))
-        if node.build_unique:
+        if node.build_unique and node.join_type != N.JoinType.FULL:
+            # FULL always takes the expanding path: it owns the
+            # unmatched-build-rows tail pass
             out, ok = OP.apply_join(left, right, node, cap)
             self._note_ok(node, ok)
             return out
@@ -291,10 +293,28 @@ class PlanInterpreter:
     def _r_crossjoin(self, node: N.CrossJoin) -> DTable:
         left = self.run(node.left)
         right = self.run(node.right)
-        if not node.scalar:
-            raise NotImplementedError(
-                "general (non-scalar) cross join not supported yet")
-        return OP.apply_cross_scalar(left, right)
+        if node.scalar:
+            return OP.apply_cross_scalar(left, right)
+        return self._cross_general(node, left, right)
+
+    def _cross_general(self, node: N.CrossJoin, left: DTable,
+                       right: DTable) -> DTable:
+        """Nested-loop cross join: compact both sides to their estimated
+        live sizes (with overflow retry), then take the static product."""
+        lcap = self._capacity(
+            node, next_pow2(min(left.n, 2 * (node.left_rows or left.n))),
+            "left")
+        rcap = self._capacity(
+            node, next_pow2(min(right.n,
+                                2 * (node.right_rows or right.n))),
+            "right")
+        if lcap < left.n:
+            left, lok = OP.compact_dtable(left, lcap)
+            self._note_ok(node, lok, "left")
+        if rcap < right.n:
+            right, rok = OP.compact_dtable(right, rcap)
+            self._note_ok(node, rok, "right")
+        return OP.apply_cross_general(left, right)
 
     def _r_union(self, node: N.Union) -> DTable:
         parts = [self.run(s) for s in node.inputs]

@@ -513,7 +513,8 @@ def apply_expand_join(left: DTable, right: DTable, node: N.Join,
     rkeys = [rk for _, rk in node.criteria]
     build_live = _and_key_valid(right, rkeys, right.live_mask())
     probe_live = _and_key_valid(left, lkeys, left.live_mask())
-    left_join = node.join_type == N.JoinType.LEFT
+    full_join = node.join_type == N.JoinType.FULL
+    left_join = node.join_type == N.JoinType.LEFT or full_join
     if left_join:
         # left-join preserves probe rows with NULL keys (they just match
         # nothing); only the probe lookup masks them out
@@ -538,14 +539,8 @@ def apply_expand_join(left: DTable, right: DTable, node: N.Join,
     matched = build_row >= 0
     gather = jnp.clip(build_row, 0, right.n - 1)
     verify = _verify_keys(left, right, node.criteria, probe_idx, gather)
-    if verify is not True:
-        if left_join:
-            # a collision row would need to convert back to an
-            # unmatched-left row; with content-hashed keys the risk is a
-            # 64-bit collision within one query's keys (~n^2/2^64)
-            pass
-        else:
-            out_live = out_live & (verify | ~matched)
+    if verify is not True and not left_join:
+        out_live = out_live & (verify | ~matched)
     for sym, v in right.cols.items():
         data = v.data[gather]
         if left_join:
@@ -557,13 +552,76 @@ def apply_expand_join(left: DTable, right: DTable, node: N.Join,
             valid = None if v.valid is None else v.valid[gather]
         out[sym] = Val(v.dtype, data, valid, v.dictionary)
 
+    keep = matched
+    f_ok = None
     if node.filter is not None:
-        if left_join:
-            raise NotImplementedError(
-                "residual filter on expanding LEFT join")
         fv = ExprCompiler(out).compile(node.filter)
         f_ok = fv.data if fv.valid is None else (fv.data & fv.valid)
-        out_live = out_live & f_ok
+        if not left_join:
+            out_live = out_live & f_ok
+    if left_join and (f_ok is not None or verify is not True):
+        # outer-join keep/revert pass: a match failing the residual
+        # filter or the key value-verify is NOT a match (identity int
+        # keys make the EMPTY-remap collision of combine_hashes
+        # deterministic for INT64_MAX neighbours, so verify demotion is
+        # a correctness path). A probe row whose slots ALL fail must
+        # still emit exactly once, unmatched; its surviving collision
+        # slots must die (reference JoinFilterFunction handling in
+        # LookupJoinOperator — outer rows emit after filtering). Slots
+        # of one probe row are contiguous, so "first slot" is where
+        # probe_idx changes; revive it when no sibling slot survives.
+        keep = matched & out_live
+        if f_ok is not None:
+            keep = keep & f_ok
+        if verify is not True:
+            keep = keep & verify
+        surv = jax.ops.segment_max(
+            keep.astype(jnp.int32), probe_idx,
+            num_segments=left.n, indices_are_sorted=True)
+        first = jnp.concatenate(
+            [jnp.ones((1,), bool), probe_idx[1:] != probe_idx[:-1]])
+        revert = (first & (surv[probe_idx] == 0)
+                  & probe_rows_live[probe_idx] & out_live)
+        out_live = keep | revert
+        # right columns of reverted slots are NULL
+        for sym, v in right.cols.items():
+            data = out[sym].data
+            valid = keep if v.valid is None \
+                else (keep & v.valid[gather])
+            out[sym] = Val(v.dtype, data, valid, v.dictionary)
+
+    if full_join:
+        # FULL = LEFT + the build rows no probe row matched, appended as
+        # a build-sized tail region with NULL probe columns (reference
+        # JoinNode.Type.FULL + LookupOuterOperator's unvisited-positions
+        # pass, operator/join/LookupJoinOperator.java)
+        nb = right.n
+        matched_build = jnp.zeros((nb,), bool).at[jnp.where(
+            keep & out_live, build_row, nb)].set(True, mode="drop")
+        tail_live = right.live_mask() & ~matched_build
+        zero = jnp.zeros((nb,), jnp.int32)
+        out2: dict[str, Val] = {}
+        for sym, v in out.items():
+            if sym in left.cols:
+                lv = left.cols[sym]
+                tdata = lv.data[zero]  # values dead: all-NULL via valid
+                tvalid = jnp.zeros((nb,), bool)
+            else:
+                rv = right.cols[sym]
+                tdata = rv.data
+                tvalid = rv.valid
+            if v.valid is None and tvalid is None:
+                valid = None
+            else:
+                va = (v.valid if v.valid is not None
+                      else jnp.ones((out_capacity,), bool))
+                vb = (tvalid if tvalid is not None
+                      else jnp.ones((nb,), bool))
+                valid = jnp.concatenate([va, vb])
+            out2[sym] = Val(v.dtype, jnp.concatenate([v.data, tdata]),
+                            valid, v.dictionary)
+        live2 = jnp.concatenate([out_live, tail_live])
+        return DTable(out2, live2, out_capacity + nb), t_ok, o_ok
 
     return DTable(out, out_live, out_capacity), t_ok, o_ok
 
@@ -599,6 +657,43 @@ def apply_semijoin(dt: DTable, filt: DTable, node: N.SemiJoin,
         mark_valid = found | set_empty | (~probe_null & ~build_has_null)
     out[node.output] = Val(T.BOOLEAN, found, mark_valid)
     return DTable(out, dt.live, dt.n), ok
+
+
+def compact_dtable(dt: DTable, capacity: int) -> tuple:
+    """Gather live rows to the front of a ``capacity``-row DTable (the
+    page-compaction analog inside a traced program). Returns
+    (DTable [capacity], ok); ok is False when live rows overflow the
+    capacity (host retries with a grown capacity)."""
+    live = dt.live_mask()
+    cnt = jnp.sum(live.astype(jnp.int32))
+    ok = cnt <= capacity
+    idx = jnp.nonzero(live, size=capacity, fill_value=dt.n - 1)[0]
+    cols = {
+        sym: Val(v.dtype, v.data[idx],
+                 None if v.valid is None else v.valid[idx], v.dictionary)
+        for sym, v in dt.cols.items()}
+    return DTable(cols, jnp.arange(capacity) < cnt, capacity), ok
+
+
+def apply_cross_general(left: DTable, right: DTable) -> DTable:
+    """General nested-loop cross join: the full static product
+    left.n x right.n (reference NestedLoopJoinOperator.java:46).
+    Callers compact both sides first so the product is sized by live
+    estimates, not input capacities."""
+    nl, nr = left.n, right.n
+    i = jnp.repeat(jnp.arange(nl, dtype=jnp.int32), nr)
+    j = jnp.tile(jnp.arange(nr, dtype=jnp.int32), nl)
+    out: dict[str, Val] = {}
+    for sym, v in left.cols.items():
+        out[sym] = Val(v.dtype, v.data[i],
+                       None if v.valid is None else v.valid[i],
+                       v.dictionary)
+    for sym, v in right.cols.items():
+        out[sym] = Val(v.dtype, v.data[j],
+                       None if v.valid is None else v.valid[j],
+                       v.dictionary)
+    live = left.live_mask()[i] & right.live_mask()[j]
+    return DTable(out, live, nl * nr)
 
 
 def apply_cross_scalar(left: DTable, right: DTable) -> DTable:

@@ -47,14 +47,21 @@ def _splitmix64(x):
 
 
 def hash_int_column(data, valid=None):
-    """64-bit hash of an integer-like column (int64/int32/date/decimal/bool
-    physical). NULLs hash to a fixed sentinel."""
-    h = _splitmix64(data.astype(jnp.int64).view(jnp.uint64)
-                    if data.dtype == jnp.int64 else
-                    data.astype(jnp.int64).astype(jnp.uint64))
+    """Order-preserving identity key of an integer-like column
+    (int64/int32/date/decimal/bool physical): the value with its sign
+    bit flipped into uint64. NULLs map to a fixed sentinel.
+
+    Deliberately NO mixing: TPU v5e has no native 64-bit ALU, so
+    splitmix64's two 64-bit multiplies cost ~40ms per million rows
+    (measured; they dominated every join/group-by). The sort-based
+    kernels only need equal keys to compare equal and the dead-row
+    sentinel to stay unreachable; exactness against residual collisions
+    comes from value verification (joins, _verify_keys) and key-payload
+    secondary sort keys (grouping, SortedGroups)."""
+    u = data.astype(jnp.int64).astype(jnp.uint64) ^ jnp.uint64(1 << 63)
     if valid is not None:
-        h = jnp.where(valid, h, _NULL_KEY_HASH)
-    return h
+        u = jnp.where(valid, u, _NULL_KEY_HASH)
+    return u
 
 
 # id(dictionary) -> (strong ref to the dictionary, hashes). Holding the
@@ -90,13 +97,16 @@ def hash_string_column(codes, dictionary: np.ndarray, valid=None):
 
 
 def combine_hashes(hashes: list):
-    """Combine per-column hashes into one row hash. Order-dependent: the
-    accumulator is multiplied by an odd constant before xoring the next
-    column, so (a, b) and (b, a) key tuples don't collide (plain xor is
-    commutative)."""
+    """Combine per-column keys into one row key. Order-dependent: the
+    accumulator multiplies by an odd constant (a bijection of Z/2^64)
+    before xoring the next column, so (a, b) and (b, a) tuples don't
+    systematically collide. ONE emulated 64-bit multiply per extra
+    column (vs splitmix64's two plus shifts) — single-key rows (the
+    common case) pay nothing, and residual collisions are exact-checked
+    downstream (see hash_int_column)."""
     out = hashes[0]
     for h in hashes[1:]:
-        out = _splitmix64(out * jnp.uint64(0x100000001B3) ^ h)
+        out = out * jnp.uint64(0x9E3779B97F4A7C15) ^ h
     # keep the EMPTY sentinel unreachable
     return jnp.where(out == _EMPTY, out - jnp.uint64(1), out)
 
@@ -423,6 +433,18 @@ def next_pow2(x: int) -> int:
     return 1 << max(int(x) - 1, 1).bit_length()
 
 
+def partition_id(h, nparts: int):
+    """Destination partition of a 64-bit row key: fold to 32 bits and
+    golden-ratio multiply, then mod. 32-bit multiplies are native on
+    TPU (64-bit are emulated), and the multiply spreads the identity
+    keys produced by hash_int_column evenly across partitions even when
+    they are dense or strided. Must stay bit-identical to
+    np_partition_id (host-side scan bucketing)."""
+    x = (h ^ (h >> jnp.uint64(32))).astype(jnp.uint32)
+    x = x * jnp.uint32(0x9E3779B1)
+    return (x % jnp.uint32(nparts)).astype(jnp.int32)
+
+
 # --- numpy twins (host-side, exact same bit pattern) -----------------------
 # Scan bucketing for connector-defined partitioning happens on host
 # before shard placement; it must land rows on the SAME shard as the
@@ -442,7 +464,8 @@ def np_splitmix64(x: np.ndarray) -> np.ndarray:
 
 
 def np_hash_int_column(data: np.ndarray, valid=None) -> np.ndarray:
-    h = np_splitmix64(np.asarray(data).astype(np.int64).view(np.uint64))
+    h = (np.asarray(data).astype(np.int64).view(np.uint64)
+         ^ np.uint64(1 << 63))
     if valid is not None:
         h = np.where(valid, h, np.uint64(0x9E3779B97F4A7C15))
     return h
@@ -460,10 +483,17 @@ def np_hash_string_column(codes, dictionary, valid=None) -> np.ndarray:
     return h
 
 
+def np_partition_id(h: np.ndarray, nparts: int) -> np.ndarray:
+    x = (h ^ (h >> np.uint64(32))).astype(np.uint32)
+    with np.errstate(over="ignore"):
+        x = x * np.uint32(0x9E3779B1)
+    return (x % np.uint32(nparts)).astype(np.int64)
+
+
 def np_combine_hashes(hashes: list) -> np.ndarray:
     out = hashes[0]
     with np.errstate(over="ignore"):
         for h in hashes[1:]:
-            out = np_splitmix64(out * np.uint64(0x100000001B3) ^ h)
+            out = out * np.uint64(0x9E3779B97F4A7C15) ^ h
     return np.where(out == np.uint64(0xFFFFFFFFFFFFFFFF),
                     out - np.uint64(1), out)

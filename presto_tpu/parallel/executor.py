@@ -181,11 +181,10 @@ class ShardedInterpreter:
         ExchangeOperator; here bucket + lax.all_to_all over ICI).
         Per-destination bucket capacity grows via the host retry loop on
         kernel-reported overflow."""
-        # partition on HIGH hash bits: the hash kernels' home slot uses
-        # the low bits (hash % capacity), so low-bit partitioning would
-        # leave only every nshards-th home slot reachable per shard
-        part_id = ((OP._row_hash(dt, keys) >> jnp.uint64(32))
-                   % jnp.uint64(self.nshards)).astype(jnp.int32)
+        # golden-ratio 32-bit mix of the row key: identity int keys
+        # (hash_int_column) still spread evenly, and the host scan
+        # bucketing (np_partition_id) places by the same bit pattern
+        part_id = H.partition_id(OP._row_hash(dt, keys), self.nshards)
         live = dt.live_mask()
         arrays = {}
         for sym, v in dt.cols.items():
@@ -358,8 +357,27 @@ class ShardedInterpreter:
         lkeys = [lk for lk, _ in node.criteria]
         rkeys = [rk for _, rk in node.criteria]
         out_part = left.part
-        if (node.criteria and left.dist == SHARDED
-                and right.dist == SHARDED and self._join_partitioned(node)):
+        partitioned = (node.criteria and left.dist == SHARDED
+                       and right.dist == SHARDED
+                       and self._join_partitioned(node))
+        if node.join_type == N.JoinType.FULL and not partitioned:
+            # FULL with a broadcast build would emit each unmatched build
+            # row once PER SHARD; only the FIXED_HASH layout (both sides
+            # co-partitioned by key) keeps the unmatched-tail pass
+            # correct, so otherwise gather both sides and join replicated
+            probe = (left.dt if left.dist == REPLICATED
+                     else _gather(left.dt, self.nshards))
+            build = (right.dt if right.dist == REPLICATED
+                     else _gather(right.dt, self.nshards))
+            cap = self._capacity(node, next_pow2(2 * build.n))
+            out_cap = self._capacity(
+                node, next_pow2(2 * (probe.n + build.n)), "out")
+            out, t_ok, o_ok = OP.apply_expand_join(probe, build, node,
+                                                   cap, out_cap)
+            self._note_ok(node, t_ok)
+            self._note_ok(node, o_ok, "out")
+            return DistTable(out, REPLICATED)
+        if partitioned:
             # FIXED_HASH: repartition both sides by join-key hash so each
             # shard joins only its key range — per-device build memory is
             # O(build/nshards) instead of O(build)
@@ -372,7 +390,12 @@ class ShardedInterpreter:
             build = (right.dt if self._co_located(right, rkeys)
                      else self._repart(right.dt, rkeys, node,
                                        "build_exch"))
-            out_part = tuple(lkeys)
+            # FULL's unmatched-build tail rows carry NULL probe keys on
+            # whichever shard the BUILD key hashed to — the output is
+            # NOT partitioned by the probe keys (downstream co-location
+            # shortcuts would emit one NULL group per shard)
+            out_part = (None if node.join_type == N.JoinType.FULL
+                        else tuple(lkeys))
             # per-shard table: must NOT pick up the planner's global-sized
             # capacity hint (kind "ptable" skips it)
             tab_kind, out_kind = "ptable", "pout"
@@ -386,7 +409,7 @@ class ShardedInterpreter:
                      else _gather(right.dt, self.nshards))
             tab_kind, out_kind = "table", "out"
             cap = self._capacity(node, next_pow2(2 * build.n))
-        if node.build_unique:
+        if node.build_unique and node.join_type != N.JoinType.FULL:
             out, ok = OP.apply_join(probe, build, node, cap)
             self._note_ok(node, ok, tab_kind)
             return DistTable(out, left.dist, out_part)
@@ -409,9 +432,24 @@ class ShardedInterpreter:
     def _r_crossjoin(self, node: N.CrossJoin) -> DistTable:
         left = self.run(node.left)
         right = self.replicated(node.right)
-        if not node.scalar:
-            raise NotImplementedError("general cross join")
-        return DistTable(OP.apply_cross_scalar(left.dt, right),
+        if node.scalar:
+            return DistTable(OP.apply_cross_scalar(left.dt, right),
+                             left.dist, left.part)
+        # general nested loop: left stays sharded (each probe row lives
+        # on exactly one shard), build replicated — shard-local product
+        ldt = left.dt
+        lcap = self._capacity(node, next_pow2(
+            min(ldt.n, 2 * max((node.left_rows or ldt.n)
+                               // max(self.nshards, 1), 16))), "left")
+        rcap = self._capacity(node, next_pow2(
+            min(right.n, 2 * (node.right_rows or right.n))), "right")
+        if lcap < ldt.n:
+            ldt, lok = OP.compact_dtable(ldt, lcap)
+            self._note_ok(node, lok, "left")
+        if rcap < right.n:
+            right, rok = OP.compact_dtable(right, rcap)
+            self._note_ok(node, rok, "right")
+        return DistTable(OP.apply_cross_general(ldt, right),
                          left.dist, left.part)
 
     # -- replicated-only operators ------------------------------------------
@@ -594,7 +632,7 @@ def _shard_scan_arrays(scan: ScanInput, nshards: int,
     Default split is contiguous blocks padded to a multiple of
     nshards. With ``bucketed`` (connector-defined partitioning), rows
     place by key-hash bucket — the exact bit pattern of the device
-    FIXED_HASH exchange (high 32 hash bits mod nshards, numpy twins in
+    FIXED_HASH exchange (partition_id golden-ratio fold, numpy twins in
     ops/hash.py), so bucket-sharded scans are co-located with each
     other AND with repartitioned intermediates on the same keys."""
     from presto_tpu.ops import hash as H
@@ -616,8 +654,7 @@ def _shard_scan_arrays(scan: ScanInput, nshards: int,
                 scan.arrays[sym], scan.dictionaries[sym], valid))
         else:
             hs.append(H.np_hash_int_column(scan.arrays[sym], valid))
-    bucket = ((H.np_combine_hashes(hs) >> np.uint64(32))
-              % np.uint64(nshards)).astype(np.int64)
+    bucket = H.np_partition_id(H.np_combine_hashes(hs), nshards)
     base_live = scan.arrays.get("__live__")
     if base_live is not None:
         # dead padding rows go to bucket 0 as dead rows

@@ -234,6 +234,11 @@ class CrossJoin(PlanNode):
     left: PlanNode = None  # type: ignore[assignment]
     right: PlanNode = None  # type: ignore[assignment]
     scalar: bool = True  # right side guaranteed single row
+    # planner row-count estimates for the general (non-scalar) case: the
+    # executor compacts each side to ~these before taking the static
+    # product, with overflow retry (page-compaction analog)
+    left_rows: int | None = None
+    right_rows: int | None = None
 
     def sources(self):
         return [self.left, self.right]

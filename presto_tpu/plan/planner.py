@@ -1069,8 +1069,6 @@ class LogicalPlanner:
 
     def plan_outer_join(self, rel: A.JoinRelation, ctes: dict,
                         outer: Scope | None) -> RelationPlan:
-        if rel.join_type == "full":
-            raise SemanticError("FULL OUTER JOIN not supported yet")
         left = self._plan_join_operand(rel.left, ctes, outer)
         right = self._plan_join_operand(rel.right, ctes, outer)
         # RIGHT join: probe the right side, build the left; the declared
@@ -1111,10 +1109,12 @@ class LogicalPlanner:
                         and isinstance(b, ir.ColumnRef):
                     criteria.append((a.name, b.name))
                     continue
-            if refs <= bsyms:
+            if refs <= bsyms and rel.join_type != "full":
                 # build-side-only ON conjunct: filter the build input
-                # (legal for outer joins: it only affects which build
-                # rows can match)
+                # (legal for one-sided outer joins: it only affects
+                # which build rows can match; for FULL the filtered
+                # build rows must still emit unmatched, so it stays a
+                # residual)
                 build_node = N.Filter(build_node, planned)
                 continue
             residual.append(planned)
@@ -1126,15 +1126,22 @@ class LogicalPlanner:
                 T.BOOLEAN, "and", tuple(residual))
         build_syms = frozenset(b for _, b in criteria)
         build_unique = any(k <= build_syms for k in build.unique)
-        jt = (N.JoinType.INNER if rel.join_type == "inner"
-              else N.JoinType.LEFT)
+        if rel.join_type == "full":
+            jt = N.JoinType.FULL
+        elif rel.join_type == "inner":
+            jt = N.JoinType.INNER
+        else:
+            jt = N.JoinType.LEFT
         node = N.Join(probe.node, build_node, jt, criteria,
                       filt, build_unique,
                       build_rows=build.est,
                       capacity=_next_pow2(2 * build.est),
-                      output_capacity=None if build_unique
+                      output_capacity=None
+                      if build_unique and jt != N.JoinType.FULL
                       else _next_pow2(2 * (probe.est + build.est)))
         est = probe.est if build_unique else probe.est + build.est
+        if jt == N.JoinType.FULL:
+            est = probe.est + build.est
         return RelationPlan(node, combined, est, probe.unique)
 
     def _plan_join_operand(self, rel: A.Relation, ctes, outer
@@ -1479,13 +1486,24 @@ class LogicalPlanner:
                 elif lb in in_set and la in remaining:
                     cands.setdefault(la, []).append((sb, sa))
             if not cands:
-                # no edge: cross join (scalar only)
+                # no edge: cross join. Single-row right sides broadcast
+                # (scalar path); the general case is a nested-loop
+                # product over compacted sides, bounded at plan time
+                # (reference NestedLoopJoinOperator precedent)
                 j = min(remaining, key=lambda i: legs[i].est)
-                if legs[j].est > 1:
-                    raise SemanticError(
-                        "cross join between relations without join "
-                        "predicate is not supported")
-                node = N.CrossJoin(node, legs[j].node, scalar=True)
+                if legs[j].est <= 1:
+                    node = N.CrossJoin(node, legs[j].node, scalar=True)
+                else:
+                    if est * legs[j].est > (1 << 26):
+                        raise SemanticError(
+                            "cross join product estimated at "
+                            f"{est * legs[j].est} rows exceeds the "
+                            "nested-loop limit (add a join predicate)")
+                    node = N.CrossJoin(node, legs[j].node, scalar=False,
+                                       left_rows=est,
+                                       right_rows=legs[j].est)
+                    est = max(est * legs[j].est, 1)
+                    unique = []
                 in_set.add(j)
                 remaining.discard(j)
                 joined_syms |= set(legs[j].node.output_types())
