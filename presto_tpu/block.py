@@ -182,6 +182,11 @@ def _decode_column(dtype: T.DataType, data: np.ndarray, dictionary):
     if isinstance(dtype, T.DateType):
         epoch = np.datetime64("1970-01-01")
         return (epoch + data.astype("timedelta64[D]")).astype("datetime64[D]")
+    if isinstance(dtype, T.TimestampType):
+        epoch = np.datetime64("1970-01-01", "us")
+        return epoch + data.astype("timedelta64[us]")
+    if isinstance(dtype, T.TimeType):
+        return data.astype("timedelta64[us]")
     if isinstance(dtype, T.BooleanType):
         return data.astype(bool)
     if isinstance(dtype, T.DoubleType):

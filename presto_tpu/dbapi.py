@@ -74,13 +74,15 @@ def _quote(value) -> str:
     if isinstance(value, (int, float)):
         return repr(value)
     if isinstance(value, datetime.datetime):
-        # no TIMESTAMP type in the engine yet; truncating to DATE would
-        # silently change results — fail loudly instead
-        raise NotSupportedError(
-            "datetime parameters are unsupported (no TIMESTAMP type); "
-            "pass datetime.date")
+        if value.tzinfo is not None:
+            raise NotSupportedError(
+                "timezone-aware datetimes are unsupported "
+                "(no TIMESTAMP WITH TIME ZONE type)")
+        return f"TIMESTAMP '{value:%Y-%m-%d %H:%M:%S.%f}'"
     if isinstance(value, datetime.date):
         return f"DATE '{value:%Y-%m-%d}'"
+    if isinstance(value, datetime.time):
+        return f"TIME '{value:%H:%M:%S.%f}'"
     if isinstance(value, str):
         return "'" + value.replace("'", "''") + "'"
     raise ProgrammingError(f"cannot bind parameter of type {type(value)}")
@@ -134,6 +136,24 @@ def _substitute(sql: str, params) -> str:
     return "".join(out)
 
 
+def _parse_wire_timestamp(v: str) -> datetime.datetime:
+    s = str(v).replace("T", " ")
+    fmt = "%Y-%m-%d %H:%M:%S.%f" if "." in s else "%Y-%m-%d %H:%M:%S"
+    return datetime.datetime.strptime(s, fmt)
+
+
+def _parse_wire_time(v: str) -> datetime.time:
+    fmt = "%H:%M:%S.%f" if "." in str(v) else "%H:%M:%S"
+    return datetime.datetime.strptime(str(v), fmt).time()
+
+
+_WIRE_CONVERTERS = {
+    "date": lambda v: datetime.date.fromisoformat(str(v)),
+    "timestamp": _parse_wire_timestamp,
+    "time": _parse_wire_time,
+}
+
+
 class Cursor:
     arraysize = 1
 
@@ -161,7 +181,14 @@ class Cursor:
         self.description = [
             (c.get("name"), c.get("type"), None, None, None, None, None)
             for c in columns]
-        self._rows = [tuple(r) for r in rows]
+        convs = [_WIRE_CONVERTERS.get(str(c.get("type", "")).lower())
+                 for c in columns]
+        if any(convs):
+            self._rows = [
+                tuple(v if cv is None or v is None else cv(v)
+                      for v, cv in zip(r, convs)) for r in rows]
+        else:
+            self._rows = [tuple(r) for r in rows]
         self._pos = 0
         self.rowcount = len(self._rows)
         return self

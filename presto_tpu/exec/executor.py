@@ -386,7 +386,12 @@ def make_traced(scan_inputs: list[ScanInput], plan: N.PlanNode,
             res.append(v.data)
             res.append(v.valid if v.valid is not None
                        else jnp.ones((out.n,), dtype=bool))
-        return tuple(res), out.live_mask(), tuple(interp.ok_flags)
+        # ok flags ship as ONE stacked array: a tuple of device scalars
+        # costs one host round-trip EACH to inspect (~90ms over a
+        # tunneled device), a (k,) bool array costs one total
+        oks = (jnp.stack(interp.ok_flags) if interp.ok_flags
+               else jnp.zeros((0,), dtype=bool))
+        return tuple(res), out.live_mask(), oks
 
     return traced_fn, flat_arrays, meta
 
@@ -479,11 +484,12 @@ def prepare_plan(engine, plan: N.PlanNode, scan_inputs: list[ScanInput]):
             compiled, meta = entry
             out = compiled(*flat_arrays)
         res, live, oks = out
-        if all(bool(o) for o in oks):
+        oks_np = np.asarray(oks)  # ONE host sync for every flag
+        if oks_np.all():
             engine._caps_memory[base_key] = dict(capacities)
             return compiled, flat_arrays, meta, (res, live, oks)
-        for key, okv in zip(meta["ok_keys"], oks):
-            if not bool(okv):
+        for key, okv in zip(meta["ok_keys"], oks_np):
+            if not okv:
                 capacities[key] = (RETRY_GROWTH
                                    * meta["used_capacity"][key])
     raise RuntimeError("hash table capacity retry limit exceeded")
@@ -692,12 +698,14 @@ def run_plan(engine, plan: N.PlanNode,
             # device-side shape math only — no transfer
             pool.reserve(tag, sum(int(r.nbytes) for r in res))
 
-        live_np = np.asarray(live)
+        # one batched device->host transfer for every output column:
+        # per-array np.asarray pays a tunnel round-trip each
+        live_np, res_np = jax.device_get((live, res))
         cols: dict[str, Column] = {}
         i = 0
         for sym, dtype, dictionary, has_valid in meta["out"]:
-            data = np.asarray(res[i])
-            valid = np.asarray(res[i + 1])
+            data = res_np[i]
+            valid = res_np[i + 1]
             i += 2
             cols[sym] = Column(
                 dtype, data,

@@ -157,9 +157,10 @@ def _run_partitions(engine, jp: N.Join, part_inputs: list) -> list[Table]:
             feed = [pinput.arrays[s] for s in pinput0.arrays] + \
                    [binput.arrays[s] for s in binput0.arrays]
             res, live, oks = compiled(*feed)
-            if not all(bool(o) for o in oks):
-                for key, okv in zip(meta["ok_keys"], oks):
-                    if not bool(okv):
+            oks_np = np.asarray(oks)
+            if not oks_np.all():
+                for key, okv in zip(meta["ok_keys"], oks_np):
+                    if not okv:
                         capacities[key] = 4 * meta["used_capacity"][key]
                 overflow = True
                 break
@@ -272,9 +273,10 @@ def _run_partition_plans(engine, root: N.PlanNode,
             for inp, inp0 in zip(inputs, inputs0):
                 feed.extend(inp.arrays[s] for s in inp0.arrays)
             res, live, oks = compiled(*feed)
-            if not all(bool(o) for o in oks):
-                for key, okv in zip(meta["ok_keys"], oks):
-                    if not bool(okv):
+            oks_np = np.asarray(oks)
+            if not oks_np.all():
+                for key, okv in zip(meta["ok_keys"], oks_np):
+                    if not okv:
                         capacities[key] = 4 * meta["used_capacity"][key]
                 overflow = True
                 break

@@ -126,10 +126,11 @@ def try_execute_streamed(engine, plan: N.PlanNode):
                 compiled = jax.jit(traced_fn)
             res, live, oks = compiled(
                 *[arrays[sym] for sym in scan.arrays], arrays["__live__"])
-            if all(bool(o) for o in oks):
+            oks_np = np.asarray(oks)
+            if oks_np.all():
                 break
-            for key, okv in zip(meta["ok_keys"], oks):
-                if not bool(okv):
+            for key, okv in zip(meta["ok_keys"], oks_np):
+                if not okv:
                     capacities[key] = 4 * meta["used_capacity"][key]
             compiled = None  # recompile with grown capacity
         else:

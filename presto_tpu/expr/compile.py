@@ -250,6 +250,16 @@ def cast_val(v: Val, to: T.DataType) -> Val:
         return Val(to, d.astype(jnp.int64), v.valid)
     if isinstance(to, T.IntegerType):
         return Val(to, d.astype(jnp.int32), v.valid)
+    if isinstance(to, T.TimestampType):
+        if isinstance(v.dtype, T.DateType):
+            return Val(to, v.data.astype(jnp.int64) * T.US_PER_DAY,
+                       v.valid)
+        if v.is_string:
+            return _parse_datetime_dictionary(v, to)
+    if isinstance(to, T.DateType) and isinstance(v.dtype,
+                                                 T.TimestampType):
+        return Val(to, jnp.floor_divide(v.data, T.US_PER_DAY)
+                   .astype(jnp.int32), v.valid)
     if isinstance(to, T.DateType) and v.is_string:
         # per-dictionary-entry ISO date parse (one parse per unique
         # string, rows gather by code); malformed strings become NULL
@@ -283,6 +293,28 @@ def _div_round(x, f: int):
     """Integer division rounding half away from zero."""
     half = f // 2
     return jnp.where(x >= 0, (x + half) // f, -((-x + half) // f))
+
+
+def _parse_datetime_dictionary(v: Val, to: T.DataType) -> Val:
+    """Per-dictionary-entry timestamp parse (cast varchar -> timestamp);
+    malformed strings become NULL."""
+    epoch = np.datetime64("1970-01-01", "us")
+    us = np.zeros(len(v.dictionary), dtype=np.int64)
+    ok = np.zeros(len(v.dictionary), dtype=bool)
+    for i, s in enumerate(v.dictionary):
+        try:
+            d64 = np.datetime64(str(s).strip().replace(" ", "T"), "us")
+            if not np.isnat(d64):
+                us[i] = int((d64 - epoch).astype(np.int64))
+                ok[i] = True
+        except (ValueError, OverflowError):
+            pass
+    d = v.data
+    data = (jnp.asarray(us)[jnp.clip(d, 0, max(len(us) - 1, 0))]
+            if len(us) else jnp.zeros_like(d, dtype=jnp.int64))
+    okrow = (jnp.asarray(ok)[jnp.clip(d, 0, max(len(ok) - 1, 0))]
+             if len(ok) else jnp.zeros_like(d, dtype=bool))
+    return Val(to, data, and_valid(v.valid, okrow))
 
 
 # --- scalar function registry ---------------------------------------------
@@ -434,6 +466,10 @@ def _compare(e: ir.Call, args: list[Val], op, eq_only_op) -> Val:
     elif isinstance(a.dtype, T.DoubleType) != isinstance(b.dtype, T.DoubleType):
         da = cast_val(a, T.DOUBLE).data
         db = cast_val(b, T.DOUBLE).data
+    elif {type(a.dtype), type(b.dtype)} == {T.DateType, T.TimestampType}:
+        # align epoch-days against epoch-micros (DATE widens)
+        da = cast_val(a, T.TIMESTAMP).data
+        db = cast_val(b, T.TIMESTAMP).data
     return _bool(op(da, db), valid)
 
 
@@ -654,6 +690,20 @@ def _civil_from_days(days):
     return y, m, d
 
 
+def _days_of(v: Val):
+    """Epoch days of a DATE or TIMESTAMP Val (floor for pre-epoch)."""
+    if isinstance(v.dtype, T.TimestampType):
+        return jnp.floor_divide(v.data, T.US_PER_DAY)
+    return v.data
+
+
+def _us_of(v: Val):
+    """Epoch micros of a DATE or TIMESTAMP Val."""
+    if isinstance(v.dtype, T.DateType):
+        return v.data.astype(jnp.int64) * T.US_PER_DAY
+    return v.data
+
+
 def _days_from_civil(y, m, d):
     """Inverse of _civil_from_days (Hinnant's days_from_civil)."""
     y = y - (m <= 2)
@@ -690,22 +740,237 @@ def _add_months(e, args):
 @scalar("year")
 def _year(e, args):
     (a,) = args
-    y, _, _ = _civil_from_days(a.data)
+    y, _, _ = _civil_from_days(_days_of(a))
     return Val(e.dtype, y, a.valid)
 
 
 @scalar("month")
 def _month(e, args):
     (a,) = args
-    _, m, _ = _civil_from_days(a.data)
+    _, m, _ = _civil_from_days(_days_of(a))
     return Val(e.dtype, m, a.valid)
 
 
 @scalar("day")
 def _day(e, args):
     (a,) = args
-    _, _, d = _civil_from_days(a.data)
+    _, _, d = _civil_from_days(_days_of(a))
     return Val(e.dtype, d, a.valid)
+
+
+@scalar("hour")
+def _hour(e, args):
+    (a,) = args
+    us = a.data if isinstance(a.dtype, T.TimeType) else (
+        _us_of(a) - _days_of(a) * T.US_PER_DAY)
+    return Val(e.dtype, us // T.US_PER_HOUR, a.valid)
+
+
+@scalar("minute")
+def _minute(e, args):
+    (a,) = args
+    us = a.data if isinstance(a.dtype, T.TimeType) else (
+        _us_of(a) - _days_of(a) * T.US_PER_DAY)
+    return Val(e.dtype, (us // T.US_PER_MINUTE) % 60, a.valid)
+
+
+@scalar("second")
+def _second(e, args):
+    (a,) = args
+    us = a.data if isinstance(a.dtype, T.TimeType) else (
+        _us_of(a) - _days_of(a) * T.US_PER_DAY)
+    return Val(e.dtype, (us // T.US_PER_SECOND) % 60, a.valid)
+
+
+@scalar("millisecond")
+def _millisecond(e, args):
+    (a,) = args
+    us = a.data if isinstance(a.dtype, T.TimeType) else (
+        _us_of(a) - _days_of(a) * T.US_PER_DAY)
+    return Val(e.dtype, (us // 1000) % 1000, a.valid)
+
+
+def _trunc_days(unit: str, days):
+    """Truncate epoch days to the start of a civil unit (day stays)."""
+    y, m, _d = _civil_from_days(days)
+    one = jnp.ones_like(y)
+    if unit == "year":
+        return _days_from_civil(y, one, one)
+    if unit == "quarter":
+        return _days_from_civil(y, ((m - 1) // 3) * 3 + 1, one)
+    if unit == "month":
+        return _days_from_civil(y, m, one)
+    if unit == "week":  # ISO week starts Monday; epoch day 0 = Thursday
+        d = days.astype(jnp.int64)
+        return d - ((d + 3) % 7)
+    raise NotImplementedError(f"date_trunc unit {unit}")
+
+
+@scalar("date_trunc")
+def _date_trunc(e, args):
+    unit = str(e.args[0].value).lower()
+    v = args[1]
+    if isinstance(v.dtype, T.DateType):
+        if unit == "day":
+            return v
+        out = _trunc_days(unit, v.data)
+        return Val(e.dtype, out.astype(jnp.int32), v.valid)
+    us_per = {"second": T.US_PER_SECOND, "minute": T.US_PER_MINUTE,
+              "hour": T.US_PER_HOUR, "day": T.US_PER_DAY}.get(unit)
+    if us_per is not None:
+        out = jnp.floor_divide(v.data, us_per) * us_per
+        return Val(e.dtype, out, v.valid)
+    out = _trunc_days(unit, _days_of(v)) * T.US_PER_DAY
+    return Val(e.dtype, out, v.valid)
+
+
+def _add_months_days(days, months):
+    """days + months with day-of-month clamping (shared by add_months,
+    ts_add_months, date_add)."""
+    y, m, d = _civil_from_days(days)
+    total = (y * 12 + (m - 1)) + months
+    ny = jnp.floor_divide(total, 12)
+    nm = total - ny * 12 + 1
+    month_days = jnp.asarray(
+        [31, 28, 31, 30, 31, 30, 31, 31, 30, 31, 30, 31])[nm - 1]
+    leap = ((ny % 4 == 0) & (ny % 100 != 0)) | (ny % 400 == 0)
+    month_days = jnp.where((nm == 2) & leap, 29, month_days)
+    return _days_from_civil(ny, nm, jnp.minimum(d, month_days))
+
+
+@scalar("ts_add_months")
+def _ts_add_months(e, args):
+    a, months = args
+    days = _days_of(a)
+    tod = a.data - days * T.US_PER_DAY
+    out = _add_months_days(days, months.data) * T.US_PER_DAY + tod
+    return Val(e.dtype, out, and_valid(a.valid, months.valid))
+
+
+@scalar("date_add")
+def _date_add(e, args):
+    unit = str(e.args[0].value).lower()
+    if unit.endswith("s"):
+        unit = unit[:-1]
+    n, v = args[1], args[2]
+    valid = and_valid(n.valid, v.valid)
+    months = {"year": 12, "quarter": 3, "month": 1}.get(unit)
+    if isinstance(v.dtype, T.DateType):
+        if months is not None:
+            out = _add_months_days(v.data, n.data * months)
+            return Val(e.dtype, out.astype(jnp.int32), valid)
+        per_day = {"day": 1, "week": 7}.get(unit)
+        if per_day is None:
+            raise NotImplementedError(
+                f"date_add({unit}) on a date value")
+        return Val(e.dtype, (v.data + n.data * per_day)
+                   .astype(jnp.int32), valid)
+    if months is not None:
+        days = _days_of(v)
+        tod = v.data - days * T.US_PER_DAY
+        out = _add_months_days(days, n.data * months) \
+            * T.US_PER_DAY + tod
+        return Val(e.dtype, out, valid)
+    us_per = {"second": T.US_PER_SECOND, "minute": T.US_PER_MINUTE,
+              "hour": T.US_PER_HOUR, "day": T.US_PER_DAY,
+              "week": 7 * T.US_PER_DAY,
+              "millisecond": 1000}.get(unit)
+    if us_per is None:
+        raise NotImplementedError(f"date_add unit {unit}")
+    return Val(e.dtype, v.data + n.data * us_per, valid)
+
+
+@scalar("date_diff")
+def _date_diff(e, args):
+    unit = str(e.args[0].value).lower()
+    if unit.endswith("s"):
+        unit = unit[:-1]
+    a, b = args[1], args[2]
+    valid = and_valid(a.valid, b.valid)
+    if unit in ("year", "quarter", "month"):
+        # calendar-component difference (reference DateTimeFunctions
+        # diffDate via epoch-month arithmetic)
+        ya, ma, _ = _civil_from_days(_days_of(a))
+        yb, mb, _ = _civil_from_days(_days_of(b))
+        months = (yb * 12 + mb) - (ya * 12 + ma)
+        div = {"year": 12, "quarter": 3, "month": 1}[unit]
+        return Val(e.dtype, (months // div).astype(jnp.int64), valid)
+    if unit in ("day", "week") and isinstance(a.dtype, T.DateType) \
+            and isinstance(b.dtype, T.DateType):
+        d = (b.data - a.data).astype(jnp.int64)
+        if unit == "week":  # truncate toward zero, like the us branch
+            d = jnp.where(d >= 0, d // 7, -((-d) // 7))
+        return Val(e.dtype, d, valid)
+    us_per = {"second": T.US_PER_SECOND, "minute": T.US_PER_MINUTE,
+              "hour": T.US_PER_HOUR, "day": T.US_PER_DAY,
+              "week": 7 * T.US_PER_DAY,
+              "millisecond": 1000}.get(unit)
+    if us_per is None:
+        raise NotImplementedError(f"date_diff unit {unit}")
+    diff = _us_of(b) - _us_of(a)
+    # truncate toward zero (reference diffTimestamp semantics)
+    out = jnp.where(diff >= 0, diff // us_per, -((-diff) // us_per))
+    return Val(e.dtype, out, valid)
+
+
+@scalar("from_unixtime")
+def _from_unixtime(e, args):
+    (a,) = args
+    sec = a.data.astype(jnp.float64) / (
+        a.dtype.unscale_factor if isinstance(a.dtype, T.DecimalType)
+        else 1)
+    return Val(e.dtype, jnp.round(sec * T.US_PER_SECOND)
+               .astype(jnp.int64), a.valid)
+
+
+@scalar("to_unixtime")
+def _to_unixtime(e, args):
+    (a,) = args
+    return Val(e.dtype, _us_of(a).astype(jnp.float64) / T.US_PER_SECOND,
+               a.valid)
+
+
+# MySQL-style date_format specifiers with day granularity (time-of-day
+# specifiers need per-row strings, which have no dictionary encoding)
+_MYSQL_STRFTIME = {
+    "%Y": "%Y", "%y": "%y", "%m": "%m", "%c": "%-m", "%d": "%d",
+    "%e": "%-d", "%j": "%j", "%M": "%B", "%b": "%b", "%W": "%A",
+    "%a": "%a",
+}
+_DATE_FORMAT_LO = -40179  # 1860-01-01
+_DATE_FORMAT_HI = 80468   # 2190-04-25
+_DATE_FORMAT_CACHE: dict[str, np.ndarray] = {}
+
+
+@scalar("date_format")
+def _date_format(e, args):
+    import datetime
+    import re
+
+    if not isinstance(e.args[1], ir.Literal):
+        raise NotImplementedError("date_format with non-literal format")
+    fmt = str(e.args[1].value)
+    v = args[0]
+    if re.search(r"%[HhiSsfprT]", fmt):
+        raise NotImplementedError(
+            "date_format with time-of-day specifiers")
+    lut = _DATE_FORMAT_CACHE.get(fmt)
+    if lut is None:
+        pyfmt = re.sub(
+            "%.", lambda m: _MYSQL_STRFTIME.get(m.group(0), m.group(0)),
+            fmt)
+        base = datetime.date(1970, 1, 1).toordinal()
+        lut = np.array(
+            [datetime.date.fromordinal(base + d).strftime(pyfmt)
+             for d in range(_DATE_FORMAT_LO, _DATE_FORMAT_HI)], object)
+        if len(_DATE_FORMAT_CACHE) > 16:
+            _DATE_FORMAT_CACHE.clear()
+        _DATE_FORMAT_CACHE[fmt] = lut
+    days = _days_of(v)
+    code = (days - _DATE_FORMAT_LO).astype(jnp.int32)
+    in_range = (code >= 0) & (code < len(lut))
+    return Val(T.VARCHAR, jnp.clip(code, 0, len(lut) - 1),
+               and_valid(v.valid, in_range), lut)
 
 
 # -- strings -----------------------------------------------------------------
@@ -834,6 +1099,11 @@ def _strpos(e, args):
 
 @scalar("coalesce")
 def _coalesce(e, args):
+    if not any(a.is_string for a in args) \
+            and not isinstance(e.dtype, T.VarcharType):
+        # physical alignment to the result type (e.g. a DATE branch
+        # under a TIMESTAMP result must not merge days with micros)
+        args = [cast_val(a, e.dtype) for a in args]
     out = args[-1]
     for v in args[:-1][::-1]:
         take = jnp.ones_like(v.data, dtype=bool) if v.valid is None else v.valid
@@ -931,7 +1201,7 @@ def _nullif(e, args):
 @scalar("quarter")
 def _quarter(e, args):
     (a,) = args
-    _, m, _ = _civil_from_days(a.data)
+    _, m, _ = _civil_from_days(_days_of(a))
     return Val(e.dtype, (m - 1) // 3 + 1, a.valid)
 
 
@@ -939,23 +1209,24 @@ def _quarter(e, args):
 def _day_of_week(e, args):
     # ISO: Monday=1..Sunday=7; epoch 1970-01-01 was a Thursday
     (a,) = args
-    dow = (a.data.astype(jnp.int64) + 3) % 7 + 1
+    dow = (_days_of(a).astype(jnp.int64) + 3) % 7 + 1
     return Val(e.dtype, dow, a.valid)
 
 
 @scalar("day_of_year")
 def _day_of_year(e, args):
     (a,) = args
-    y, _, _ = _civil_from_days(a.data)
+    days = _days_of(a)
+    y, _, _ = _civil_from_days(days)
     jan1 = _days_from_civil(y, jnp.ones_like(y), jnp.ones_like(y))
-    return Val(e.dtype, a.data.astype(jnp.int64) - jan1 + 1, a.valid)
+    return Val(e.dtype, days.astype(jnp.int64) - jan1 + 1, a.valid)
 
 
 @scalar("week")
 def _week(e, args):
     # ISO week number of the year (reference week_of_year)
     (a,) = args
-    d = a.data.astype(jnp.int64)
+    d = _days_of(a).astype(jnp.int64)
     # Thursday of this row's ISO week determines the ISO year
     thursday = d - ((d + 3) % 7) + 3
     y, _, _ = _civil_from_days(thursday)

@@ -41,10 +41,20 @@ class TaskError(RuntimeError):
 
 
 class RemoteWorker:
-    def __init__(self, uri: str):
+    def __init__(self, uri: str, shared_secret: str | None = None):
+        from presto_tpu.parallel import auth as _auth
         self.uri = uri
+        self.shared_secret = (shared_secret
+                              if shared_secret is not None
+                              else _auth.default_secret())
         self.failure_ratio = 0.0  # exponential decay of ping failures
         self.lock = threading.Lock()
+
+    def _auth_headers(self) -> dict:
+        if self.shared_secret is None:
+            return {}
+        from presto_tpu.parallel import auth as _auth
+        return {_auth.HEADER: _auth.make_token(self.shared_secret)}
 
     DECAY = 0.7
     THRESHOLD = 0.5
@@ -71,7 +81,8 @@ class RemoteWorker:
         req = urllib.request.Request(
             f"{self.uri}/v1/task",
             data=json.dumps(payload).encode(), method="POST",
-            headers={"Content-Type": "application/json"})
+            headers={"Content-Type": "application/json",
+                     **self._auth_headers()})
         try:
             with urllib.request.urlopen(req, timeout=timeout) as resp:
                 body = resp.read()
@@ -92,7 +103,8 @@ class RemoteWorker:
 
     def delete_task(self, prefix: str, timeout: float = 10.0) -> None:
         req = urllib.request.Request(
-            f"{self.uri}/v1/task/{prefix}", method="DELETE")
+            f"{self.uri}/v1/task/{prefix}", method="DELETE",
+            headers=self._auth_headers())
         try:
             with urllib.request.urlopen(req, timeout=timeout):
                 pass

@@ -115,13 +115,22 @@ class BufferConnector:
         return TableStats(row_count=self._tables[name][1])
 
 
-def _fetch_buffer(ref: dict, timeout: float = 120.0) -> bytes:
+def _fetch_buffer(ref: dict, timeout: float = 120.0,
+                  secret: str | None = None) -> bytes:
+    from presto_tpu.parallel import auth as _auth
     url = f"{ref['uri']}/v1/task/{ref['task_id']}/results/{ref['part']}"
-    with urllib.request.urlopen(url, timeout=timeout) as resp:
+    headers = {}
+    if secret is None:
+        secret = _auth.default_secret()
+    if secret is not None:
+        headers[_auth.HEADER] = _auth.make_token(secret)
+    req = urllib.request.Request(url, headers=headers)
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
         return resp.read()
 
 
-def execute_fragment_task(engine, req: dict, store: dict) -> object:
+def execute_fragment_task(engine, req: dict, store: dict,
+                          secret: str | None = None) -> object:
     """Run one fragment task. Returns a dict (JSON response, buffered
     output) or bytes (inline binary result)."""
     from presto_tpu.exec.executor import collect_scans, run_plan
@@ -137,7 +146,8 @@ def execute_fragment_task(engine, req: dict, store: dict) -> object:
     if sources:
         conn = BufferConnector()
         for tname, refs in sources.items():
-            parts = [bytes_to_columns(_fetch_buffer(r)) for r in refs]
+            parts = [bytes_to_columns(_fetch_buffer(r, secret=secret))
+                     for r in refs]
             cols = concat_columns([p[0] for p in parts])
             nrows = sum(p[1] for p in parts)
             conn.add(tname, cols, nrows)
@@ -170,9 +180,14 @@ class WorkerServer(HttpService):
     across tasks of repeat queries."""
 
     def __init__(self, catalogs: dict, host: str = "127.0.0.1",
-                 port: int = 0, node_id: str = "worker"):
+                 port: int = 0, node_id: str = "worker",
+                 shared_secret: str | None = None):
+        from presto_tpu.parallel import auth as _auth
         self.catalogs = catalogs
         self.node_id = node_id
+        self.shared_secret = (shared_secret
+                              if shared_secret is not None
+                              else _auth.default_secret())
         self.buffers: dict[str, list[bytes]] = {}
         self._engines: dict[tuple, object] = {}
         self._lock = threading.Lock()
@@ -198,7 +213,25 @@ class WorkerServer(HttpService):
         outer = self
 
         class Handler(JsonHandler):
+            def _authorized(self) -> bool:
+                """Shared-secret check on every task/buffer endpoint
+                (reference InternalAuthenticationManager). /v1/status
+                stays open: the failure detector pings it and it leaks
+                only pool sizes."""
+                if outer.shared_secret is None \
+                        or self.path == "/v1/status":
+                    return True
+                from presto_tpu.parallel import auth as _auth
+                tok = self.headers.get(_auth.HEADER)
+                if _auth.check_token(outer.shared_secret, tok):
+                    return True
+                self._send_json(
+                    {"error": "unauthorized internal request"}, 401)
+                return False
+
             def do_GET(self):  # noqa: N802
+                if not self._authorized():
+                    return
                 parts = self.path.strip("/").split("/")
                 if self.path == "/v1/status":
                     pools = [e.memory_pool.info()
@@ -223,6 +256,8 @@ class WorkerServer(HttpService):
                 self._send_json({"error": "not found"}, 404)
 
             def do_DELETE(self):  # noqa: N802
+                if not self._authorized():
+                    return
                 parts = self.path.strip("/").split("/")
                 if len(parts) == 3 and parts[:2] == ["v1", "task"]:
                     # task-id prefix delete: one query's stages share
@@ -237,6 +272,8 @@ class WorkerServer(HttpService):
                 self._send_json({"error": "not found"}, 404)
 
             def do_POST(self):  # noqa: N802
+                if not self._authorized():
+                    return
                 if self.path != "/v1/task":
                     self._send_json({"error": "not found"}, 404)
                     return
@@ -248,7 +285,8 @@ class WorkerServer(HttpService):
                             int(req.get("nshards", 1)))
                         with outer._task_lock:
                             out = execute_fragment_task(
-                                engine, req, outer.buffers)
+                                engine, req, outer.buffers,
+                                secret=outer.shared_secret)
                         if isinstance(out, bytes):
                             self._send_bytes(out)
                         else:

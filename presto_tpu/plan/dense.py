@@ -1,0 +1,194 @@
+"""Dense-key annotation pass: mark joins/semijoins whose build keys are
+bounded-range integers so the executor can use direct-address tables
+(one scatter + one gather) instead of sort-merge probes.
+
+TPC-H/TPC-DS surrogate keys are dense 1..n integers (the reference ships
+the same fact as connector column statistics,
+plugin/trino-tpch/src/main/resources/tpch/statistics + the *_sk columns
+of TPC-DS), and TPU sorts cost ~6ns/row/pass while a direct-address
+probe is a single gather — the pass exists because the physical choice
+needs value-range facts the trace-time executor cannot see.
+
+Runs AFTER the optimizer pipeline (plan shapes are final). Ranges are
+conservative over-approximations propagated from connector
+column_range_estimates through position-preserving operators.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from presto_tpu import types as T
+from presto_tpu.plan import nodes as N
+
+# widest direct-address table the executor will allocate (slots)
+MAX_SPAN = 1 << 24
+# and the widest relative to the build side (avoid 16M-slot tables for
+# 100-row builds)
+MAX_SPAN_FACTOR = 16
+
+
+def _scan_ranges(node: N.TableScan, engine) -> dict[str, tuple]:
+    conn = engine.catalogs.get(node.catalog)
+    if conn is None:
+        return {}
+    try:
+        ranges = conn.column_range_estimates(node.table)
+    except (AttributeError, KeyError):
+        return {}
+    out = {}
+    for sym, col in node.assignments.items():
+        r = ranges.get(col)
+        if r is not None:
+            out[sym] = (int(r[0]), int(r[1]))
+    return out
+
+
+def symbol_ranges(node: N.PlanNode, engine) -> dict[str, tuple]:
+    """(lo, hi) bounds per output symbol, where derivable. Conservative:
+    a symbol missing from the map has unknown range."""
+    if isinstance(node, N.TableScan):
+        return _scan_ranges(node, engine)
+    if isinstance(node, N.Filter):
+        return symbol_ranges(node.source, engine)
+    if isinstance(node, N.Project):
+        src = symbol_ranges(node.source, engine)
+        out = {}
+        from presto_tpu.expr import ir
+        for sym, expr in node.assignments.items():
+            if isinstance(expr, ir.ColumnRef) and expr.name in src:
+                out[sym] = src[expr.name]
+        return out
+    if isinstance(node, (N.Join, N.CrossJoin)):
+        out = symbol_ranges(node.left, engine)
+        out.update(symbol_ranges(node.right, engine))
+        return out
+    if isinstance(node, N.SemiJoin):
+        return symbol_ranges(node.source, engine)
+    if isinstance(node, (N.Sort, N.TopN, N.Limit, N.Distinct,
+                         N.MarkDistinct, N.Exchange, N.Window)):
+        return symbol_ranges(node.sources()[0], engine)
+    if isinstance(node, N.Aggregate):
+        src = symbol_ranges(node.source, engine)
+        return {k: src[k] for k in node.group_keys if k in src}
+    return {}
+
+
+def unique_key_sets(node: N.PlanNode, engine) -> list[frozenset]:
+    """Symbol sets that are unique keys of the node's output, derived
+    structurally (the planner's RelationPlan.unique analog, recomputed
+    over the optimized plan)."""
+    if isinstance(node, N.TableScan):
+        conn = engine.catalogs.get(node.catalog)
+        if conn is None:
+            return []
+        try:
+            keys = conn.unique_keys(node.table)
+        except (AttributeError, KeyError, NotImplementedError):
+            return []
+        by_col = {c: s for s, c in node.assignments.items()}
+        out = []
+        for key in keys:
+            if all(c in by_col for c in key):
+                out.append(frozenset(by_col[c] for c in key))
+        return out
+    if isinstance(node, N.Filter):
+        return unique_key_sets(node.source, engine)
+    if isinstance(node, N.Project):
+        from presto_tpu.expr import ir
+        src = unique_key_sets(node.source, engine)
+        fwd = {}
+        for sym, expr in node.assignments.items():
+            if isinstance(expr, ir.ColumnRef):
+                fwd.setdefault(expr.name, sym)
+        out = []
+        for key in src:
+            if all(s in fwd for s in key):
+                out.append(frozenset(fwd[s] for s in key))
+        return out
+    if isinstance(node, N.Join):
+        if node.join_type in (N.JoinType.INNER, N.JoinType.LEFT) \
+                and node.build_unique:
+            # each probe row matches <= 1 build row: probe keys survive
+            return unique_key_sets(node.left, engine)
+        return []
+    if isinstance(node, N.SemiJoin):
+        return unique_key_sets(node.source, engine)
+    if isinstance(node, N.Aggregate) and node.group_keys:
+        return [frozenset(node.group_keys)]
+    if isinstance(node, N.Distinct):
+        return [frozenset(node.source.output_symbols)]
+    if isinstance(node, (N.Sort, N.TopN, N.Limit, N.MarkDistinct,
+                         N.Exchange)):
+        return unique_key_sets(node.sources()[0], engine)
+    return []
+
+
+def _eligible_span(rng: tuple, build_rows: int | None) -> bool:
+    lo, hi = rng
+    span = hi - lo + 1
+    if span <= 0 or span > MAX_SPAN:
+        return False
+    if build_rows and span > max(MAX_SPAN_FACTOR * build_rows, 4096):
+        return False
+    return True
+
+
+def _int_typed(types: dict, sym: str) -> bool:
+    t = types.get(sym)
+    return isinstance(t, (T.BigintType, T.IntegerType, T.DateType))
+
+
+def annotate_dense(plan: N.PlanNode, engine) -> N.PlanNode:
+    """Attach dense_key hints to Join/SemiJoin nodes (bottom-up)."""
+
+    def visit(node: N.PlanNode) -> N.PlanNode:
+        updates = {}
+        for f in dataclasses.fields(node):
+            v = getattr(node, f.name)
+            if isinstance(v, N.PlanNode):
+                nv = visit(v)
+                if nv is not v:
+                    updates[f.name] = nv
+            elif isinstance(v, list) and v \
+                    and isinstance(v[0], N.PlanNode):
+                nv = [visit(x) for x in v]
+                if any(a is not b for a, b in zip(nv, v)):
+                    updates[f.name] = nv
+        if updates:
+            node = dataclasses.replace(node, **updates)
+
+        if isinstance(node, N.Join) and node.criteria \
+                and node.join_type != N.JoinType.FULL \
+                and node.build_unique and node.dense_key is None:
+            ranges = symbol_ranges(node.right, engine)
+            types = node.right.output_types()
+            uniques = None
+            for i, (_lk, rk) in enumerate(node.criteria):
+                if rk not in ranges or not _int_typed(types, rk):
+                    continue
+                if not _eligible_span(ranges[rk], node.build_rows):
+                    continue
+                if len(node.criteria) > 1:
+                    if uniques is None:
+                        uniques = unique_key_sets(node.right, engine)
+                    if frozenset([rk]) not in uniques:
+                        continue
+                lo, hi = ranges[rk]
+                node = dataclasses.replace(
+                    node, dense_key=(i, lo, hi))
+                break
+        elif isinstance(node, N.SemiJoin) \
+                and len(node.filter_keys) == 1 \
+                and node.dense_key is None:
+            # membership bitmap: uniqueness not required
+            ranges = symbol_ranges(node.filter_source, engine)
+            types = node.filter_source.output_types()
+            rk = node.filter_keys[0]
+            if rk in ranges and _int_typed(types, rk) \
+                    and _eligible_span(ranges[rk], None):
+                lo, hi = ranges[rk]
+                node = dataclasses.replace(node, dense_key=(lo, hi))
+        return node
+
+    return visit(plan)

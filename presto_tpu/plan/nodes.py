@@ -173,6 +173,10 @@ class Join(PlanNode):
     capacity: int | None = None
     # static output-row capacity for the expanding (many-to-many) path
     output_capacity: int | None = None
+    # dense-int build key hint (criterion index, lo, hi) from
+    # plan/dense.py: build rows scatter into a (hi-lo+1)-slot
+    # direct-address table; probes become one gather (no sort, no hash)
+    dense_key: tuple[int, int, int] | None = None
 
     def sources(self):
         return [self.left, self.right]
@@ -203,6 +207,9 @@ class SemiJoin(PlanNode):
     # first key only (later keys are correlation equalities)
     null_aware: bool = False
     capacity: int | None = None
+    # dense-int filter key hint (lo, hi) from plan/dense.py: the filter
+    # side becomes a membership bitmap, the probe one gather
+    dense_key: tuple[int, int] | None = None
 
     # single-key compatibility accessors
     @property

@@ -22,10 +22,13 @@ from presto_tpu.plan import nodes as N
 
 
 def optimize(plan: N.PlanNode, engine) -> N.PlanNode:
+    from presto_tpu.plan.dense import annotate_dense
     from presto_tpu.plan.rules import apply_rules
     plan = apply_rules(plan)
     plan = prune_columns(plan)
     plan = inline_trivial_projects(plan)
+    # physical-choice annotation runs last, over final plan shapes
+    plan = annotate_dense(plan, engine)
     return plan
 
 

@@ -153,6 +153,57 @@ def plan_literal_number(text: str) -> ir.Literal:
     return ir.Literal(T.BIGINT, int(text))
 
 
+def _ts_micros(s: str) -> int:
+    """Epoch micros of a 'YYYY-MM-DD[ HH:MM:SS[.ffffff]]' literal."""
+    s = s.strip().replace(" ", "T")
+    d64 = np.datetime64(s, "us")
+    return int((d64 - np.datetime64("1970-01-01", "us")).astype(np.int64))
+
+
+def _time_micros(s: str) -> int:
+    """Micros since midnight of a 'HH:MM:SS[.ffffff]' literal."""
+    parts = s.strip().split(":")
+    h, m = int(parts[0]), int(parts[1]) if len(parts) > 1 else 0
+    sec = float(parts[2]) if len(parts) > 2 else 0.0
+    return ((h * 60 + m) * 60) * T.US_PER_SECOND + round(
+        sec * T.US_PER_SECOND)
+
+
+# micros per day-time interval unit
+_INTERVAL_US = {
+    "second": T.US_PER_SECOND, "minute": T.US_PER_MINUTE,
+    "hour": T.US_PER_HOUR, "day": T.US_PER_DAY,
+    "week": 7 * T.US_PER_DAY,
+}
+
+
+def _interval_value(e: A.IntervalLiteral) -> tuple[T.DataType, int]:
+    """(type, value) of an interval literal: months for year-month,
+    micros for day-second. 'D HH:MM:SS' day-to-second strings
+    supported."""
+    sign = -1 if e.negative else 1
+    if e.unit in ("year", "month"):
+        v = int(e.value)
+        return (T.INTERVAL_YEAR_MONTH,
+                sign * (12 * v if e.unit == "year" else v))
+    if e.unit in _INTERVAL_US:
+        text = str(e.value).strip()
+        if text.startswith("-"):
+            sign, text = -sign, text[1:].strip()
+        if " " in text or ":" in text:
+            # 'D HH:MM:SS' day-to-second body: one sign for the WHOLE
+            # magnitude (SQL interval semantics — the day and time
+            # parts never carry opposite signs)
+            days, _, rest = text.partition(" ")
+            us = int(days or 0) * T.US_PER_DAY
+            if rest:
+                us += _time_micros(rest)
+            return T.INTERVAL_DAY_TIME, sign * us
+        return (T.INTERVAL_DAY_TIME,
+                sign * round(float(text) * _INTERVAL_US[e.unit]))
+    raise SemanticError(f"unsupported interval unit {e.unit}")
+
+
 def _interval_months_days(e: A.IntervalLiteral) -> tuple[int, int]:
     v = int(e.value)
     if e.negative:
@@ -204,6 +255,7 @@ def parse_type_name(name: str) -> T.DataType:
         "smallint": T.INTEGER, "tinyint": T.INTEGER,
         "double": T.DOUBLE, "real": T.DOUBLE, "float": T.DOUBLE,
         "boolean": T.BOOLEAN, "date": T.DATE,
+        "timestamp": T.TIMESTAMP, "time": T.TIME,
         "varchar": T.VARCHAR, "char": T.VARCHAR,
         "decimal": T.DecimalType(18, 0),
     }[name]
@@ -216,6 +268,8 @@ def _decimal_scale(t: T.DataType) -> int:
 def arith_result_type(op: str, a: T.DataType, b: T.DataType) -> T.DataType:
     if op == "||":
         return T.VARCHAR
+    if isinstance(a, T.TimestampType) or isinstance(b, T.TimestampType):
+        return T.TIMESTAMP
     if isinstance(a, T.DateType) or isinstance(b, T.DateType):
         return T.DATE
     if isinstance(a, T.DoubleType) or isinstance(b, T.DoubleType):
@@ -282,9 +336,14 @@ class ExprPlanner:
         if e.type_name == "decimal":
             return plan_literal_number(e.value)
         if e.type_name == "timestamp":
-            # timestamps truncated to date granularity in v1
-            return ir.Literal(T.DATE, _days(e.value[:10]))
+            return ir.Literal(T.TIMESTAMP, _ts_micros(e.value))
+        if e.type_name == "time":
+            return ir.Literal(T.TIME, _time_micros(e.value))
         raise SemanticError(f"unsupported literal type {e.type_name}")
+
+    def _p_intervalliteral(self, e: A.IntervalLiteral) -> ir.Expr:
+        dtype, v = _interval_value(e)
+        return ir.Literal(dtype, v)
 
     # -- operators
 
@@ -301,19 +360,47 @@ class ExprPlanner:
         if e.op in _COMPARISONS:
             a, b = self.plan(e.left), self.plan(e.right)
             return ir.Call(T.BOOLEAN, _COMPARISONS[e.op], (a, b))
-        # date +- interval
+        # date/timestamp +- interval
         if e.op in ("+", "-"):
             il = isinstance(e.left, A.IntervalLiteral)
             ri = isinstance(e.right, A.IntervalLiteral)
             if il or ri:
                 iv = e.left if il else e.right
                 other = e.right if il else e.left
-                months, days = _interval_months_days(iv)
+                itype, ival = _interval_value(iv)
                 if e.op == "-":
-                    months, days = -months, -days
+                    if il:
+                        raise SemanticError(
+                            "interval - datetime is not defined")
+                    ival = -ival
                 o = self.plan(other)
+                if isinstance(o.dtype, T.TimestampType):
+                    if itype is T.INTERVAL_DAY_TIME:
+                        if isinstance(o, ir.Literal) and o.value is not None:
+                            return ir.Literal(T.TIMESTAMP, o.value + ival)
+                        return ir.Call(
+                            T.TIMESTAMP, "add",
+                            (o, ir.Literal(T.BIGINT, ival)))
+                    return ir.Call(
+                        T.TIMESTAMP, "ts_add_months",
+                        (o, ir.Literal(T.BIGINT, ival)))
                 if not isinstance(o.dtype, T.DateType):
-                    raise SemanticError("interval arithmetic needs a date")
+                    raise SemanticError(
+                        "interval arithmetic needs a date or timestamp")
+                if itype is T.INTERVAL_YEAR_MONTH:
+                    months, days = ival, 0
+                else:
+                    if ival % T.US_PER_DAY:
+                        # sub-day interval promotes the date to timestamp
+                        if isinstance(o, ir.Literal) \
+                                and o.value is not None:
+                            return ir.Literal(
+                                T.TIMESTAMP,
+                                o.value * T.US_PER_DAY + ival)
+                        return ir.Call(T.TIMESTAMP, "add",
+                                       (ir.Cast(T.TIMESTAMP, o),
+                                        ir.Literal(T.BIGINT, ival)))
+                    months, days = 0, ival // T.US_PER_DAY
                 if isinstance(o, ir.Literal):
                     return ir.Literal(
                         T.DATE, _shift_date_days(o.value, months, days))
@@ -383,10 +470,19 @@ class ExprPlanner:
             default.dtype, T.UnknownType) else default
         return ir.CaseWhen(out_t, conds, tuple(results), default)
 
+    _EXTRACT_FIELDS = {
+        "year": "year", "month": "month", "day": "day",
+        "quarter": "quarter", "week": "week",
+        "day_of_week": "day_of_week", "dow": "day_of_week",
+        "day_of_year": "day_of_year", "doy": "day_of_year",
+        "hour": "hour", "minute": "minute", "second": "second",
+    }
+
     def _p_extract(self, e: A.Extract) -> ir.Expr:
-        if e.field not in ("year", "month", "day"):
+        fn = self._EXTRACT_FIELDS.get(e.field)
+        if fn is None:
             raise SemanticError(f"extract({e.field}) unsupported")
-        return ir.Call(T.BIGINT, e.field, (self.plan(e.operand),))
+        return ir.Call(T.BIGINT, fn, (self.plan(e.operand),))
 
     def _p_functioncall(self, e: A.FunctionCall) -> ir.Expr:
         name = e.name
@@ -408,8 +504,34 @@ class ExprPlanner:
         if name in ("substr", "substring"):
             name = "substring"
         args = tuple(self.plan(a) for a in e.args)
-        if name in ("year", "month", "day"):
+        if name in ("year", "month", "day", "hour", "minute", "second",
+                    "millisecond"):
             return ir.Call(T.BIGINT, name, args)
+        if name == "date_trunc":
+            if not (isinstance(args[0], ir.Literal)
+                    and isinstance(args[0].dtype, T.VarcharType)):
+                raise SemanticError("date_trunc unit must be a literal")
+            return ir.Call(args[1].dtype, "date_trunc", args)
+        if name == "date_add":
+            if not (isinstance(args[0], ir.Literal)
+                    and isinstance(args[0].dtype, T.VarcharType)):
+                raise SemanticError("date_add unit must be a literal")
+            return ir.Call(args[2].dtype, "date_add", args)
+        if name == "date_diff":
+            return ir.Call(T.BIGINT, "date_diff", args)
+        if name == "from_unixtime":
+            return ir.Call(T.TIMESTAMP, "from_unixtime", args)
+        if name == "to_unixtime":
+            return ir.Call(T.DOUBLE, "to_unixtime", args)
+        if name == "date_format":
+            return ir.Call(T.VARCHAR, "date_format", args)
+        if name in ("now", "current_timestamp", "localtimestamp"):
+            return ir.Literal(T.TIMESTAMP, _ts_micros(
+                np.datetime_as_string(np.datetime64("now", "us"))))
+        if name == "current_date":
+            return ir.Literal(T.DATE, int(
+                (np.datetime64("now", "D")
+                 - np.datetime64("1970-01-01")).astype(int)))
         if name == "coalesce":
             out_t = args[0].dtype
             for a in args[1:]:

@@ -73,6 +73,16 @@ def _json_value(v, dtype: T.DataType):
         return f"{v:.{dtype.scale}f}"
     if isinstance(dtype, T.DateType):
         return str(v)
+    if isinstance(dtype, T.TimestampType):
+        # Trino wire format: 'YYYY-MM-DD HH:MM:SS.fff'
+        return str(v).replace("T", " ")
+    if isinstance(v, np.timedelta64):
+        us = int(v.astype("timedelta64[us]").astype(np.int64))
+        h, rem = divmod(us, 3_600_000_000)
+        m, rem = divmod(rem, 60_000_000)
+        sec, frac = divmod(rem, 1_000_000)
+        return (f"{h:02d}:{m:02d}:{sec:02d}.{frac:06d}" if frac
+                else f"{h:02d}:{m:02d}:{sec:02d}")
     if isinstance(v, (np.integer,)):
         return int(v)
     if isinstance(v, (np.floating,)):

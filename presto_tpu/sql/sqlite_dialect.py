@@ -234,6 +234,36 @@ def _sort_item(s: A.SortItem) -> str:
     return out
 
 
+def _canon_timestamp_text(v: str) -> str:
+    """'YYYY-MM-DD HH:MM:SS[.ffffff]' canonical text of a timestamp
+    literal body (what sqlite datetime() emits; fraction kept only when
+    nonzero). Must match testing/oracle.normalize_value's rendering of
+    engine datetime64[us] values."""
+    s = str(v).strip().replace("T", " ")
+    date_part, _, time_part = s.partition(" ")
+    if not time_part:
+        time_part = "00:00:00"
+    hms, _, frac = time_part.partition(".")
+    if hms.count(":") == 1:
+        hms += ":00"
+    frac = frac.rstrip("0")
+    out = f"{date_part} {hms}"
+    return f"{out}.{frac}" if frac else out
+
+
+def _is_timestampish(e: A.Expression) -> bool:
+    """Best-effort: does this expression produce a timestamp (so
+    interval arithmetic must keep sqlite's datetime() rendering)?"""
+    if isinstance(e, A.TypedLiteral):
+        return e.type_name == "timestamp"
+    if isinstance(e, A.FunctionCall):
+        return e.name in ("from_unixtime", "now", "current_timestamp",
+                          "localtimestamp")
+    if isinstance(e, A.CastExpression):
+        return e.type_name.lower() == "timestamp"
+    return False
+
+
 _UNIT_SQLITE = {"year": "years", "month": "months", "day": "days",
                 "week": "days"}
 
@@ -253,20 +283,34 @@ def _expr(e: A.Expression) -> str:
     if isinstance(e, A.NullLiteral):
         return "NULL"
     if isinstance(e, A.TypedLiteral):
-        if e.type_name in ("date", "timestamp"):
+        if e.type_name == "date":
             return f"'{e.value[:10]}'"
+        if e.type_name == "timestamp":
+            # canonical 'YYYY-MM-DD HH:MM:SS[.ffffff]' text (sqlite
+            # datetime functions and lexicographic order both work)
+            v = _canon_timestamp_text(e.value)
+            return f"'{v}'"
+        if e.type_name == "time":
+            return f"'{e.value}'"
         return e.value
     if isinstance(e, A.BinaryOp):
-        # date +- interval -> sqlite date() modifier
+        # date/timestamp +- interval -> sqlite date()/datetime() modifier
         for a, b, sign in ((e.left, e.right, ""), (e.right, e.left, "")):
             if isinstance(b, A.IntervalLiteral) and e.op in ("+", "-"):
-                n = int(b.value) * (7 if b.unit == "week" else 1)
-                if b.negative:
-                    n = -n
+                from presto_tpu.plan.planner import _interval_value
+                from presto_tpu import types as _T
+                itype, ival = _interval_value(b)
                 if e.op == "-":
-                    n = -n
-                unit = _UNIT_SQLITE[b.unit]
-                return f"date({_expr(a)}, '{n:+d} {unit}')"
+                    ival = -ival
+                if itype is _T.INTERVAL_YEAR_MONTH:
+                    fn = ("datetime" if _is_timestampish(a) else "date")
+                    return f"{fn}({_expr(a)}, '{ival:+d} months')"
+                if ival % 86_400_000_000 == 0 \
+                        and not _is_timestampish(a):
+                    days = ival // 86_400_000_000
+                    return f"date({_expr(a)}, '{days:+d} days')"
+                secs = ival / 1_000_000
+                return f"datetime({_expr(a)}, '{secs:+g} seconds')"
         return f"({_expr(e.left)} {e.op} {_expr(e.right)})"
     if isinstance(e, A.UnaryOp):
         return f"({e.op}{_expr(e.operand)})"
@@ -305,6 +349,67 @@ def _expr(e: A.Expression) -> str:
         if e.name == "concat" and not e.is_star:
             # sqlite spells string concatenation ||
             return "(" + " || ".join(_expr(a) for a in e.args) + ")"
+        if e.name in ("year", "month", "day", "hour", "minute",
+                      "second", "day_of_year", "doy") and e.args:
+            fmt = {"year": "%Y", "month": "%m", "day": "%d",
+                   "hour": "%H", "minute": "%M", "second": "%S",
+                   "day_of_year": "%j", "doy": "%j"}[e.name]
+            return (f"CAST(strftime('{fmt}', {_expr(e.args[0])}) "
+                    "AS INTEGER)")
+        if e.name == "date_trunc" and len(e.args) == 2 \
+                and isinstance(e.args[0], A.StringLiteral):
+            unit = e.args[0].value.lower()
+            x = _expr(e.args[1])
+            ts = _is_timestampish(e.args[1])
+            if unit in ("year", "month"):
+                out = f"date({x}, 'start of {unit}')"
+            elif unit == "quarter":
+                out = (f"date({x}, 'start of year', '+' || "
+                       f"(((CAST(strftime('%m', {x}) AS INTEGER) - 1) "
+                       f"/ 3) * 3) || ' months')")
+            elif unit == "week":
+                out = f"date({x}, '+1 day', 'weekday 1', '-7 days')"
+            elif unit == "day":
+                out = f"date({x})"
+            elif unit in ("hour", "minute"):
+                fmt = ("%Y-%m-%d %H:00:00" if unit == "hour"
+                       else "%Y-%m-%d %H:%M:00")
+                return f"strftime('{fmt}', {x})"
+            elif unit == "second":
+                return f"strftime('%Y-%m-%d %H:%M:%S', {x})"
+            else:
+                out = f"date({x})"
+            if ts and unit in ("year", "quarter", "month", "week",
+                               "day"):
+                return f"(({out}) || ' 00:00:00')"
+            return out
+        if e.name == "date_add" and len(e.args) == 3 \
+                and isinstance(e.args[0], A.StringLiteral):
+            unit = e.args[0].value.lower().rstrip("s")
+            n, x = _expr(e.args[1]), _expr(e.args[2])
+            fn = ("datetime"
+                  if _is_timestampish(e.args[2])
+                  or unit in ("hour", "minute", "second") else "date")
+            return f"{fn}({x}, ({n}) || ' {unit}s')"
+        if e.name == "date_diff" and len(e.args) == 3 \
+                and isinstance(e.args[0], A.StringLiteral):
+            unit = e.args[0].value.lower().rstrip("s")
+            a, b = _expr(e.args[1]), _expr(e.args[2])
+            if unit in ("year", "quarter", "month"):
+                months = (f"((CAST(strftime('%Y', {b}) AS INTEGER) - "
+                          f"CAST(strftime('%Y', {a}) AS INTEGER)) * 12 "
+                          f"+ CAST(strftime('%m', {b}) AS INTEGER) - "
+                          f"CAST(strftime('%m', {a}) AS INTEGER))")
+                div = {"year": 12, "quarter": 3, "month": 1}[unit]
+                return f"({months} / {div})" if div > 1 else months
+            secs = {"second": 1, "minute": 60, "hour": 3600,
+                    "day": 86400, "week": 604800}[unit]
+            return (f"CAST((strftime('%s', {b}) - strftime('%s', {a}))"
+                    f" / {secs} AS INTEGER)")
+        if e.name == "from_unixtime" and len(e.args) == 1:
+            return f"datetime({_expr(e.args[0])}, 'unixepoch')"
+        if e.name == "to_unixtime" and len(e.args) == 1:
+            return f"CAST(strftime('%s', {_expr(e.args[0])}) AS REAL)"
         args = "*" if e.is_star else ", ".join(_expr(a) for a in e.args)
         name = {"substring": "substr", "arbitrary": "max"}.get(
             e.name, e.name)
@@ -361,7 +466,9 @@ def _expr(e: A.Expression) -> str:
         parts.append("END")
         return "(" + " ".join(parts) + ")"
     if isinstance(e, A.Extract):
-        fmt = {"year": "%Y", "month": "%m", "day": "%d"}[e.field]
+        fmt = {"year": "%Y", "month": "%m", "day": "%d", "hour": "%H",
+               "minute": "%M", "second": "%S", "day_of_year": "%j",
+               "doy": "%j"}[e.field]
         return f"CAST(strftime('{fmt}', {_expr(e.operand)}) AS INTEGER)"
     if isinstance(e, A.Star):
         return f"{e.qualifier}.*" if e.qualifier else "*"

@@ -102,7 +102,20 @@ def normalize_value(v):
     if isinstance(v, (np.floating,)):
         return float(v)
     if isinstance(v, np.datetime64):
-        return str(v)
+        # canonical 'YYYY-MM-DD[ HH:MM:SS[.ffffff]]' text matching what
+        # sqlite datetime()/timestamp literals produce
+        s = str(v).replace("T", " ")
+        if "." in s:
+            s = s.rstrip("0").rstrip(".")
+        return s
+    if isinstance(v, np.timedelta64):  # TIME values (us since midnight)
+        us = int(v.astype("timedelta64[us]").astype(np.int64))
+        h, rem = divmod(us, 3_600_000_000)
+        m, rem = divmod(rem, 60_000_000)
+        sec, frac = divmod(rem, 1_000_000)
+        out = f"{h:02d}:{m:02d}:{sec:02d}"
+        return f"{out}.{frac:06d}".rstrip("0").rstrip(".") if frac \
+            else out
     if isinstance(v, np.str_):
         return str(v)
     if isinstance(v, bool):

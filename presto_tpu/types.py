@@ -94,6 +94,57 @@ class DateType(DataType):
 
 
 @dataclasses.dataclass(frozen=True)
+class TimestampType(DataType):
+    """Microseconds since the 1970-01-01 00:00:00 epoch, int64
+    (reference spi/type/TimestampType: precision 6 short timestamp is
+    an epoch-micros long the same way)."""
+
+    def __init__(self) -> None:
+        super().__init__("timestamp")
+
+    @property
+    def physical_dtype(self) -> np.dtype:
+        return np.dtype(np.int64)
+
+
+@dataclasses.dataclass(frozen=True)
+class TimeType(DataType):
+    """Microseconds since midnight, int64 (reference spi/type/TimeType)."""
+
+    def __init__(self) -> None:
+        super().__init__("time")
+
+    @property
+    def physical_dtype(self) -> np.dtype:
+        return np.dtype(np.int64)
+
+
+@dataclasses.dataclass(frozen=True)
+class IntervalDayTimeType(DataType):
+    """Day-to-second interval as microseconds, int64 (reference
+    client IntervalDayTime millis; micros here to match TimestampType)."""
+
+    def __init__(self) -> None:
+        super().__init__("interval day to second")
+
+    @property
+    def physical_dtype(self) -> np.dtype:
+        return np.dtype(np.int64)
+
+
+@dataclasses.dataclass(frozen=True)
+class IntervalYearMonthType(DataType):
+    """Year-to-month interval as months, int32."""
+
+    def __init__(self) -> None:
+        super().__init__("interval year to month")
+
+    @property
+    def physical_dtype(self) -> np.dtype:
+        return np.dtype(np.int32)
+
+
+@dataclasses.dataclass(frozen=True)
 class DecimalType(DataType):
     """Short decimal: int64 scaled by 10**scale.
 
@@ -191,8 +242,17 @@ INTEGER = IntegerType()
 DOUBLE = DoubleType()
 BOOLEAN = BooleanType()
 DATE = DateType()
+TIMESTAMP = TimestampType()
+TIME = TimeType()
+INTERVAL_DAY_TIME = IntervalDayTimeType()
+INTERVAL_YEAR_MONTH = IntervalYearMonthType()
 VARCHAR = VarcharType()
 UNKNOWN = UnknownType()
+
+US_PER_SECOND = 1_000_000
+US_PER_MINUTE = 60 * US_PER_SECOND
+US_PER_HOUR = 60 * US_PER_MINUTE
+US_PER_DAY = 24 * US_PER_HOUR
 
 
 def is_numeric(t: DataType) -> bool:
@@ -242,6 +302,9 @@ def common_super_type(a: DataType, b: DataType) -> DataType:
         return a
     if is_string(a) and is_string(b):
         return VARCHAR
+    # date widens to timestamp (reference TypeCoercion DATE->TIMESTAMP)
+    if {type(a), type(b)} == {DateType, TimestampType}:
+        return TIMESTAMP
     raise TypeError(f"cannot unify types {a} and {b}")
 
 
@@ -269,7 +332,10 @@ def parse_type(s: str) -> DataType:
                                parse_type(inner[i + 1:]))
         raise ValueError(f"cannot parse type {s!r}")
     simple = {"bigint": BIGINT, "integer": INTEGER, "double": DOUBLE,
-              "boolean": BOOLEAN, "date": DATE, "unknown": UNKNOWN}
+              "boolean": BOOLEAN, "date": DATE, "unknown": UNKNOWN,
+              "timestamp": TIMESTAMP, "time": TIME,
+              "interval day to second": INTERVAL_DAY_TIME,
+              "interval year to month": INTERVAL_YEAR_MONTH}
     if s in simple:
         return simple[s]
     raise ValueError(f"cannot parse type {s!r}")

@@ -1,0 +1,158 @@
+"""First-class TIMESTAMP/TIME/interval coverage (VERDICT r3 item 3).
+
+The reference models timestamps as epoch-micros longs
+(core/trino-spi/src/main/java/io/trino/spi/type/TimestampType.java) with
+the datetime function library in
+core/trino-main/src/main/java/io/trino/operator/scalar/DateTimeFunctions.java.
+"""
+
+import datetime
+
+import numpy as np
+import pytest
+
+from presto_tpu.testing.oracle import assert_query
+
+
+def test_timestamp_literal_roundtrip(engine):
+    # the r3 VERDICT's named failure: time-of-day silently truncated
+    [(v,)] = engine.execute("select timestamp '2020-01-01 10:00:00'")
+    assert v == np.datetime64("2020-01-01T10:00:00", "us")
+    [(v,)] = engine.execute(
+        "select timestamp '2020-01-01 10:00:00.123456'")
+    assert v == np.datetime64("2020-01-01T10:00:00.123456", "us")
+
+
+def test_time_literal(engine):
+    [(v,)] = engine.execute("select time '13:45:30'")
+    assert v == np.timedelta64(
+        ((13 * 60 + 45) * 60 + 30) * 1_000_000, "us")
+
+
+def test_timestamp_compare_and_filter(engine, oracle):
+    assert_query(
+        engine, oracle,
+        "select count(*) from orders "
+        "where o_orderdate < date '1995-01-01'")
+    [(n,)] = engine.execute(
+        "select count(*) from orders where "
+        "cast(o_orderdate as timestamp) < timestamp '1995-01-01 00:00:01'")
+    [(m,)] = engine.execute(
+        "select count(*) from orders where o_orderdate "
+        "<= date '1995-01-01'")
+    assert n == m
+
+
+def test_extract_fields(engine):
+    row = engine.execute(
+        "select extract(year from timestamp '2021-03-04 05:06:07'), "
+        "extract(month from timestamp '2021-03-04 05:06:07'), "
+        "extract(day from timestamp '2021-03-04 05:06:07'), "
+        "extract(hour from timestamp '2021-03-04 05:06:07'), "
+        "extract(minute from timestamp '2021-03-04 05:06:07'), "
+        "extract(second from timestamp '2021-03-04 05:06:07')")[0]
+    assert tuple(int(x) for x in row) == (2021, 3, 4, 5, 6, 7)
+
+
+def test_date_trunc(engine, oracle):
+    [(v,)] = engine.execute(
+        "select date_trunc('hour', timestamp '2020-02-29 13:45:11')")
+    assert v == np.datetime64("2020-02-29T13:00:00", "us")
+    [(v,)] = engine.execute(
+        "select date_trunc('quarter', date '2020-08-19')")
+    assert v == np.datetime64("2020-07-01")
+    [(v,)] = engine.execute(
+        "select date_trunc('week', date '2020-08-19')")  # a Wednesday
+    assert v == np.datetime64("2020-08-17")  # the preceding Monday
+    assert_query(engine, oracle,
+                 "select date_trunc('month', o_orderdate), count(*) "
+                 "from orders group by 1 order by 1")
+
+
+def test_date_add_diff(engine, oracle):
+    [(v,)] = engine.execute(
+        "select date_add('month', 1, date '2020-01-31')")
+    assert v == np.datetime64("2020-02-29")  # day-of-month clamp
+    [(v,)] = engine.execute(
+        "select date_diff('hour', timestamp '2020-01-01 00:30:00', "
+        "timestamp '2020-01-01 05:00:00')")
+    assert int(v) == 4
+    assert_query(engine, oracle,
+                 "select date_add('day', 30, o_orderdate), count(*) "
+                 "from orders group by 1 order by 1 limit 10")
+
+
+def test_interval_arithmetic(engine):
+    [(v,)] = engine.execute(
+        "select timestamp '2020-01-01 23:30:00' + interval '45' minute")
+    assert v == np.datetime64("2020-01-02T00:15:00", "us")
+    [(v,)] = engine.execute(
+        "select timestamp '2020-03-31 12:00:00' - interval '1' month")
+    assert v == np.datetime64("2020-02-29T12:00:00", "us")
+    # date + sub-day interval promotes to timestamp
+    [(v,)] = engine.execute(
+        "select date '2020-01-01' + interval '6' hour")
+    assert v == np.datetime64("2020-01-01T06:00:00", "us")
+
+
+def test_unixtime(engine):
+    [(v,)] = engine.execute("select to_unixtime(from_unixtime(1600000000))")
+    assert float(v) == 1600000000.0
+
+
+def test_cast_matrix(engine):
+    [(v,)] = engine.execute(
+        "select cast(timestamp '2020-05-06 07:08:09' as date)")
+    assert v == np.datetime64("2020-05-06")
+    [(v,)] = engine.execute(
+        "select cast(date '2020-05-06' as timestamp)")
+    assert v == np.datetime64("2020-05-06T00:00:00", "us")
+    [(v,)] = engine.execute(
+        "select cast('2020-05-06 07:08:09' as timestamp)")
+    assert v == np.datetime64("2020-05-06T07:08:09", "us")
+    [(v,)] = engine.execute("select try_cast('nonsense' as timestamp)")
+    assert v is None
+
+
+def test_timestamp_group_and_join_keys(engine):
+    rows = engine.execute(
+        "select t, count(*) from ("
+        " select cast(o_orderdate as timestamp) as t from orders"
+        " where o_orderkey < 100) group by t order by t")
+    assert len(rows) >= 2
+    assert all(isinstance(r[0], np.datetime64) for r in rows)
+
+
+def test_date_format(engine):
+    [(v,)] = engine.execute(
+        "select date_format(date '2020-07-04', '%Y/%m/%d')")
+    assert v == "2020/07/04"
+    [(v,)] = engine.execute(
+        "select date_format(timestamp '2020-07-04 10:00:00', '%b %Y')")
+    assert v == "Jul 2020"
+
+
+def test_timestamp_through_server_and_dbapi():
+    from presto_tpu import Engine
+    from presto_tpu.connectors.tpch import TpchConnector
+    from presto_tpu.dbapi import connect
+    from presto_tpu.server import CoordinatorServer
+
+    e = Engine()
+    e.register_catalog("tpch", TpchConnector(scale=0.01))
+    srv = CoordinatorServer(e).start()
+    try:
+        conn = connect("127.0.0.1", srv.port)
+        cur = conn.cursor()
+        cur.execute("select timestamp '2020-01-01 10:00:00'")
+        [(v,)] = cur.fetchall()
+        assert v == datetime.datetime(2020, 1, 1, 10, 0, 0)
+    finally:
+        srv.stop()
+
+
+def test_timestamp_oracle_values(engine, oracle):
+    assert_query(
+        engine, oracle,
+        "select timestamp '2020-01-01 10:00:00' + interval '2' hour",
+        ordered=False)
