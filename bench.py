@@ -1,20 +1,29 @@
-"""Benchmark entry: TPC-H Q1 throughput on the local accelerator.
+"""Benchmark entry: TPC-H throughput on the local accelerator.
 
 Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, "detail": {...}}
 
-The metric is lineitem rows/sec through the full Q1 kernel
-(scan→filter→project→group-aggregate→sort), steady-state (arrays resident
-on device, compiled once) — the analog of the reference's
-HandTpchQuery1 in-process benchmark
+Headline: TPC-H Q1 lineitem rows/sec at SF10 through the full SQL path
+(scan->filter->project->group-aggregate->sort), steady-state (arrays
+pinned on device, program cached) — BASELINE.md ladder config 3's scale
+on one chip; the analog of the reference's in-process benchmark harness
 (testing/trino-benchmark/.../HandTpchQuery1.java, BenchmarkSuite).
 
 ``vs_baseline`` compares against a single-threaded vectorized NumPy
-implementation of the same query measured on this host — the stand-in for
-BASELINE.json config 1 ("CPU Java-equivalent operators"), since the
+implementation of Q1 at the same SF measured on this host — the stand-in
+for BASELINE.json config 1 ("CPU Java-equivalent operators"), since the
 reference repo publishes no absolute numbers (BASELINE.md).
 
-Env knobs: PRESTO_TPU_BENCH_SF (default 1.0), PRESTO_TPU_BENCH_REPS (5).
+Detail queries (q06 scan/agg, q03 3-way join, q05 six-way join) run in
+the SAME process so lineitem device pins are shared; each reports
+rows/sec at the SF it ran. A time budget guards the driver's wall clock:
+whatever measured before exhaustion is reported, the rest is marked
+skipped.
+
+Env knobs: PRESTO_TPU_BENCH_SF (default 10), PRESTO_TPU_BENCH_REPS (3),
+PRESTO_TPU_BENCH_BUDGET_S (default 600), PRESTO_TPU_TPCH_CACHE (default
+/tmp/presto_tpu_tpch_cache — table datagen cache; generated on first
+run, ~4 min at SF10, fast raw-npy load afterwards).
 """
 
 from __future__ import annotations
@@ -25,6 +34,9 @@ import sys
 import time
 
 import numpy as np
+
+os.environ.setdefault("PRESTO_TPU_TPCH_CACHE",
+                      "/tmp/presto_tpu_tpch_cache")
 
 
 def numpy_q1_baseline(arrays: dict[str, np.ndarray], cutoff: int) -> float:
@@ -66,96 +78,65 @@ def steady_state_sql(engine, sql: str, reps: int) -> float:
     return min(times)
 
 
-def detail_main(name: str) -> None:
-    """Subprocess entry: measure one TPC-H query, print rows/sec."""
+def main() -> None:
+    sf = float(os.environ.get("PRESTO_TPU_BENCH_SF", "10"))
+    reps = int(os.environ.get("PRESTO_TPU_BENCH_REPS", "3"))
+    budget = float(os.environ.get("PRESTO_TPU_BENCH_BUDGET_S", "600"))
+    t_start = time.perf_counter()
+
     from presto_tpu import Engine
     from presto_tpu.connectors.tpch import TpchConnector
     from tests.tpch_queries import QUERIES
 
-    sf = float(os.environ.get("PRESTO_TPU_BENCH_SF", "1.0"))
     engine = Engine()
     engine.register_catalog("tpch", TpchConnector(scale=sf))
-    nrows = engine.catalogs["tpch"].table("lineitem").nrows
-    best = steady_state_sql(engine, QUERIES[name], 3)
-    print(nrows / best)
+    lineitem = engine.catalogs["tpch"].table("lineitem")
+    nrows = lineitem.nrows
 
-
-def main() -> None:
-    one = os.environ.get("PRESTO_TPU_BENCH_ONE")
-    if one:
-        return detail_main(one)
-    sf = float(os.environ.get("PRESTO_TPU_BENCH_SF", "1.0"))
-    reps = int(os.environ.get("PRESTO_TPU_BENCH_REPS", "5"))
-
-    import jax
-
-    from presto_tpu import Engine
-    from presto_tpu.benchmarks import q1_plan
-    from presto_tpu.benchmarks.handq import _days
-    from presto_tpu.connectors.tpch import TpchConnector
-    from presto_tpu.exec.executor import collect_scans, make_traced
-
-    engine = Engine()
-    engine.register_catalog("tpch", TpchConnector(scale=sf))
-    plan = q1_plan()
-    scan_inputs = collect_scans(plan, engine)
-    nrows = scan_inputs[0].nrows
-
-    traced_fn, flat_arrays, _meta = make_traced(scan_inputs, plan, {})
-    device_args = [jax.device_put(a) for a in flat_arrays]
-    compiled = jax.jit(traced_fn)
-    # sync by materializing the live mask on host: block_until_ready
-    # does not reliably block on tunneled accelerator platforms
-    np.asarray(compiled(*device_args)[1])  # compile + warmup
-
-    times = []
-    for _ in range(reps):
-        t0 = time.perf_counter()
-        np.asarray(compiled(*device_args)[1])
-        times.append(time.perf_counter() - t0)
-    best = min(times)
+    # headline: Q1 through the full SQL frontend
+    best = steady_state_sql(engine, QUERIES["q01"], reps)
     rows_per_sec = nrows / best
 
     # single-thread NumPy baseline (config-1 stand-in)
-    li = {sym: np.asarray(a) for sym, a in
-          zip(scan_inputs[0].arrays, flat_arrays)}
-    base_times = [numpy_q1_baseline(li, _days("1998-09-02"))
-                  for _ in range(3)]
-    base_rows_per_sec = nrows / min(base_times)
+    li = {c: np.asarray(lineitem.columns[c].data)
+          for c in ("l_shipdate", "l_returnflag", "l_linestatus",
+                    "l_quantity", "l_extendedprice", "l_discount",
+                    "l_tax")}
+    cutoff = int((np.datetime64("1998-09-02")
+                  - np.datetime64("1970-01-01")).astype(int))
+    base_best = min(numpy_q1_baseline(li, cutoff) for _ in range(3))
+    base_rows_per_sec = nrows / base_best
+    del li
 
-    # join/secondary queries through the full SQL frontend (analog of the
-    # reference's BenchmarkSuite covering HandTpchQuery1/6 plus SQL-driven
-    # TPC-H runs) — reported as detail so join-path regressions are
-    # visible. Each runs in a SUBPROCESS: a device OOM / TPU worker crash
-    # in a detail query must not take down the headline measurement.
-    detail = {}
-    budget = float(os.environ.get("PRESTO_TPU_BENCH_BUDGET_S", "330"))
-    t_detail = time.perf_counter()
-    if os.environ.get("PRESTO_TPU_BENCH_Q1_ONLY") != "1":
-        import subprocess
-        # q05's six-table join exceeds single-chip HBM at SF1 (its
-        # multi-chip home is the v5e-8 config, BASELINE.md ladder 4);
-        # bench it at a bounded SF and record the SF used
-        sf_cap = {"q05": 0.25}
-        for name in ("q06", "q03", "q05"):
-            left = budget - (time.perf_counter() - t_detail)
-            if left <= 0:
-                detail[f"{name}_skipped"] = "bench time budget exhausted"
-                continue
-            q_sf = min(sf, sf_cap.get(name, sf))
-            try:
-                proc = subprocess.run(
-                    [sys.executable, os.path.abspath(__file__)],
-                    env={**os.environ, "PRESTO_TPU_BENCH_ONE": name,
-                         "PRESTO_TPU_BENCH_SF": str(q_sf)},
-                    capture_output=True, text=True, timeout=left,
-                    cwd=os.path.dirname(os.path.abspath(__file__)))
-                out = proc.stdout.strip().splitlines()
-                detail[f"{name}_rows_per_sec"] = round(float(out[-1]))
-                if q_sf != sf:
-                    detail[f"{name}_sf"] = q_sf
-            except Exception as exc:  # never let detail kill the headline
-                detail[f"{name}_error"] = f"{type(exc).__name__}: {exc}"[:200]
+    # detail queries share this process's device pins (q06's columns
+    # are a subset of q01's; q03/q05/q09 add the join columns). Each is
+    # alarm-guarded so one hung query cannot eat the whole budget; a
+    # Python-level failure never kills the headline.
+    import signal
+
+    class _DetailTimeout(Exception):
+        pass
+
+    def _on_alarm(_sig, _frm):
+        raise _DetailTimeout()
+
+    signal.signal(signal.SIGALRM, _on_alarm)
+    detail = {"sf": sf}
+    for name in ("q06", "q03", "q05", "q09"):
+        left = budget - (time.perf_counter() - t_start)
+        if left <= 60:
+            detail[f"{name}_skipped"] = "bench time budget exhausted"
+            continue
+        signal.alarm(int(left))
+        try:
+            q_best = steady_state_sql(engine, QUERIES[name], reps)
+            detail[f"{name}_rows_per_sec"] = round(nrows / q_best)
+        except _DetailTimeout:
+            detail[f"{name}_error"] = "timed out"
+        except Exception as exc:  # never let detail kill the headline
+            detail[f"{name}_error"] = f"{type(exc).__name__}: {exc}"[:200]
+        finally:
+            signal.alarm(0)
 
     print(json.dumps({
         "metric": f"tpch_q1_sf{sf:g}_rows_per_sec",

@@ -57,5 +57,11 @@ class SplitConnector(Connector):
     def unique_keys(self, name: str):
         return self.base.unique_keys(name)
 
+    def column_range_estimates(self, name: str):
+        # value ranges survive row splitting; without this forwarding
+        # the dense-key join annotation (plan/dense.py) silently
+        # disappears on workers
+        return self.base.column_range_estimates(name)
+
     def stats(self, name: str) -> TableStats:
         return TableStats(row_count=self.row_count_estimate(name))

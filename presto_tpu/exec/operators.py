@@ -181,12 +181,19 @@ def _apply_aggregate_sorted(dt: DTable, node: N.Aggregate, capacity: int,
     see ops/segscan.py and SortedGroups.compact). Output contract
     matches the segment-op path: [capacity] rows, ok=False when the
     group count exceeds capacity."""
-    rh = _row_hash(dt, node.group_keys)
+    # FD-reduced identity (plan/dense.py): when a subset of the group
+    # keys determines the rest, only that subset hashes and sorts as
+    # group identity; dependent keys (constant within each group) ride
+    # as plain payloads
+    id_keys = (node.fd_keys if node.fd_keys
+               and set(node.fd_keys) <= set(node.group_keys)
+               else node.group_keys)
+    rh = _row_hash(dt, id_keys)
     is_final = node.step == N.AggStep.FINAL
 
-    # assemble sort payloads: key columns first (they double as
-    # SECONDARY SORT KEYS so group identity is the exact key tuple, not
-    # the 64-bit hash — see SortedGroups), then per-call agg inputs
+    # assemble sort payloads: identity key columns first (they double
+    # as SECONDARY SORT KEYS so group identity is the exact key tuple,
+    # not the 64-bit hash — see SortedGroups), then per-call agg inputs
     payloads: list = []
 
     def _add(arr) -> int:
@@ -194,19 +201,26 @@ def _apply_aggregate_sorted(dt: DTable, node: N.Aggregate, capacity: int,
         return len(payloads) - 1
 
     key_refs = []  # (sym, Val, data_idx, valid_idx)
-    float_keys = []  # float originals ride outside the key section
+    plain_keys = []  # float originals / FD-dependent keys ride outside
     for k in node.group_keys:
         v = dt.cols[k]
+        if k not in id_keys:
+            plain_keys.append((k, v, None if v.valid is None else v.valid))
+            continue
         norm_idx = _add(_group_key_operand(v))
         valid_idx = None if v.valid is None else _add(v.valid)
         if jnp.issubdtype(v.data.dtype, jnp.floating):
             # the normalized operand is a bit view; keep the original
             # float data as a plain payload for output
-            float_keys.append((k, v, valid_idx))
+            plain_keys.append((k, v, valid_idx))
         else:
             key_refs.append((k, v, norm_idx, valid_idx))
     num_key_payloads = len(payloads)
-    for k, v, valid_idx in float_keys:
+    for k, v, valid_ref in plain_keys:
+        if isinstance(valid_ref, int) or valid_ref is None:
+            valid_idx = valid_ref
+        else:
+            valid_idx = _add(valid_ref)
         key_refs.append((k, v, _add(v.data), valid_idx))
 
     call_refs: dict[str, tuple] = {}
@@ -325,8 +339,12 @@ def apply_aggregate(dt: DTable, node: N.Aggregate, capacity: int) -> tuple:
     """Returns (DTable of [capacity] rows, ok flag)."""
     live = dt.live_mask()
     c = _compiler(dt)
+    # FD-reduced keys carry dependent output columns the arithmetic
+    # slot decode can't reproduce: those plans take the sorted path
+    fd_reduced = (node.fd_keys
+                  and set(node.fd_keys) < set(node.group_keys))
     direct = _direct_group_ids(dt, node.group_keys) \
-        if node.group_keys else None
+        if node.group_keys and not fd_reduced else None
 
     if direct is not None:
         slots, capacity, sizes = direct
@@ -1025,6 +1043,19 @@ def apply_window(dt: DTable, node: N.Window) -> DTable:
     peer_start = jax.lax.associative_scan(
         jnp.maximum, jnp.where(same_peer, jnp.int64(-1), idx))
 
+    # partition / peer-group END positions (reverse running min over
+    # boundary markers) — frames and value functions need both ends
+    is_last_of_part = jnp.concatenate(
+        [part_start[1:] != part_start[:-1], jnp.ones((1,), bool)])
+    part_end = jax.lax.associative_scan(
+        jnp.minimum, jnp.where(is_last_of_part, idx, jnp.int64(n)),
+        reverse=True)
+    is_last_of_peer = jnp.concatenate(
+        [peer_start[1:] != peer_start[:-1], jnp.ones((1,), bool)])
+    peer_end = jax.lax.associative_scan(
+        jnp.minimum, jnp.where(is_last_of_peer, idx, jnp.int64(n)),
+        reverse=True)
+
     out = dict(dt.cols)
     c = ExprCompiler({s: Val(v.dtype, v.data[perm],
                              None if v.valid is None else v.valid[perm],
@@ -1033,7 +1064,8 @@ def apply_window(dt: DTable, node: N.Window) -> DTable:
 
     for sym, call in node.functions.items():
         data, valid, dictionary = _window_fn(
-            call, c, idx, part_start, peer_start, same_part, slive, n)
+            call, c, idx, part_start, peer_start, part_end, peer_end,
+            same_part, slive, n)
         # scatter back to original order
         data = data[inv]
         valid = None if valid is None else valid[inv]
@@ -1042,7 +1074,7 @@ def apply_window(dt: DTable, node: N.Window) -> DTable:
 
 
 def _window_fn(call: N.WindowCall, c: ExprCompiler, idx, part_start,
-               peer_start, same_part, slive, n):
+               peer_start, part_end, peer_end, same_part, slive, n):
     fn = call.fn
     if fn == "row_number":
         return (idx - part_start + 1), None, None
@@ -1054,6 +1086,44 @@ def _window_fn(call: N.WindowCall, c: ExprCompiler, idx, part_start,
         peer_ord = jnp.cumsum(new_peer.astype(jnp.int64))
         at_start = peer_ord[jnp.clip(part_start, 0, n - 1)]
         return peer_ord - at_start + 1, None, None
+    if fn == "percent_rank":
+        rank = (peer_start - part_start).astype(jnp.float64)
+        rows = (part_end - part_start).astype(jnp.float64)
+        return jnp.where(rows > 0, rank / jnp.maximum(rows, 1), 0.0), \
+            None, None
+    if fn == "cume_dist":
+        rows = (part_end - part_start + 1).astype(jnp.float64)
+        return (peer_end - part_start + 1).astype(jnp.float64) / rows, \
+            None, None
+    if fn == "ntile":
+        buckets = int(call.args[0].value)
+        pos = idx - part_start
+        rows = part_end - part_start + 1
+        q, r = rows // buckets, rows % buckets
+        # the first r buckets get q+1 rows (SQL ntile split)
+        big_span = (q + 1) * r
+        in_big = pos < big_span
+        bucket = jnp.where(
+            in_big, pos // jnp.maximum(q + 1, 1),
+            r + (pos - big_span) // jnp.maximum(q, 1))
+        return jnp.clip(bucket, 0, buckets - 1) + 1, None, None
+    if fn in ("first_value", "last_value", "nth_value"):
+        v = c.compile(call.args[0])
+        lo, hi = _frame_bounds(call, idx, part_start, part_end,
+                               peer_end)
+        if fn == "first_value":
+            at = lo
+        elif fn == "last_value":
+            at = hi
+        else:
+            k = int(call.args[1].value)
+            at = lo + (k - 1)
+        in_frame = (at >= lo) & (at <= hi) & (hi >= lo)
+        src = jnp.clip(at, 0, n - 1).astype(jnp.int32)
+        data = v.data[src]
+        valid = in_frame if v.valid is None else (in_frame
+                                                  & v.valid[src])
+        return data, valid, v.dictionary
     if fn in ("lag", "lead"):
         v = c.compile(call.args[0])
         offset = 1
@@ -1066,12 +1136,6 @@ def _window_fn(call: N.WindowCall, c: ExprCompiler, idx, part_start,
         data = v.data[src]
         valid = in_part if v.valid is None else (in_part & v.valid[src])
         return data, valid, v.dictionary
-    if fn == "first_value":
-        v = c.compile(call.args[0])
-        src = jnp.clip(part_start, 0, n - 1).astype(jnp.int32)
-        data = v.data[src]
-        valid = None if v.valid is None else v.valid[src]
-        return data, valid, v.dictionary
     if fn in ("sum", "count", "avg", "min", "max"):
         if call.args:
             v = c.compile(call.args[0])
@@ -1081,26 +1145,29 @@ def _window_fn(call: N.WindowCall, c: ExprCompiler, idx, part_start,
             v = None
             w = slive
             vals = jnp.ones((n,), jnp.int64)
-        framed = call.frame != "full_partition"
         restart = ~same_part  # new partition begins (row 0 included)
         if fn == "count":
             vals = jnp.ones((n,), jnp.int64)
         if jnp.issubdtype(vals.dtype, jnp.integer):
             vals = vals.astype(jnp.int64)
-        if call.frame == "rows_unbounded_current":
+
+        if call.rows_frame is not None and (
+                call.rows_frame[0] is not None
+                or call.rows_frame[1] is not None):
+            return _frame_agg(call, fn, v, vals, w, idx, part_start,
+                              part_end, restart, n)
+
+        if call.rows_frame == (None, None) \
+                or call.frame == "full_partition":
+            # ROWS UNBOUNDED..UNBOUNDED == the whole partition
+            frame_at = None
+        elif call.frame == "rows_unbounded_current":
             # ROWS frame: ends exactly at the current row (peers excluded)
             frame_at = jnp.clip(idx, 0, n - 1)
-        elif framed:
+        elif call.frame != "full_partition":
             # RANGE default includes the whole peer group — the running
-            # value is the segmented scan taken at the END of this row's
-            # peer group
-            is_last_of_peer = jnp.concatenate(
-                [peer_start[1:] != peer_start[:-1],
-                 jnp.ones((1,), bool)])
-            peer_end = jax.lax.associative_scan(
-                jnp.minimum,
-                jnp.where(is_last_of_peer, idx, jnp.int64(n)),
-                reverse=True)
+            # value is the segmented scan taken at the END of this
+            # row's peer group
             frame_at = jnp.clip(peer_end, 0, n - 1)
         else:
             frame_at = None
@@ -1110,14 +1177,7 @@ def _window_fn(call: N.WindowCall, c: ExprCompiler, idx, part_start,
             if frame_at is not None:
                 return scanned[frame_at]
             # full partition: value at partition's last row
-            is_last_of_part = jnp.concatenate(
-                [part_start[1:] != part_start[:-1],
-                 jnp.ones((1,), bool)])
-            last = jax.lax.associative_scan(
-                jnp.minimum,
-                jnp.where(is_last_of_part, idx, jnp.int64(n)),
-                reverse=True)
-            return scanned[jnp.clip(last, 0, n - 1)]
+            return scanned[jnp.clip(part_end, 0, n - 1)]
 
         cnt = run_scan(w.astype(jnp.int64), jnp.add)
         if fn == "count":
@@ -1143,6 +1203,120 @@ def _window_fn(call: N.WindowCall, c: ExprCompiler, idx, part_start,
             run = run_scan(jnp.where(w, vals, sentinel), jnp.minimum)
         return run, cnt > 0, (v.dictionary if v is not None else None)
     raise NotImplementedError(f"window function {fn}")
+
+
+def _frame_bounds(call: N.WindowCall, idx, part_start, part_end,
+                  peer_end):
+    """Inclusive sorted-position frame [lo, hi] for value functions and
+    framed aggregates. Default (no explicit frame): RANGE UNBOUNDED
+    PRECEDING..CURRENT ROW = partition start .. peer-group end."""
+    rf = call.rows_frame
+    if rf is not None:
+        p, f = rf
+        lo = part_start if p is None else jnp.maximum(idx - p,
+                                                      part_start)
+        hi = part_end if f is None else jnp.minimum(idx + f, part_end)
+        return lo, hi
+    if call.frame == "full_partition":
+        return part_start, part_end
+    if call.frame == "rows_unbounded_current":
+        return part_start, idx
+    return part_start, peer_end
+
+
+def _frame_agg(call: N.WindowCall, fn: str, v, vals, w, idx,
+               part_start, part_end, restart, n):
+    """Aggregate over a general ROWS frame (reference
+    window/RowsFraming.java). sum/count/avg difference two points of
+    the segmented prefix scan; min/max query a doubling sparse table
+    (log2(width) elementwise passes, queries stay inside [lo, hi] so
+    cross-partition contamination is impossible)."""
+    p, f = call.rows_frame
+    lo = part_start if p is None else jnp.maximum(idx - p, part_start)
+    hi = part_end if f is None else jnp.minimum(idx + f, part_end)
+    empty = hi < lo
+    hi_c = jnp.clip(hi, 0, n - 1).astype(jnp.int32)
+    lo_c = jnp.clip(lo, 0, n - 1).astype(jnp.int32)
+
+    def span_sum(masked):
+        s = _segmented_scan(masked, restart, jnp.add)
+        at_hi = s[hi_c]
+        prev = s[jnp.clip(lo_c - 1, 0, n - 1)]
+        has_prev = lo > part_start
+        return jnp.where(empty, 0, at_hi - jnp.where(has_prev, prev, 0))
+
+    cnt = span_sum(w.astype(jnp.int64))
+    if fn == "count":
+        return cnt, None, None
+    if fn in ("sum", "avg"):
+        total = span_sum(jnp.where(w, vals, jnp.zeros((), vals.dtype)))
+        if fn == "avg":
+            sf = total.astype(jnp.float64)
+            if v is not None and isinstance(v.dtype, T.DecimalType):
+                sf = sf / v.dtype.unscale_factor
+            return sf / jnp.maximum(cnt, 1), cnt > 0, None
+        return total, cnt > 0, None
+
+    # min/max: sparse table over masked values
+    is_max = fn == "max"
+    if jnp.issubdtype(vals.dtype, jnp.integer):
+        ident = jnp.asarray(jnp.iinfo(vals.dtype).min if is_max
+                            else jnp.iinfo(vals.dtype).max, vals.dtype)
+    else:
+        ident = jnp.asarray(-jnp.inf if is_max else jnp.inf,
+                            vals.dtype)
+    op = jnp.maximum if is_max else jnp.minimum
+    masked = jnp.where(w, vals, ident)
+    if p is None or f is None:
+        # one-sided unbounded: running scan (possibly reversed) taken
+        # at the bounded end
+        if p is None:
+            s = _segmented_scan(masked, restart, op)
+            run = s[hi_c]
+        else:
+            rrestart = jnp.concatenate(
+                [restart[1:], jnp.ones((1,), bool)])
+            s = _rsegmented_scan(masked, rrestart, op)
+            run = s[lo_c]
+        return jnp.where(empty, ident, run), cnt > 0, \
+            (v.dictionary if v is not None else None)
+    # bounded frame: one static shift + select per offset (width total
+    # elementwise passes, no gathers; frames in practice are narrow —
+    # moving averages of a few rows)
+    width = int(p) + int(f) + 1
+    if width > 1024:
+        raise NotImplementedError(
+            f"ROWS frame of width {width} (bounded min/max frames "
+            "support width <= 1024)")
+    res = jnp.full((n,), ident, masked.dtype)
+    for d in range(-int(p), int(f) + 1):
+        if d < 0:
+            shifted = jnp.concatenate(
+                [jnp.full((-d,), ident, masked.dtype), masked[:d]])
+        elif d > 0:
+            shifted = jnp.concatenate(
+                [masked[d:], jnp.full((d,), ident, masked.dtype)])
+        else:
+            shifted = masked
+        pos = idx + d
+        inside = (pos >= lo) & (pos <= hi)
+        res = op(res, jnp.where(inside, shifted, ident))
+    return jnp.where(empty, ident, res), cnt > 0, \
+        (v.dictionary if v is not None else None)
+
+
+def _rsegmented_scan(vals, restart_rev, op):
+    """Reverse segmented inclusive scan (restart flags mark segment
+    ENDS)."""
+
+    def combine(a, b):
+        av, af = a
+        bv, bf = b
+        return jnp.where(bf, bv, op(av, bv)), af | bf
+
+    out, _ = jax.lax.associative_scan(combine, (vals, restart_rev),
+                                      reverse=True)
+    return out
 
 
 def _segmented_scan(vals, restart, op):

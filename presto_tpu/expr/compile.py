@@ -704,6 +704,13 @@ def _us_of(v: Val):
     return v.data
 
 
+def _tod_us(v: Val):
+    """Micros since midnight of a TIME/DATE/TIMESTAMP Val."""
+    if isinstance(v.dtype, T.TimeType):
+        return v.data
+    return _us_of(v) - _days_of(v) * T.US_PER_DAY
+
+
 def _days_from_civil(y, m, d):
     """Inverse of _civil_from_days (Hinnant's days_from_civil)."""
     y = y - (m <= 2)
@@ -761,32 +768,28 @@ def _day(e, args):
 @scalar("hour")
 def _hour(e, args):
     (a,) = args
-    us = a.data if isinstance(a.dtype, T.TimeType) else (
-        _us_of(a) - _days_of(a) * T.US_PER_DAY)
+    us = _tod_us(a)
     return Val(e.dtype, us // T.US_PER_HOUR, a.valid)
 
 
 @scalar("minute")
 def _minute(e, args):
     (a,) = args
-    us = a.data if isinstance(a.dtype, T.TimeType) else (
-        _us_of(a) - _days_of(a) * T.US_PER_DAY)
+    us = _tod_us(a)
     return Val(e.dtype, (us // T.US_PER_MINUTE) % 60, a.valid)
 
 
 @scalar("second")
 def _second(e, args):
     (a,) = args
-    us = a.data if isinstance(a.dtype, T.TimeType) else (
-        _us_of(a) - _days_of(a) * T.US_PER_DAY)
+    us = _tod_us(a)
     return Val(e.dtype, (us // T.US_PER_SECOND) % 60, a.valid)
 
 
 @scalar("millisecond")
 def _millisecond(e, args):
     (a,) = args
-    us = a.data if isinstance(a.dtype, T.TimeType) else (
-        _us_of(a) - _days_of(a) * T.US_PER_DAY)
+    us = _tod_us(a)
     return Val(e.dtype, (us // 1000) % 1000, a.valid)
 
 

@@ -124,6 +124,80 @@ def unique_key_sets(node: N.PlanNode, engine) -> list[frozenset]:
     return []
 
 
+def fd_singles(node: N.PlanNode, engine) -> dict[str, set]:
+    """Single-symbol functional dependencies of a plan's output:
+    determinant symbol -> symbols it determines. Sources: unique-build
+    joins with one criterion (the probe key determines every build
+    column) and single-column unique scan keys (a PK determines its
+    table's columns)."""
+    if isinstance(node, N.TableScan):
+        conn = engine.catalogs.get(node.catalog)
+        if conn is None:
+            return {}
+        try:
+            keys = conn.unique_keys(node.table)
+        except (AttributeError, KeyError, NotImplementedError):
+            return {}
+        by_col = {c: s for s, c in node.assignments.items()}
+        out: dict[str, set] = {}
+        for key in keys:
+            if len(key) == 1 and key[0] in by_col:
+                out[by_col[key[0]]] = set(node.assignments) \
+                    - {by_col[key[0]]}
+        return out
+    if isinstance(node, (N.Filter, N.Sort, N.TopN, N.Limit,
+                         N.Exchange, N.MarkDistinct, N.Window)):
+        return fd_singles(node.sources()[0], engine)
+    if isinstance(node, N.Project):
+        from presto_tpu.expr import ir
+        src = fd_singles(node.source, engine)
+        fwd: dict[str, list] = {}
+        for sym, expr in node.assignments.items():
+            if isinstance(expr, ir.ColumnRef):
+                fwd.setdefault(expr.name, []).append(sym)
+        out = {}
+        for det, deps in src.items():
+            for dsym in fwd.get(det, []):
+                out[dsym] = {s for d in deps for s in fwd.get(d, [])}
+        return out
+    if isinstance(node, N.SemiJoin):
+        out = fd_singles(node.source, engine)
+        return out
+    if isinstance(node, N.Join):
+        out = fd_singles(node.left, engine)
+        if node.join_type in (N.JoinType.INNER, N.JoinType.LEFT) \
+                and node.build_unique and len(node.criteria) == 1:
+            lk, rk = node.criteria[0]
+            right_fd = fd_singles(node.right, engine)
+            rsyms = set(node.right.output_symbols)
+            deps = out.setdefault(lk, set())
+            deps |= rsyms
+            # transitively: whatever rk determined, lk now determines
+            deps |= right_fd.get(rk, set())
+        return out
+    return {}
+
+
+def reduce_group_keys(keys: list[str], fds: dict[str, set]) -> list:
+    """Minimal ordered subset of ``keys`` whose FD closure covers all
+    of them (greedy; exact enough for star-schema shapes)."""
+    kept: list[str] = []
+    covered: set = set()
+    for k in keys:
+        if k in covered:
+            continue
+        kept.append(k)
+        # closure expansion from the newly kept key
+        frontier = [k]
+        while frontier:
+            cur = frontier.pop()
+            for dep in fds.get(cur, ()):  # noqa: B023
+                if dep not in covered:
+                    covered.add(dep)
+                    frontier.append(dep)
+    return kept
+
+
 def _eligible_span(rng: tuple, build_rows: int | None) -> bool:
     lo, hi = rng
     span = hi - lo + 1
@@ -178,6 +252,13 @@ def annotate_dense(plan: N.PlanNode, engine) -> N.PlanNode:
                 node = dataclasses.replace(
                     node, dense_key=(i, lo, hi))
                 break
+        elif isinstance(node, N.Aggregate) \
+                and len(node.group_keys) > 1 and node.fd_keys is None:
+            fds = fd_singles(node.source, engine)
+            if fds:
+                reduced = reduce_group_keys(node.group_keys, fds)
+                if len(reduced) < len(node.group_keys):
+                    node = dataclasses.replace(node, fd_keys=reduced)
         elif isinstance(node, N.SemiJoin) \
                 and len(node.filter_keys) == 1 \
                 and node.dense_key is None:

@@ -115,6 +115,13 @@ class Aggregate(PlanNode):
     # planner hash-table capacity hint (None = executor default); the
     # executor doubles + recompiles on kernel-reported overflow
     capacity: int | None = None
+    # functional-dependency-reduced key subset (plan/dense.py): these
+    # keys alone determine every group key (e.g. Q3's l_orderkey
+    # determines o_orderdate/o_shippriority through the unique join),
+    # so group identity hashes/sorts only them — the rest ride as
+    # plain payloads (reference analog: ReplaceRedundantJoinWithSource
+    # -class optimizations; Trino v360 lacks this one)
+    fd_keys: list[str] | None = None
 
     def sources(self):
         return [self.source]
@@ -385,12 +392,21 @@ class WindowCall:
     partition/order; frame semantics follow SQL defaults (RANGE UNBOUNDED
     PRECEDING..CURRENT ROW with ORDER BY, full partition without)."""
 
-    fn: str  # rank|dense_rank|row_number|ntile|lag|lead|first_value|
+    fn: str  # rank|dense_rank|row_number|ntile|percent_rank|cume_dist|
+    #          lag|lead|first_value|last_value|nth_value|
     #          sum|count|avg|min|max
     args: tuple[ir.Expr, ...]
     dtype: T.DataType
-    # frame: None = SQL default; "rows_unbounded_current" supported
+    # frame: None = SQL default; "rows_unbounded_current" kept for the
+    # running-ROWS special case; "full_partition" for no ORDER BY
     frame: Optional[str] = None
+    # general ROWS frame (preceding, following): row offsets relative
+    # to the current row, None = UNBOUNDED on that side. (2, 0) is
+    # ROWS BETWEEN 2 PRECEDING AND CURRENT ROW; (0, 3) CURRENT..3
+    # FOLLOWING; negative following (e.g. BETWEEN 3 PRECEDING AND
+    # 1 PRECEDING -> (3, -1)) allowed (reference
+    # operator/window/RowsFraming.java)
+    rows_frame: Optional[tuple] = None
 
 
 @dataclasses.dataclass

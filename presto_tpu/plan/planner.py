@@ -191,10 +191,12 @@ def _interval_value(e: A.IntervalLiteral) -> tuple[T.DataType, int]:
         if text.startswith("-"):
             sign, text = -sign, text[1:].strip()
         if " " in text or ":" in text:
-            # 'D HH:MM:SS' day-to-second body: one sign for the WHOLE
+            # '[D ]HH:MM:SS' day-to-second body: one sign for the WHOLE
             # magnitude (SQL interval semantics — the day and time
             # parts never carry opposite signs)
             days, _, rest = text.partition(" ")
+            if ":" in days:  # no day part, just a time body
+                days, rest = "0", text
             us = int(days or 0) * T.US_PER_DAY
             if rest:
                 us += _time_micros(rest)
@@ -701,7 +703,9 @@ def find_agg_calls(e: A.Expression | None) -> list[A.FunctionCall]:
 
 
 WINDOW_FNS = {"rank", "dense_rank", "row_number", "lag", "lead",
-              "first_value", "sum", "count", "avg", "min", "max"}
+              "first_value", "last_value", "nth_value", "ntile",
+              "percent_rank", "cume_dist",
+              "sum", "count", "avg", "min", "max"}
 
 
 def find_window_calls(e: A.Expression | None) -> list[A.FunctionCall]:
@@ -2013,6 +2017,51 @@ class LogicalPlanner:
             prod = min(prod * max(nd, 1), 1 << 40)
         return _next_pow2(max(2 * min(prod, est_rows, 1 << 21), 16))
 
+    def _plan_frame(self, frame_ast: "A.WindowFrame"):
+        """(frame tag, rows_frame) of an explicit frame clause.
+        General ROWS frames become (preceding, following) offsets
+        (reference window/RowsFraming.java); RANGE supports only the
+        SQL-default UNBOUNDED PRECEDING..CURRENT ROW shape (value-based
+        RANGE offsets — window/RangeFraming.java — not yet)."""
+        def bound_offset(btype, bvalue, is_start):
+            if btype == "unbounded_preceding":
+                return None if is_start else 0  # degenerate, clamped
+            if btype == "unbounded_following":
+                return None
+            if btype == "current":
+                return 0
+            if bvalue is None or not isinstance(
+                    bvalue, A.NumericLiteral):
+                raise SemanticError(
+                    "frame offsets must be numeric literals")
+            k = int(bvalue.text)
+            return k if btype == "preceding" else -k
+
+        start_t, end_t = frame_ast.start_type, frame_ast.end_type
+        if frame_ast.unit == "range":
+            if start_t == "unbounded_preceding" \
+                    and end_t in ("current", None):
+                return None, None  # the SQL default running frame
+            raise SemanticError(
+                "RANGE frames support only UNBOUNDED PRECEDING.."
+                "CURRENT ROW")
+        if frame_ast.unit != "rows":
+            raise SemanticError(f"{frame_ast.unit} frames unsupported")
+        if start_t == "unbounded_preceding" and end_t in ("current",
+                                                          None):
+            return "rows_unbounded_current", None
+        # rows_frame is (preceding, following): the frame covers sorted
+        # positions [idx - preceding, idx + following], so a start
+        # bound negates "following" and an end bound negates "preceding"
+        p = bound_offset(start_t, frame_ast.start_value, True)
+        if end_t is None:
+            f = 0  # 'ROWS k PRECEDING' means k PRECEDING..CURRENT
+        else:
+            f = bound_offset(end_t, frame_ast.end_value, False)
+            if f is not None:
+                f = -f
+        return None, (p, f)
+
     def _plan_windows(self, qs: QState,
                       calls: list[A.FunctionCall], ctx: ExprCtx,
                       ctes, group_map: dict[ir.Expr, str]) -> None:
@@ -2038,23 +2087,14 @@ class LogicalPlanner:
                 orderings.append(N.Ordering(sym, item.ascending,
                                             item.nulls_first))
             frame = None
+            rows_frame = None
             if not w.order_by:
                 if frame_ast is not None:
                     raise SemanticError(
                         "window frame requires ORDER BY")
                 frame = "full_partition"
             elif frame_ast is not None:
-                supported = (frame_ast.start_type == "unbounded_preceding"
-                             and frame_ast.end_type in ("current", None)
-                             and frame_ast.unit in ("rows", "range"))
-                if not supported:
-                    raise SemanticError(
-                        "only [ROWS|RANGE] UNBOUNDED PRECEDING..CURRENT "
-                        "ROW window frames are supported")
-                if frame_ast.unit == "rows":
-                    # ROWS excludes later peers; RANGE (the default)
-                    # includes the whole peer group
-                    frame = "rows_unbounded_current"
+                frame, rows_frame = self._plan_frame(frame_ast)
             functions: dict[str, N.WindowCall] = {}
             for call in group:
                 fn = call.name
@@ -2070,16 +2110,24 @@ class LogicalPlanner:
                         and not isinstance(args[1], ir.Literal):
                     raise SemanticError(
                         f"{fn} offset must be a literal")
-                if fn in ("rank", "dense_rank", "row_number", "count"):
+                if fn in ("rank", "dense_rank", "row_number", "count",
+                          "ntile"):
                     dtype: T.DataType = T.BIGINT
                 elif fn == "sum":
                     dtype = AGG.output_type("sum", args[0].dtype)
-                elif fn == "avg":
+                elif fn in ("avg", "percent_rank", "cume_dist"):
                     dtype = T.DOUBLE
                 else:
                     dtype = args[0].dtype
+                if fn in ("ntile", "nth_value"):
+                    pos = 0 if fn == "ntile" else 1
+                    if len(args) <= pos or not isinstance(
+                            args[pos], ir.Literal):
+                        raise SemanticError(
+                            f"{fn} bucket/offset must be a literal")
                 sym = self.symbols.fresh(fn)
-                functions[sym] = N.WindowCall(fn, args, dtype, frame)
+                functions[sym] = N.WindowCall(fn, args, dtype, frame,
+                                              rows_frame)
                 ctx.subquery_syms[call] = ir.ColumnRef(dtype, sym)
             qs.node = N.Window(qs.node, part_syms, orderings, functions)
             qs.scope = Scope(qs.scope.fields + [

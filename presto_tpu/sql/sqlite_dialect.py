@@ -389,7 +389,16 @@ def _expr(e: A.Expression) -> str:
             n, x = _expr(e.args[1]), _expr(e.args[2])
             fn = ("datetime"
                   if _is_timestampish(e.args[2])
-                  or unit in ("hour", "minute", "second") else "date")
+                  or unit in ("hour", "minute", "second",
+                              "millisecond") else "date")
+            # sqlite modifiers know only days/months/years/hours/
+            # minutes/seconds: rescale the units it lacks
+            if unit == "week":
+                return f"{fn}({x}, (({n}) * 7) || ' days')"
+            if unit == "quarter":
+                return f"{fn}({x}, (({n}) * 3) || ' months')"
+            if unit == "millisecond":
+                return f"{fn}({x}, (({n}) / 1000.0) || ' seconds')"
             return f"{fn}({x}, ({n}) || ' {unit}s')"
         if e.name == "date_diff" and len(e.args) == 3 \
                 and isinstance(e.args[0], A.StringLiteral):
