@@ -108,6 +108,18 @@ def main() -> None:
     base_rows_per_sec = nrows / base_best
     del li
 
+    headline = {
+        "metric": f"tpch_q1_sf{sf:g}_rows_per_sec",
+        "value": round(rows_per_sec),
+        "unit": "rows/s",
+        "vs_baseline": round(rows_per_sec / base_rows_per_sec, 3),
+    }
+    # emit the headline NOW: if a detail query dies inside the device
+    # runtime (uncatchable), the last stdout line is still a valid
+    # result; on success the final line below (with details) replaces
+    # it as the last line
+    print(json.dumps(headline), flush=True)
+
     # detail queries share this process's device pins (q06's columns
     # are a subset of q01's; q03/q05/q09 add the join columns). Each is
     # alarm-guarded so one hung query cannot eat the whole budget; a
@@ -138,13 +150,7 @@ def main() -> None:
         finally:
             signal.alarm(0)
 
-    print(json.dumps({
-        "metric": f"tpch_q1_sf{sf:g}_rows_per_sec",
-        "value": round(rows_per_sec),
-        "unit": "rows/s",
-        "vs_baseline": round(rows_per_sec / base_rows_per_sec, 3),
-        "detail": detail,
-    }))
+    print(json.dumps({**headline, "detail": detail}))
 
 
 if __name__ == "__main__":

@@ -480,7 +480,10 @@ class TpchConnector(Connector):
                     np.load(os.path.join(path, f"{col}.dict.npy"),
                             allow_pickle=True))
             else:
-                out[col] = np.load(os.path.join(path, f"{col}.npy"))
+                # allow_pickle: raw object string columns (phones,
+                # part names) pickle through np.save
+                out[col] = np.load(os.path.join(path, f"{col}.npy"),
+                                   allow_pickle=True)
         return out
 
     def _disk_store(self, name: str, raw: dict) -> None:

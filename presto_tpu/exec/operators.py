@@ -1228,9 +1228,12 @@ def _frame_agg(call: N.WindowCall, fn: str, v, vals, w, idx,
                part_start, part_end, restart, n):
     """Aggregate over a general ROWS frame (reference
     window/RowsFraming.java). sum/count/avg difference two points of
-    the segmented prefix scan; min/max query a doubling sparse table
-    (log2(width) elementwise passes, queries stay inside [lo, hi] so
-    cross-partition contamination is impossible)."""
+    the segmented prefix scan; one-sided-unbounded min/max take a
+    (possibly reversed) running scan; doubly-bounded min/max unroll one
+    static shift+select pass per frame offset — linear in frame width,
+    so the width guard below caps the unrolled graph (a doubling
+    sparse table would cut this to log2(width) passes if wide frames
+    ever matter)."""
     p, f = call.rows_frame
     lo = part_start if p is None else jnp.maximum(idx - p, part_start)
     hi = part_end if f is None else jnp.minimum(idx + f, part_end)

@@ -1119,6 +1119,24 @@ def _coalesce(e, args):
     return out
 
 
+@scalar("row_index")
+def _row_index(e, args):
+    """Synthetic per-row identifier (planner-internal; backs the
+    residual-EXISTS decorrelation when the outer relation has no
+    unique key). Under a mesh axis the shard index lands in the high
+    bits so ids are GLOBALLY unique across shards."""
+    import jax as _jax
+    (a,) = args
+    n = a.data.shape[0]
+    idx = jnp.arange(n, dtype=jnp.int64)
+    try:
+        shard = _jax.lax.axis_index("d").astype(jnp.int64)
+        idx = idx + (shard << jnp.int64(40))
+    except NameError:
+        pass
+    return Val(e.dtype, idx, None)
+
+
 @scalar("abs")
 def _abs(e, args):
     (a,) = args

@@ -184,29 +184,120 @@ class ClusterCoordinator:
 
         plan, _ = self.engine.plan_sql(sql)
         workers = self.live_workers()
+        require = bool(self.engine.session.get("require_distribution"))
+
+        def run_local() -> list[tuple]:
+            self.last_distribution = None
+            from presto_tpu.exec.executor import execute_plan
+            return execute_plan(self.engine, plan).to_pylist()
+
+        def local(reason: str) -> list[tuple]:
+            if require:
+                raise NoWorkersError(
+                    f"require_distribution is set but the query "
+                    f"cannot be distributed: {reason}")
+            return run_local()
+
         if workers:
-            from presto_tpu.parallel.fragmenter import fragment_join_plan
+            from presto_tpu.parallel.fragmenter import (
+                fragment_join_plan, fragment_plan_general)
+            general = fragment_plan_general(
+                plan, mode=str(self.engine.session.get(
+                    "join_distribution_type") or "automatic").lower())
+            if general is not None:
+                try:
+                    return self._execute_general(plan, general, workers)
+                except (NoWorkersError, TaskError):
+                    # node loss mid-stage: buffers are gone, restart
+                    # the whole query locally (the reference fails the
+                    # query outright here, SURVEY §5)
+                    if require:
+                        raise
+                    return run_local()
             fragged = fragment_join_plan(plan)
             if fragged is not None:
                 try:
                     return self._execute_fragmented(plan, fragged,
                                                     workers)
                 except (NoWorkersError, TaskError):
-                    # node loss mid-stage: buffers are gone, restart
-                    # the whole query locally (the reference fails the
-                    # query outright here, SURVEY §5)
-                    self.last_distribution = None
-                    from presto_tpu.exec.executor import execute_plan
-                    return execute_plan(self.engine, plan).to_pylist()
+                    if require:
+                        raise
+                    return run_local()
         found = _find_streamable(plan)
         if found is None or not workers:
             # single-node fallback: run the plan we already built (the
             # monitored() wrapper above owns the lifecycle events)
-            self.last_distribution = None
-            from presto_tpu.exec.executor import execute_plan
-            return execute_plan(self.engine, plan).to_pylist()
+            return local("no workers" if not workers
+                         else "plan shape not distributable")
         agg, _scan = found
         return self._execute_partial_fragments(plan, agg, workers)
+
+    def _run_stage(self, workers: list[RemoteWorker],
+                   payloads: list[dict]) -> list:
+        """One task per worker; any node failure aborts the fragmented
+        attempt (buffers on the dead node are lost)."""
+
+        def run_one(i: int):
+            w = workers[i]
+            if not w.alive:
+                raise NoWorkersError(f"worker {w.uri} died")
+            try:
+                out = w.post_task_any(payloads[i])
+                w.record(False)
+                return out
+            except TaskError:
+                raise
+            except Exception as e:  # noqa: BLE001 - node failure
+                w.record(True)
+                w.record(True)
+                raise NoWorkersError(str(e)) from e
+
+        with ThreadPoolExecutor(max_workers=len(workers)) as pool:
+            return list(pool.map(run_one, range(len(workers))))
+
+    def _finish_with_partials(self, plan, agg, boundary,
+                              buffers: list[bytes], meta: dict
+                              ) -> list[tuple]:
+        """Coordinator completion: concatenate worker partial-aggregate
+        buffers, splice a FINAL aggregate over a carrier scan into the
+        original plan, and run the remainder locally."""
+        import dataclasses as DC
+
+        from presto_tpu.exec.executor import ScanInput, run_plan
+        from presto_tpu.exec.streaming import _replace_node
+        from presto_tpu.parallel.wire import (bytes_to_columns,
+                                              concat_columns)
+        from presto_tpu.plan import nodes as N
+
+        parts = [bytes_to_columns(b) for b in buffers]
+        cols = concat_columns([p[0] for p in parts])
+        total = sum(p[1] for p in parts)
+        if agg is not None:
+            ctypes = DC.replace(agg,
+                                step=N.AggStep.PARTIAL).output_types()
+        else:
+            ctypes = boundary.output_types()
+        carrier = N.TableScan("__cluster__", "__partials__",
+                              {s: s for s in ctypes}, dict(ctypes))
+        if agg is not None:
+            new_node: N.PlanNode = DC.replace(
+                agg, source=carrier, step=N.AggStep.FINAL)
+        else:
+            new_node = carrier
+        plan2 = _replace_node(plan, boundary, new_node)
+        arrays: dict = {}
+        dicts: dict = {}
+        for s in ctypes:
+            col = cols[s]
+            arrays[s] = np.asarray(col.data)
+            if col.valid is not None:
+                arrays[f"{s}$valid"] = np.asarray(col.valid)
+            dicts[s] = col.dictionary
+        carrier_input = ScanInput(carrier, arrays, dicts,
+                                  dict(ctypes), total)
+        self.last_distribution = {**meta, "partial_rows": total}
+        return run_plan(self.engine, plan2,
+                        [carrier_input]).to_pylist()
 
     def _execute_partial_fragments(self, plan, agg,
                                    workers) -> list[tuple]:
@@ -253,6 +344,72 @@ class ClusterCoordinator:
                                   "partial_rows": total}
         return run_plan(self.engine, plan2,
                         [carrier_input]).to_pylist()
+    def _execute_general(self, plan, g,
+                         workers: list[RemoteWorker]) -> list[tuple]:
+        """Run a generally-fragmented plan (parallel/fragmenter.py
+        fragment_plan_general): stages dispatch in dependency order,
+        one task per worker; partitioned stages bucket outputs into W
+        buffers, broadcast/gather stages store one buffer; the
+        coordinator pulls the last stage's partial-aggregate buffers
+        and finishes (SqlQueryScheduler.schedule + stage linkage
+        analog, execution/scheduler/SqlQueryScheduler.java:282-452)."""
+        import uuid
+
+        from presto_tpu.plan.serde import fragment_to_dict
+
+        qid = uuid.uuid4().hex[:8]
+        W = len(workers)
+        nparts_of: dict[str, int] = {}
+
+        try:
+            inline: list | None = None
+            for st in g.stages:
+                frag = fragment_to_dict(st.fragment)
+                last = st.name == g.last_stage
+                payloads = []
+                for i in range(W):
+                    sources = {}
+                    for tname, (producer, mode) in st.sources.items():
+                        tid = f"{qid}.{producer}"
+                        if mode == "part":
+                            refs = [{"uri": w.uri, "task_id": tid,
+                                     "part": i} for w in workers]
+                        else:  # "all": broadcast read of every buffer
+                            np_ = nparts_of[producer]
+                            refs = [{"uri": w.uri, "task_id": tid,
+                                     "part": p}
+                                    for w in workers
+                                    for p in range(np_)]
+                        sources[tname] = refs
+                    p: dict = {"fragment": frag,
+                               "task_id": f"{qid}.{st.name}",
+                               "shard": i, "nshards": W}
+                    if sources:
+                        p["sources"] = sources
+                    if st.partition_keys is not None:
+                        p["partition"] = {"nparts": W,
+                                          "keys": st.partition_keys}
+                    elif not last:
+                        p["store"] = True
+                    # the LAST stage returns its partials inline: no
+                    # coordinator pull phase, so a worker death after
+                    # the final stage cannot strand the query
+                    payloads.append(p)
+                nparts_of[st.name] = (W if st.partition_keys is not None
+                                      else 1)
+                outs = self._run_stage(workers, payloads)
+                if last:
+                    inline = outs
+            assert inline is not None
+            return self._finish_with_partials(
+                plan, g.agg, g.boundary, inline,
+                {"nshards": W, "mode": "fragments",
+                 "stages": len(g.stages)})
+        finally:
+            for w in workers:
+                if w.alive:
+                    w.delete_task(qid)
+
     def _execute_fragmented(self, plan, fragged,
                             workers: list[RemoteWorker]) -> list[tuple]:
         """Run a fragmented join plan: scan stages partition legs into
@@ -262,11 +419,6 @@ class ClusterCoordinator:
         import dataclasses as DC
         import uuid
 
-        from presto_tpu import types as T  # noqa: F401
-        from presto_tpu.exec.executor import ScanInput, run_plan
-        from presto_tpu.exec.streaming import _replace_node
-        from presto_tpu.parallel.wire import (bytes_to_columns,
-                                              concat_columns)
         from presto_tpu.plan import nodes as N
         from presto_tpu.plan.serde import fragment_to_dict
 
@@ -278,26 +430,7 @@ class ClusterCoordinator:
                                {s: s for s in types}, dict(types))
 
         def run_stage(payloads: list[dict]) -> list:
-            """One task per worker; any node failure aborts the
-            fragmented attempt (buffers on the dead node are lost)."""
-
-            def run_one(i: int):
-                w = workers[i]
-                if not w.alive:
-                    raise NoWorkersError(f"worker {w.uri} died")
-                try:
-                    out = w.post_task_any(payloads[i])
-                    w.record(False)
-                    return out
-                except TaskError:
-                    raise
-                except Exception as e:  # noqa: BLE001 - node failure
-                    w.record(True)
-                    w.record(True)
-                    raise NoWorkersError(str(e)) from e
-
-            with ThreadPoolExecutor(max_workers=W) as pool:
-                return list(pool.map(run_one, range(W)))
+            return self._run_stage(workers, payloads)
 
         try:
             # -- scan stages: leg fragments partition into buffers -----
@@ -354,41 +487,11 @@ class ClusterCoordinator:
 
             # -- coordinator: final over gathered worker results -------
             assert inline_results is not None
-            parts = [bytes_to_columns(b) for b in inline_results]
-            cols = concat_columns([p[0] for p in parts])
-            total = sum(p[1] for p in parts)
-            boundary = fragged.boundary
-            if fragged.agg is not None:
-                partial = DC.replace(fragged.agg,
-                                     step=N.AggStep.PARTIAL)
-                ctypes = partial.output_types()
-            else:
-                ctypes = boundary.output_types()
-            carrier = N.TableScan("__cluster__", "__partials__",
-                                  {s: s for s in ctypes}, dict(ctypes))
-            if fragged.agg is not None:
-                new_node: N.PlanNode = DC.replace(
-                    fragged.agg, source=carrier, step=N.AggStep.FINAL)
-            else:
-                new_node = carrier
-            plan2 = _replace_node(plan, boundary, new_node)
-            arrays: dict = {}
-            dicts: dict = {}
-            for s in ctypes:
-                col = cols[s]
-                arrays[s] = np.asarray(col.data)
-                if col.valid is not None:
-                    arrays[f"{s}$valid"] = np.asarray(col.valid)
-                dicts[s] = col.dictionary
-            carrier_input = ScanInput(carrier, arrays, dicts,
-                                      dict(ctypes), total)
-            self.last_distribution = {
-                "nshards": W, "mode": "fragments",
-                "stages": len(fragged.scan_stages)
-                + len(fragged.join_stages),
-                "partial_rows": total}
-            return run_plan(self.engine, plan2,
-                            [carrier_input]).to_pylist()
+            return self._finish_with_partials(
+                plan, fragged.agg, fragged.boundary, inline_results,
+                {"nshards": W, "mode": "fragments",
+                 "stages": len(fragged.scan_stages)
+                 + len(fragged.join_stages)})
         finally:
             for w in workers:
                 if w.alive:

@@ -63,6 +63,200 @@ class FragmentedJoinPlan:
     boundary: N.PlanNode
 
 
+# --- general recursive fragmenter ------------------------------------------
+
+
+class NotDistributable(Exception):
+    """Plan shape the multi-host fragmenter cannot stage (caller falls
+    back to local or partial-aggregate execution)."""
+
+
+@dataclasses.dataclass
+class GStage:
+    """One distributed stage: every worker runs ``fragment`` over its
+    base-table split plus pulled exchange inputs, and either
+    hash-partitions its output into W buffers (``partition_keys``) or
+    stores one unpartitioned buffer (None — broadcast/gather reads)."""
+
+    name: str
+    fragment: N.PlanNode
+    # exchange table name used inside ``fragment`` -> (producer stage
+    # name, read mode): "part" pulls this worker's partition from every
+    # producer, "all" pulls every buffer of every producer (broadcast)
+    sources: dict[str, tuple[str, str]]
+    partition_keys: list[str] | None
+
+
+@dataclasses.dataclass
+class GeneralFragmentedPlan:
+    stages: list[GStage]  # dependency order
+    # coordinator-side remainder: FINAL aggregation and everything
+    # above it; reads the last stage's buffers through a carrier scan
+    plan: N.PlanNode
+    boundary: N.PlanNode  # node in ``plan`` the carrier replaces
+    agg: N.Aggregate | None  # top aggregate (FINAL runs on coordinator)
+    last_stage: str
+
+
+# builds at or under this estimated row count broadcast instead of
+# repartitioning both sides (DetermineJoinDistributionType's
+# AUTOMATIC broadcast cutoff analog)
+BROADCAST_ROWS = 1 << 20
+
+
+def fragment_plan_general(plan: N.PlanNode, mode: str = "automatic"
+                          ) -> GeneralFragmentedPlan | None:
+    """Recursively stage an arbitrary join/semijoin/aggregate plan for
+    multi-host execution (reference PlanFragmenter.createSubPlans +
+    AddExchanges over any shape, SqlQueryScheduler stage DAG). The
+    SPINE (probe chain from the fact scan up to the top aggregate)
+    stays row-split or hash-partitioned across workers; every build /
+    filter / scalar side becomes its own stage, broadcast when small,
+    co-partitioned when large. Returns None when the plan shape cannot
+    distribute."""
+    try:
+        return _fragment_general(plan, mode)
+    except NotDistributable:
+        return None
+
+
+def _fragment_general(plan: N.PlanNode,
+                      mode: str = "automatic") -> GeneralFragmentedPlan:
+    # walk the coordinator-side root chain down to the top Aggregate
+    node = plan
+    agg: N.Aggregate | None = None
+    upper: list[N.PlanNode] = []  # between agg (exclusive) and spine
+    while True:
+        if isinstance(node, (N.Join, N.SemiJoin, N.CrossJoin,
+                             N.TableScan)):
+            break
+        if isinstance(node, N.Aggregate):
+            if agg is not None or node.step != N.AggStep.SINGLE:
+                raise NotDistributable()
+            if any(c.distinct for c in node.aggs.values()):
+                raise NotDistributable()
+            agg = node
+            upper = []
+            node = node.source
+            continue
+        if isinstance(node, (N.Output, N.Sort, N.TopN, N.Limit,
+                             N.Distinct)):
+            if agg is not None:
+                raise NotDistributable()
+            node = node.sources()[0]
+            continue
+        if isinstance(node, (N.Project, N.Filter)):
+            if agg is not None:
+                upper.append(node)
+            node = node.source
+            continue
+        raise NotDistributable()
+    if agg is None:
+        raise NotDistributable()  # raw-row gather: partial path covers
+    spine_root = node
+
+    stages: list[GStage] = []
+    counter = [0]
+
+    def fresh(prefix: str) -> str:
+        counter[0] += 1
+        return f"{prefix}{counter[0]}"
+
+    def exchange_scan(name: str, types: dict) -> N.TableScan:
+        return N.TableScan("__exchange__", name,
+                           {s: s for s in types}, dict(types))
+
+    def lower_side(side: N.PlanNode) -> tuple[str, dict]:
+        """Materialize a build/filter/scalar side as its own stage
+        (unpartitioned buffers; consumers read ALL = broadcast). The
+        side may itself contain joins (its nested build sides become
+        further broadcast stages): each worker contributes the rows
+        its base-table split produces, and the union of worker buffers
+        is the full side relation."""
+        srcs: dict[str, tuple[str, str]] = {}
+        frag, _dist = lower(side, srcs, allow_cut=False)
+        name = fresh("side")
+        stages.append(GStage(name, frag, srcs, None))
+        return name, frag.output_types()
+
+    def lower(node: N.PlanNode, sources: dict, allow_cut: bool):
+        """Rewrite ``node`` for the fragment whose exchange inputs
+        accumulate in ``sources``; returns (node', dist) with dist
+        "split" or ("part", keys). Appends stages depth-first."""
+        if isinstance(node, N.TableScan):
+            if node.catalog == "__exchange__":
+                raise NotDistributable()
+            return node, "split"
+        if isinstance(node, (N.Filter, N.Project)):
+            src, dist = lower(node.sources()[0], sources, allow_cut)
+            return dataclasses.replace(node, source=src), dist
+        if isinstance(node, N.CrossJoin):
+            if not node.scalar:
+                raise NotDistributable()
+            left, dist = lower(node.left, sources, allow_cut)
+            sname, stypes = lower_side(node.right)
+            scan = exchange_scan(fresh("x"), stypes)
+            sources[scan.table] = (sname, "all")
+            return dataclasses.replace(node, left=left,
+                                       right=scan), dist
+        if isinstance(node, N.SemiJoin):
+            src, dist = lower(node.source, sources, allow_cut)
+            sname, stypes = lower_side(node.filter_source)
+            scan = exchange_scan(fresh("x"), stypes)
+            sources[scan.table] = (sname, "all")
+            return dataclasses.replace(node, source=src,
+                                       filter_source=scan), dist
+        if isinstance(node, N.Join):
+            if node.join_type == N.JoinType.FULL:
+                raise NotDistributable()
+            left, dist = lower(node.left, sources, allow_cut)
+            if node.distribution == "partitioned" \
+                    or mode == "partitioned":
+                small = False
+            elif node.distribution == "broadcast" \
+                    or mode == "broadcast":
+                small = True
+            else:
+                small = (node.build_rows or 0) <= BROADCAST_ROWS
+            if small or not node.criteria or not allow_cut:
+                sname, stypes = lower_side(node.right)
+                scan = exchange_scan(fresh("x"), stypes)
+                sources[scan.table] = (sname, "all")
+                return dataclasses.replace(node, left=left,
+                                           right=scan), dist
+            # big build: FIXED_HASH — cut both sides into
+            # key-partitioned stages, join co-partitions locally
+            lkeys = [lk for lk, _ in node.criteria]
+            rkeys = [rk for _, rk in node.criteria]
+            pname = fresh("probe")
+            stages.append(GStage(pname, left, dict(sources), lkeys))
+            sources.clear()
+            bsrcs: dict[str, tuple[str, str]] = {}
+            bfrag, _bd = lower(node.right, bsrcs, allow_cut=False)
+            bname = fresh("build")
+            stages.append(GStage(bname, bfrag, bsrcs, rkeys))
+            pscan = exchange_scan(fresh("x"), left.output_types())
+            bscan = exchange_scan(fresh("x"), bfrag.output_types())
+            sources[pscan.table] = (pname, "part")
+            sources[bscan.table] = (bname, "part")
+            return dataclasses.replace(node, left=pscan,
+                                       right=bscan), ("part", lkeys)
+        raise NotDistributable()
+
+    final_sources: dict[str, tuple[str, str]] = {}
+    spine, _dist = lower(spine_root, final_sources, True)
+
+    # last worker stage: spine + upper chain + PARTIAL aggregate
+    root: N.PlanNode = spine
+    for up in reversed(upper):
+        root = dataclasses.replace(up, source=root)
+    partial = dataclasses.replace(agg, source=root,
+                                  step=N.AggStep.PARTIAL)
+    last = fresh("agg")
+    stages.append(GStage(last, partial, final_sources, None))
+    return GeneralFragmentedPlan(stages, plan, agg, agg, last)
+
+
 def _is_leg(node: N.PlanNode) -> bool:
     """A leg must be scan/filter/project over exactly one TableScan."""
     if isinstance(node, N.TableScan):

@@ -160,6 +160,11 @@ def execute_fragment_task(engine, req: dict, store: dict,
 
     part = req.get("partition")
     if part is None:
+        if req.get("store"):
+            # unpartitioned buffered output (broadcast build sides /
+            # gather stages): one buffer at partition index 0
+            store[req["task_id"]] = [columns_to_bytes(cols)]
+            return {"rows": [int(live.sum())]}
         return columns_to_bytes(cols)
     nparts = int(part["nparts"])
     ids = partition_ids(cols, part["keys"], nparts)

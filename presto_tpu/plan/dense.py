@@ -93,7 +93,9 @@ def unique_key_sets(node: N.PlanNode, engine) -> list[frozenset]:
                 out.append(frozenset(by_col[c] for c in key))
         return out
     if isinstance(node, N.Filter):
-        return unique_key_sets(node.source, engine)
+        from presto_tpu.plan.planner import narrow_unique_by_consts
+        return narrow_unique_by_consts(
+            unique_key_sets(node.source, engine), node.predicate)
     if isinstance(node, N.Project):
         from presto_tpu.expr import ir
         src = unique_key_sets(node.source, engine)
@@ -115,7 +117,13 @@ def unique_key_sets(node: N.PlanNode, engine) -> list[frozenset]:
     if isinstance(node, N.SemiJoin):
         return unique_key_sets(node.source, engine)
     if isinstance(node, N.Aggregate) and node.group_keys:
-        return [frozenset(node.group_keys)]
+        # FD-reduced: group keys determined by kept keys don't widen
+        # the unique set (q11's year_total is unique on (customer_id,
+        # year), not the 8-key grouping list)
+        fds = fd_singles(node.source, engine)
+        keys = (reduce_group_keys(node.group_keys, fds) if fds
+                else node.group_keys)
+        return [frozenset(keys)]
     if isinstance(node, N.Distinct):
         return [frozenset(node.source.output_symbols)]
     if isinstance(node, (N.Sort, N.TopN, N.Limit, N.MarkDistinct,
@@ -164,11 +172,16 @@ def fd_singles(node: N.PlanNode, engine) -> dict[str, set]:
         out = fd_singles(node.source, engine)
         return out
     if isinstance(node, N.Join):
+        # FDs are row-level properties (equal determinant => equal
+        # dependents), so BOTH sides' FDs survive any join — each
+        # output row carries one base row per side
         out = fd_singles(node.left, engine)
+        right_fd = fd_singles(node.right, engine)
+        for det, deps in right_fd.items():
+            out.setdefault(det, set()).update(deps)
         if node.join_type in (N.JoinType.INNER, N.JoinType.LEFT) \
                 and node.build_unique and len(node.criteria) == 1:
             lk, rk = node.criteria[0]
-            right_fd = fd_singles(node.right, engine)
             rsyms = set(node.right.output_symbols)
             deps = out.setdefault(lk, set())
             deps |= rsyms
@@ -232,6 +245,21 @@ def annotate_dense(plan: N.PlanNode, engine) -> N.PlanNode:
         if updates:
             node = dataclasses.replace(node, **updates)
 
+        if isinstance(node, N.Join) and node.criteria \
+                and not node.build_unique \
+                and node.join_type in (N.JoinType.INNER,
+                                       N.JoinType.LEFT):
+            # post-optimization uniqueness upgrade: the planner's
+            # uniqueness inference predates rule rewrites (union branch
+            # pruning, constant-eq narrowing), so structurally-provable
+            # unique builds planned as expanding get flipped to the
+            # probe-preserved path here (q4/q11/q74 year_total
+            # self-joins)
+            bsyms = frozenset(rk for _, rk in node.criteria)
+            if any(u <= bsyms
+                   for u in unique_key_sets(node.right, engine)):
+                node = dataclasses.replace(node, build_unique=True,
+                                           output_capacity=None)
         if isinstance(node, N.Join) and node.criteria \
                 and node.join_type != N.JoinType.FULL \
                 and node.build_unique and node.dense_key is None:

@@ -221,6 +221,34 @@ def _interval_months_days(e: A.IntervalLiteral) -> tuple[int, int]:
     raise SemanticError(f"unsupported interval unit {e.unit}")
 
 
+def _const_eq_symbol(e: ir.Expr) -> str | None:
+    """The column symbol of an eq(column, literal) predicate, else
+    None."""
+    if isinstance(e, ir.Call) and e.fn == "eq" and len(e.args) == 2:
+        a, b = e.args
+        if isinstance(a, ir.ColumnRef) and isinstance(b, ir.Literal):
+            return a.name
+        if isinstance(b, ir.ColumnRef) and isinstance(a, ir.Literal):
+            return b.name
+    return None
+
+
+def narrow_unique_by_consts(uniques: list[frozenset],
+                            predicate: ir.Expr) -> list[frozenset]:
+    """Constant-equality narrows unique keys: a relation unique on
+    {a, b} filtered to b = const is unique on {a}. Shared by the
+    planner's leg-filter pushdown and the post-optimization uniqueness
+    recomputation (plan/dense.py)."""
+    preds = [predicate]
+    if isinstance(predicate, ir.Call) and predicate.fn == "and":
+        preds = list(predicate.args)
+    consts = {s for p in preds
+              if (s := _const_eq_symbol(p)) is not None}
+    if not consts:
+        return uniques
+    return sorted({u - consts for u in uniques}, key=len)
+
+
 def _shift_date_days(days: int, months: int, delta_days: int) -> int:
     d = np.datetime64("1970-01-01") + np.timedelta64(days, "D")
     if months:
@@ -285,7 +313,11 @@ def arith_result_type(op: str, a: T.DataType, b: T.DataType) -> T.DataType:
                 return T.DOUBLE
             return T.DecimalType(18, sa + sb)
         if op == "/":
-            return T.DecimalType(18, max(sa, sb, 2))
+            # quotient scale floors at 6 (the reference's decimal
+            # division scale rule is max(6, ...),
+            # DecimalOperators/OperatorValidator): ratio orderings
+            # (q36's gross_margin rank) need the precision
+            return T.DecimalType(18, max(sa, sb, 6))
     return T.BIGINT
 
 
@@ -650,18 +682,25 @@ def _collect_calls(e: A.Expression | None, pred) -> list[A.FunctionCall]:
             if x not in out:
                 out.append(x)
             return
+        if isinstance(x, A.Query):
+            # a subquery is its own aggregation block: its aggregates
+            # must NOT hoist into the enclosing block
+            return
+        # descend through ANY AST dataclass (window specs and sort
+        # items carry expressions too: q70's rank() orders by a sum()
+        # that must be collected as an aggregate of the block)
         for f in dataclasses.fields(x) if dataclasses.is_dataclass(x) else ():
             v = getattr(x, f.name)
-            if isinstance(v, A.Expression):
-                walk(v)
-            elif isinstance(v, tuple):
-                for item in v:
-                    if isinstance(item, A.Expression):
-                        walk(item)
-                    elif isinstance(item, tuple):
-                        for sub in item:
-                            if isinstance(sub, A.Expression):
-                                walk(sub)
+            items = v if isinstance(v, (tuple, list)) else (v,)
+            for item in items:
+                if dataclasses.is_dataclass(item) \
+                        and not isinstance(item, type):
+                    walk(item)
+                elif isinstance(item, tuple):
+                    for sub in item:
+                        if dataclasses.is_dataclass(sub) \
+                                and not isinstance(sub, type):
+                            walk(sub)
     if e is not None:
         walk(e)
     return out
@@ -1525,10 +1564,15 @@ class LogicalPlanner:
                 li = leg_ids.pop() if leg_ids else 0
                 leg = legs[li]
                 s = _selectivity(planned, self.ndv, self.ranges)
+                # constant-equality narrows unique keys (q11's
+                # year_total legs join on customer_id alone after the
+                # year/sale_type filters — without this the self-joins
+                # plan as expanding with compounding output capacities)
+                uniq = narrow_unique_by_consts(leg.unique, planned)
                 legs[li] = RelationPlan(N.Filter(leg.node, planned),
                                         leg.scope,
                                         max(int(leg.est * s), 1),
-                                        leg.unique, leg.sel * s)
+                                        uniq, leg.sel * s)
                 continue
             if (len(leg_ids) == 2 and isinstance(planned, ir.Call)
                     and planned.fn == "eq"):
@@ -2031,9 +2075,11 @@ class LogicalPlanner:
             if btype == "current":
                 return 0
             if bvalue is None or not isinstance(
-                    bvalue, A.NumericLiteral):
+                    bvalue, A.NumericLiteral) \
+                    or not bvalue.text.isdigit():
                 raise SemanticError(
-                    "frame offsets must be numeric literals")
+                    "frame offsets must be non-negative integer "
+                    "literals")
             k = int(bvalue.text)
             return k if btype == "preceding" else -k
 
@@ -2125,6 +2171,11 @@ class LogicalPlanner:
                             args[pos], ir.Literal):
                         raise SemanticError(
                             f"{fn} bucket/offset must be a literal")
+                    v = args[pos].value
+                    if not isinstance(v, int) or v <= 0:
+                        raise SemanticError(
+                            f"{fn} bucket/offset must be a positive "
+                            "integer")
                 sym = self.symbols.fresh(fn)
                 functions[sym] = N.WindowCall(fn, args, dtype, frame,
                                               rows_frame)
@@ -2164,18 +2215,58 @@ class LogicalPlanner:
             negated = not negated
             inner = inner.operand
         if isinstance(inner, A.InSubquery):
-            self._apply_in_subquery(
-                qs, inner, negated != inner.negated, ctx, ctes)
+            self._filter_pred(qs, self._mark_in_subquery(
+                qs, inner, negated != inner.negated, ctx, ctes))
             return
         if isinstance(inner, A.ExistsPredicate):
             self._apply_exists(qs, inner, negated != inner.negated, ctx,
                                ctes)
             return
+        if isinstance(inner, A.LogicalOp) and inner.op == "or" \
+                and any(find_subquery_nodes(t) for t in inner.terms):
+            # OR over subquery predicates (q10/q35's
+            # `exists(ws) or exists(cs)`): plan each subquery term as a
+            # MARK (semijoin output boolean) and filter on the OR of
+            # the marks — the reference plans every subquery as an
+            # ApplyNode mark for the same reason
+            preds = tuple(self._term_predicate(qs, t, ctx, ctes,
+                                               group_map)
+                          for t in inner.terms)
+            pred: ir.Expr = ir.Call(T.BOOLEAN, "or", preds)
+            if negated:
+                pred = ir.Call(T.BOOLEAN, "not", (pred,))
+            qs.node = N.Filter(qs.node, pred)
+            return
         planned = self._plan_scalar_expr(qs, c, ctx, ctes, group_map)
         qs.node = N.Filter(qs.node, planned)
 
-    def _apply_in_subquery(self, qs: QState, e: A.InSubquery,
-                           negated: bool, ctx: ExprCtx, ctes) -> None:
+    def _filter_pred(self, qs: QState, pred: ir.Expr) -> None:
+        qs.node = N.Filter(qs.node, pred)
+
+    def _term_predicate(self, qs: QState, t: A.Expression, ctx, ctes,
+                        group_map) -> ir.Expr:
+        """One OR-term as a boolean IR predicate, planning embedded
+        IN/EXISTS subqueries as marks on ``qs``."""
+        negated = False
+        inner = t
+        while isinstance(inner, A.NotOp):
+            negated = not negated
+            inner = inner.operand
+        if isinstance(inner, A.InSubquery):
+            return self._mark_in_subquery(
+                qs, inner, negated != inner.negated, ctx, ctes)
+        if isinstance(inner, A.ExistsPredicate):
+            pred = self._mark_exists(
+                qs, inner, negated != inner.negated, ctx, ctes)
+            if pred is None:
+                raise SemanticError(
+                    "EXISTS with non-equality correlation is not "
+                    "supported inside OR")
+            return pred
+        return self._plan_scalar_expr(qs, t, ctx, ctes, group_map)
+
+    def _mark_in_subquery(self, qs: QState, e: A.InSubquery,
+                          negated: bool, ctx: ExprCtx, ctes) -> ir.Expr:
         operand_ir = self._plan_scalar_expr(qs, e.operand, ctx, ctes, {})
         operand_sym = qs.add_projection(operand_ir, "in_key", self)
         sub = self.plan_query(e.query, ctes, qs.scope)
@@ -2195,7 +2286,20 @@ class LogicalPlanner:
         pred: ir.Expr = ir.ColumnRef(T.BOOLEAN, mark)
         if negated:
             pred = ir.Call(T.BOOLEAN, "not", (pred,))
-        qs.node = N.Filter(qs.node, pred)
+        return pred
+
+    def _mark_exists(self, qs: QState, e: A.ExistsPredicate,
+                     negated: bool, ctx: ExprCtx, ctes
+                     ) -> ir.Expr | None:
+        """EXISTS as a boolean mark predicate, or None when only the
+        residual (expanding-join) path can plan it."""
+        body = e.query.body
+        if not isinstance(body, A.QuerySpec):
+            raise SemanticError("EXISTS body must be a SELECT")
+        sub_qs = self._plan_from_where(body, ctes, qs.scope, True)
+        if sub_qs.residual_corr:
+            return None
+        return self._mark_exists_planned(qs, sub_qs, negated)
 
     def _apply_exists(self, qs: QState, e: A.ExistsPredicate,
                       negated: bool, ctx: ExprCtx, ctes) -> None:
@@ -2203,12 +2307,16 @@ class LogicalPlanner:
         if not isinstance(body, A.QuerySpec):
             raise SemanticError("EXISTS body must be a SELECT")
         sub_qs = self._plan_from_where(body, ctes, qs.scope, True)
-        corr = sub_qs.corr_pairs
         if sub_qs.residual_corr:
             self._apply_exists_residual(qs, sub_qs, negated)
             return
+        pred = self._mark_exists_planned(qs, sub_qs, negated)
+        qs.node = N.Filter(qs.node, pred)
+
+    def _mark_exists_planned(self, qs: QState, sub_qs: QState,
+                             negated: bool) -> ir.Expr:
+        corr = sub_qs.corr_pairs
         if not corr:
-            # uncorrelated EXISTS: scalar count(*) > 0
             cnt = self.symbols.fresh("count")
             agg = N.Aggregate(sub_qs.node, [], {
                 cnt: AggCall("count_star", None, T.BIGINT)},
@@ -2219,8 +2327,7 @@ class LogicalPlanner:
                                   ir.Literal(T.BIGINT, 0)))
             if negated:
                 pred = ir.Call(T.BOOLEAN, "not", (pred,))
-            qs.node = N.Filter(qs.node, pred)
-            return
+            return pred
         types = sub_qs.node.output_types()
         inner_syms = [i for (_o, i, _t) in corr]
         proj = N.Project(sub_qs.node, {
@@ -2232,7 +2339,7 @@ class LogicalPlanner:
         pred = ir.ColumnRef(T.BOOLEAN, mark)
         if negated:
             pred = ir.Call(T.BOOLEAN, "not", (pred,))
-        qs.node = N.Filter(qs.node, pred)
+        return pred
 
     def _apply_exists_residual(self, qs: QState, sub_qs: QState,
                                negated: bool) -> None:
@@ -2250,9 +2357,21 @@ class LogicalPlanner:
                 key = sorted(k)
                 break
         if key is None:
-            raise SemanticError(
-                "correlated EXISTS with non-equality predicate needs a "
-                "unique key on the outer relation")
+            # no declared unique key: synthesize a row index (the
+            # reference's TransformCorrelated* rules lean on row-id
+            # semantics of the ApplyNode the same way). q16/q94 probe
+            # catalog/web_sales, whose order_number alone is not unique.
+            rid = self.symbols.fresh("rowid")
+            types0 = qs.node.output_types()
+            any_sym = next(iter(types0))
+            assigns = {s: ir.ColumnRef(t, s)
+                       for s, t in types0.items()}
+            assigns[rid] = ir.Call(
+                T.BIGINT, "row_index",
+                (ir.ColumnRef(types0[any_sym], any_sym),))
+            qs.node = N.Project(qs.node, assigns)
+            qs.unique = [frozenset([rid])] + list(qs.unique)
+            key = [rid]
         criteria = [(o, i) for (o, i, _t) in sub_qs.corr_pairs]
         residual = (sub_qs.residual_corr[0]
                     if len(sub_qs.residual_corr) == 1

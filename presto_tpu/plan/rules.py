@@ -109,7 +109,15 @@ def simplify_expr(e: ir.Expr) -> ir.Expr:
                 and all(a.value is not None for a in args)):
             a, b = args
             plain = (T.BigintType, T.IntegerType, T.DoubleType,
-                     T.BooleanType, T.DateType)
+                     T.BooleanType, T.DateType, T.TimestampType)
+            both_str = (isinstance(a.dtype, T.VarcharType)
+                        and isinstance(b.dtype, T.VarcharType))
+            if both_str and fn in ("eq", "neq"):
+                # union-branch discriminators (q11's sale_type = 's')
+                # fold so PruneFalseUnionBranch can fire
+                return ir.Literal(
+                    T.BOOLEAN,
+                    bool(_FOLDABLE_CMP[fn](str(a.value), str(b.value))))
             if isinstance(a.dtype, plain) and isinstance(b.dtype, plain):
                 if fn in _FOLDABLE_CMP:
                     return ir.Literal(
@@ -241,6 +249,68 @@ class MergeProjects(Rule):
         return N.Project(inner.source, assigns)
 
 
+class PushFilterThroughUnion(Rule):
+    """Filter(Union) -> Union of per-branch filters with references
+    remapped (reference rule/PushPredicateThroughUnion /
+    ImplementFilteredAggregations family). Together with constant
+    folding this statically prunes branches: q11-class CTE legs filter
+    a per-branch literal discriminator (sale_type = 's'), so one
+    branch's predicate folds to FALSE."""
+
+    def apply(self, node):
+        if not (isinstance(node, N.Filter)
+                and isinstance(node.source, N.Union)):
+            return None
+        u = node.source
+        new_inputs = []
+        for inp, mapping in zip(u.inputs, u.mappings):
+            in_types = inp.output_types()
+            subst = {out: ir.ColumnRef(in_types[m], m)
+                     for out, m in mapping.items()}
+            pred = ir.rewrite_refs(node.predicate, subst)
+            new_inputs.append(N.Filter(inp, pred))
+        return dataclasses.replace(u, inputs=new_inputs)
+
+
+def _statically_false(node: N.PlanNode) -> bool:
+    """Is this subtree provably empty? (a Filter whose predicate folded
+    to FALSE or NULL)."""
+    if isinstance(node, N.Filter):
+        p = node.predicate
+        if isinstance(p, ir.Literal) and (p.value is False
+                                          or p.value is None):
+            return True
+        return _statically_false(node.source)
+    if isinstance(node, N.Project):
+        return _statically_false(node.source)
+    return False
+
+
+class PruneFalseUnionBranch(Rule):
+    """Drop union branches that are provably empty; a single surviving
+    branch replaces the Union with a renaming Project (reference
+    rule/RemoveEmptyUnionBranches)."""
+
+    def apply(self, node):
+        if not isinstance(node, N.Union) or len(node.inputs) < 2:
+            return None
+        keep = [(inp, m) for inp, m in zip(node.inputs, node.mappings)
+                if not _statically_false(inp)]
+        if len(keep) == len(node.inputs):
+            return None
+        if not keep:
+            keep = [(node.inputs[0], node.mappings[0])]
+        if len(keep) == 1:
+            inp, mapping = keep[0]
+            in_types = inp.output_types()
+            return N.Project(inp, {
+                out: ir.ColumnRef(in_types[m], m)
+                for out, m in mapping.items()})
+        return dataclasses.replace(
+            node, inputs=[i for i, _ in keep],
+            mappings=[m for _, m in keep])
+
+
 class MergeLimits(Rule):
     def apply(self, node):
         if (isinstance(node, N.Limit) and isinstance(node.source, N.Limit)
@@ -264,6 +334,8 @@ DEFAULT_RULES: tuple[Rule, ...] = (
     RemoveTrivialFilter(),
     MergeFilters(),
     PushFilterThroughProject(),
+    PushFilterThroughUnion(),
+    PruneFalseUnionBranch(),
     MergeProjects(),
     MergeLimits(),
     SortLimitToTopN(),

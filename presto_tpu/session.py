@@ -83,6 +83,10 @@ SYSTEM_SESSION_PROPERTIES: dict[str, tuple[Any, type, str]] = {
                         "stream scans bigger than this in blocks of this "
                         "many rows through a partial-aggregate kernel "
                         "(the split analog; 0 disables streaming)"),
+    "require_distribution": (False, bool,
+                             "fail queries the multi-host coordinator "
+                             "cannot distribute instead of silently "
+                             "running them on the local engine"),
 }
 
 

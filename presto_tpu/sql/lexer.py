@@ -68,7 +68,10 @@ def tokenize(sql: str) -> list[Token]:
             j = sql.find('"', i + 1)
             if j < 0:
                 raise SqlSyntaxError(f"unterminated quoted identifier at {i}")
-            tokens.append(Token("qident", sql[i + 1:j], i))
+            # identifiers are case-insensitive even when quoted (the
+            # reference lowercases all identifiers — its own TPC-DS
+            # texts alias "YEAR" and reference "year")
+            tokens.append(Token("qident", sql[i + 1:j].lower(), i))
             i = j + 1
             continue
         if c.isdigit() or (c == "." and i + 1 < n and sql[i + 1].isdigit()):

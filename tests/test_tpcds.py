@@ -3502,6 +3502,1662 @@ QUERIES = {
         GROUP BY "substr"("w_warehouse_name", 1, 20), "sm_type", "cc_name"
         ORDER BY "substr"("w_warehouse_name", 1, 20) ASC, "sm_type" ASC, "cc_name" ASC
         LIMIT 100""",
+    "q02": """
+        WITH
+  wscs AS (
+   SELECT
+     "sold_date_sk"
+   , "sales_price"
+   FROM
+     (
+      SELECT
+        "ws_sold_date_sk" "sold_date_sk"
+      , "ws_ext_sales_price" "sales_price"
+      FROM
+        web_sales
+   )  
+UNION ALL (
+      SELECT
+        "cs_sold_date_sk" "sold_date_sk"
+      , "cs_ext_sales_price" "sales_price"
+      FROM
+        catalog_sales
+   ) ) 
+, wswscs AS (
+   SELECT
+     "d_week_seq"
+   , "sum"((CASE WHEN ("d_day_name" = 'Sunday') THEN "sales_price" ELSE null END)) "sun_sales"
+   , "sum"((CASE WHEN ("d_day_name" = 'Monday') THEN "sales_price" ELSE null END)) "mon_sales"
+   , "sum"((CASE WHEN ("d_day_name" = 'Tuesday') THEN "sales_price" ELSE null END)) "tue_sales"
+   , "sum"((CASE WHEN ("d_day_name" = 'Wednesday') THEN "sales_price" ELSE null END)) "wed_sales"
+   , "sum"((CASE WHEN ("d_day_name" = 'Thursday') THEN "sales_price" ELSE null END)) "thu_sales"
+   , "sum"((CASE WHEN ("d_day_name" = 'Friday') THEN "sales_price" ELSE null END)) "fri_sales"
+   , "sum"((CASE WHEN ("d_day_name" = 'Saturday') THEN "sales_price" ELSE null END)) "sat_sales"
+   FROM
+     wscs
+   , date_dim
+   WHERE ("d_date_sk" = "sold_date_sk")
+   GROUP BY "d_week_seq"
+) 
+SELECT
+  "d_week_seq1"
+, "round"(("sun_sales1" / "sun_sales2"), 2)
+, "round"(("mon_sales1" / "mon_sales2"), 2)
+, "round"(("tue_sales1" / "tue_sales2"), 2)
+, "round"(("wed_sales1" / "wed_sales2"), 2)
+, "round"(("thu_sales1" / "thu_sales2"), 2)
+, "round"(("fri_sales1" / "fri_sales2"), 2)
+, "round"(("sat_sales1" / "sat_sales2"), 2)
+FROM
+  (
+   SELECT
+     "wswscs"."d_week_seq" "d_week_seq1"
+   , "sun_sales" "sun_sales1"
+   , "mon_sales" "mon_sales1"
+   , "tue_sales" "tue_sales1"
+   , "wed_sales" "wed_sales1"
+   , "thu_sales" "thu_sales1"
+   , "fri_sales" "fri_sales1"
+   , "sat_sales" "sat_sales1"
+   FROM
+     wswscs
+   , date_dim
+   WHERE ("date_dim"."d_week_seq" = "wswscs"."d_week_seq")
+      AND ("d_year" = 2001)
+)  y
+, (
+   SELECT
+     "wswscs"."d_week_seq" "d_week_seq2"
+   , "sun_sales" "sun_sales2"
+   , "mon_sales" "mon_sales2"
+   , "tue_sales" "tue_sales2"
+   , "wed_sales" "wed_sales2"
+   , "thu_sales" "thu_sales2"
+   , "fri_sales" "fri_sales2"
+   , "sat_sales" "sat_sales2"
+   FROM
+     wswscs
+   , date_dim
+   WHERE ("date_dim"."d_week_seq" = "wswscs"."d_week_seq")
+      AND ("d_year" = (2001 + 1))
+)  z
+WHERE ("d_week_seq1" = ("d_week_seq2" - 53))
+ORDER BY "d_week_seq1" ASC""",
+    "q04": """
+        WITH
+  year_total AS (
+   SELECT
+     "c_customer_id" "customer_id"
+   , "c_first_name" "customer_first_name"
+   , "c_last_name" "customer_last_name"
+   , "c_preferred_cust_flag" "customer_preferred_cust_flag"
+   , "c_birth_country" "customer_birth_country"
+   , "c_login" "customer_login"
+   , "c_email_address" "customer_email_address"
+   , "d_year" "dyear"
+   , "sum"((((("ss_ext_list_price" - "ss_ext_wholesale_cost") - "ss_ext_discount_amt") + "ss_ext_sales_price") / 2)) "year_total"
+   , 's' "sale_type"
+   FROM
+     customer
+   , store_sales
+   , date_dim
+   WHERE ("c_customer_sk" = "ss_customer_sk")
+      AND ("ss_sold_date_sk" = "d_date_sk")
+   GROUP BY "c_customer_id", "c_first_name", "c_last_name", "c_preferred_cust_flag", "c_birth_country", "c_login", "c_email_address", "d_year"
+UNION ALL    SELECT
+     "c_customer_id" "customer_id"
+   , "c_first_name" "customer_first_name"
+   , "c_last_name" "customer_last_name"
+   , "c_preferred_cust_flag" "customer_preferred_cust_flag"
+   , "c_birth_country" "customer_birth_country"
+   , "c_login" "customer_login"
+   , "c_email_address" "customer_email_address"
+   , "d_year" "dyear"
+   , "sum"((((("cs_ext_list_price" - "cs_ext_wholesale_cost") - "cs_ext_discount_amt") + "cs_ext_sales_price") / 2)) "year_total"
+   , 'c' "sale_type"
+   FROM
+     customer
+   , catalog_sales
+   , date_dim
+   WHERE ("c_customer_sk" = "cs_bill_customer_sk")
+      AND ("cs_sold_date_sk" = "d_date_sk")
+   GROUP BY "c_customer_id", "c_first_name", "c_last_name", "c_preferred_cust_flag", "c_birth_country", "c_login", "c_email_address", "d_year"
+UNION ALL    SELECT
+     "c_customer_id" "customer_id"
+   , "c_first_name" "customer_first_name"
+   , "c_last_name" "customer_last_name"
+   , "c_preferred_cust_flag" "customer_preferred_cust_flag"
+   , "c_birth_country" "customer_birth_country"
+   , "c_login" "customer_login"
+   , "c_email_address" "customer_email_address"
+   , "d_year" "dyear"
+   , "sum"((((("ws_ext_list_price" - "ws_ext_wholesale_cost") - "ws_ext_discount_amt") + "ws_ext_sales_price") / 2)) "year_total"
+   , 'w' "sale_type"
+   FROM
+     customer
+   , web_sales
+   , date_dim
+   WHERE ("c_customer_sk" = "ws_bill_customer_sk")
+      AND ("ws_sold_date_sk" = "d_date_sk")
+   GROUP BY "c_customer_id", "c_first_name", "c_last_name", "c_preferred_cust_flag", "c_birth_country", "c_login", "c_email_address", "d_year"
+) 
+SELECT
+  "t_s_secyear"."customer_id"
+, "t_s_secyear"."customer_first_name"
+, "t_s_secyear"."customer_last_name"
+, "t_s_secyear"."customer_preferred_cust_flag"
+FROM
+  year_total t_s_firstyear
+, year_total t_s_secyear
+, year_total t_c_firstyear
+, year_total t_c_secyear
+, year_total t_w_firstyear
+, year_total t_w_secyear
+WHERE ("t_s_secyear"."customer_id" = "t_s_firstyear"."customer_id")
+   AND ("t_s_firstyear"."customer_id" = "t_c_secyear"."customer_id")
+   AND ("t_s_firstyear"."customer_id" = "t_c_firstyear"."customer_id")
+   AND ("t_s_firstyear"."customer_id" = "t_w_firstyear"."customer_id")
+   AND ("t_s_firstyear"."customer_id" = "t_w_secyear"."customer_id")
+   AND ("t_s_firstyear"."sale_type" = 's')
+   AND ("t_c_firstyear"."sale_type" = 'c')
+   AND ("t_w_firstyear"."sale_type" = 'w')
+   AND ("t_s_secyear"."sale_type" = 's')
+   AND ("t_c_secyear"."sale_type" = 'c')
+   AND ("t_w_secyear"."sale_type" = 'w')
+   AND ("t_s_firstyear"."dyear" = 2001)
+   AND ("t_s_secyear"."dyear" = (2001 + 1))
+   AND ("t_c_firstyear"."dyear" = 2001)
+   AND ("t_c_secyear"."dyear" = (2001 + 1))
+   AND ("t_w_firstyear"."dyear" = 2001)
+   AND ("t_w_secyear"."dyear" = (2001 + 1))
+   AND ("t_s_firstyear"."year_total" > 0)
+   AND ("t_c_firstyear"."year_total" > 0)
+   AND ("t_w_firstyear"."year_total" > 0)
+   AND ((CASE WHEN ("t_c_firstyear"."year_total" > 0) THEN ("t_c_secyear"."year_total" / "t_c_firstyear"."year_total") ELSE null END) > (CASE WHEN ("t_s_firstyear"."year_total" > 0) THEN ("t_s_secyear"."year_total" / "t_s_firstyear"."year_total") ELSE null END))
+   AND ((CASE WHEN ("t_c_firstyear"."year_total" > 0) THEN ("t_c_secyear"."year_total" / "t_c_firstyear"."year_total") ELSE null END) > (CASE WHEN ("t_w_firstyear"."year_total" > 0) THEN ("t_w_secyear"."year_total" / "t_w_firstyear"."year_total") ELSE null END))
+ORDER BY "t_s_secyear"."customer_id" ASC, "t_s_secyear"."customer_first_name" ASC, "t_s_secyear"."customer_last_name" ASC, "t_s_secyear"."customer_preferred_cust_flag" ASC
+LIMIT 100""",
+    "q10": """
+        SELECT
+  "cd_gender"
+, "cd_marital_status"
+, "cd_education_status"
+, "count"(*) "cnt1"
+, "cd_purchase_estimate"
+, "count"(*) "cnt2"
+, "cd_credit_rating"
+, "count"(*) "cnt3"
+, "cd_dep_count"
+, "count"(*) "cnt4"
+, "cd_dep_employed_count"
+, "count"(*) "cnt5"
+, "cd_dep_college_count"
+, "count"(*) "cnt6"
+FROM
+  customer c
+, customer_address ca
+, customer_demographics
+WHERE ("c"."c_current_addr_sk" = "ca"."ca_address_sk")
+   AND ("ca_county" IN ('Rush County', 'Toole County', 'Jefferson County', 'Dona Ana County', 'La Porte County'))
+   AND ("cd_demo_sk" = "c"."c_current_cdemo_sk")
+   AND (EXISTS (
+   SELECT *
+   FROM
+     store_sales
+   , date_dim
+   WHERE ("c"."c_customer_sk" = "ss_customer_sk")
+      AND ("ss_sold_date_sk" = "d_date_sk")
+      AND ("d_year" = 2002)
+      AND ("d_moy" BETWEEN 1 AND (1 + 3))
+))
+   AND ((EXISTS (
+      SELECT *
+      FROM
+        web_sales
+      , date_dim
+      WHERE ("c"."c_customer_sk" = "ws_bill_customer_sk")
+         AND ("ws_sold_date_sk" = "d_date_sk")
+         AND ("d_year" = 2002)
+         AND ("d_moy" BETWEEN 1 AND (1 + 3))
+   ))
+      OR (EXISTS (
+      SELECT *
+      FROM
+        catalog_sales
+      , date_dim
+      WHERE ("c"."c_customer_sk" = "cs_ship_customer_sk")
+         AND ("cs_sold_date_sk" = "d_date_sk")
+         AND ("d_year" = 2002)
+         AND ("d_moy" BETWEEN 1 AND (1 + 3))
+   )))
+GROUP BY "cd_gender", "cd_marital_status", "cd_education_status", "cd_purchase_estimate", "cd_credit_rating", "cd_dep_count", "cd_dep_employed_count", "cd_dep_college_count"
+ORDER BY "cd_gender" ASC, "cd_marital_status" ASC, "cd_education_status" ASC, "cd_purchase_estimate" ASC, "cd_credit_rating" ASC, "cd_dep_count" ASC, "cd_dep_employed_count" ASC, "cd_dep_college_count" ASC
+LIMIT 100""",
+    "q11": """
+        WITH
+  year_total AS (
+   SELECT
+     "c_customer_id" "customer_id"
+   , "c_first_name" "customer_first_name"
+   , "c_last_name" "customer_last_name"
+   , "c_preferred_cust_flag" "customer_preferred_cust_flag"
+   , "c_birth_country" "customer_birth_country"
+   , "c_login" "customer_login"
+   , "c_email_address" "customer_email_address"
+   , "d_year" "dyear"
+   , "sum"(("ss_ext_list_price" - "ss_ext_discount_amt")) "year_total"
+   , 's' "sale_type"
+   FROM
+     customer
+   , store_sales
+   , date_dim
+   WHERE ("c_customer_sk" = "ss_customer_sk")
+      AND ("ss_sold_date_sk" = "d_date_sk")
+   GROUP BY "c_customer_id", "c_first_name", "c_last_name", "c_preferred_cust_flag", "c_birth_country", "c_login", "c_email_address", "d_year"
+UNION ALL    SELECT
+     "c_customer_id" "customer_id"
+   , "c_first_name" "customer_first_name"
+   , "c_last_name" "customer_last_name"
+   , "c_preferred_cust_flag" "customer_preferred_cust_flag"
+   , "c_birth_country" "customer_birth_country"
+   , "c_login" "customer_login"
+   , "c_email_address" "customer_email_address"
+   , "d_year" "dyear"
+   , "sum"(("ws_ext_list_price" - "ws_ext_discount_amt")) "year_total"
+   , 'w' "sale_type"
+   FROM
+     customer
+   , web_sales
+   , date_dim
+   WHERE ("c_customer_sk" = "ws_bill_customer_sk")
+      AND ("ws_sold_date_sk" = "d_date_sk")
+   GROUP BY "c_customer_id", "c_first_name", "c_last_name", "c_preferred_cust_flag", "c_birth_country", "c_login", "c_email_address", "d_year"
+) 
+SELECT
+  "t_s_secyear"."customer_id"
+, "t_s_secyear"."customer_first_name"
+, "t_s_secyear"."customer_last_name"
+, "t_s_secyear"."customer_preferred_cust_flag"
+, "t_s_secyear"."customer_birth_country"
+, "t_s_secyear"."customer_login"
+FROM
+  year_total t_s_firstyear
+, year_total t_s_secyear
+, year_total t_w_firstyear
+, year_total t_w_secyear
+WHERE ("t_s_secyear"."customer_id" = "t_s_firstyear"."customer_id")
+   AND ("t_s_firstyear"."customer_id" = "t_w_secyear"."customer_id")
+   AND ("t_s_firstyear"."customer_id" = "t_w_firstyear"."customer_id")
+   AND ("t_s_firstyear"."sale_type" = 's')
+   AND ("t_w_firstyear"."sale_type" = 'w')
+   AND ("t_s_secyear"."sale_type" = 's')
+   AND ("t_w_secyear"."sale_type" = 'w')
+   AND ("t_s_firstyear"."dyear" = 2001)
+   AND ("t_s_secyear"."dyear" = (2001 + 1))
+   AND ("t_w_firstyear"."dyear" = 2001)
+   AND ("t_w_secyear"."dyear" = (2001 + 1))
+   AND ("t_s_firstyear"."year_total" > 0)
+   AND ("t_w_firstyear"."year_total" > 0)
+   AND ((CASE WHEN ("t_w_firstyear"."year_total" > 0) THEN ("t_w_secyear"."year_total" / "t_w_firstyear"."year_total") ELSE DECIMAL '0.0' END) > (CASE WHEN ("t_s_firstyear"."year_total" > 0) THEN ("t_s_secyear"."year_total" / "t_s_firstyear"."year_total") ELSE DECIMAL '0.0' END))
+ORDER BY "t_s_secyear"."customer_id" ASC, "t_s_secyear"."customer_first_name" ASC, "t_s_secyear"."customer_last_name" ASC, "t_s_secyear"."customer_preferred_cust_flag" ASC
+LIMIT 100""",
+    "q14": """
+        WITH
+  cross_items AS (
+   SELECT "i_item_sk" "ss_item_sk"
+   FROM
+     item
+   , (
+      SELECT
+        "iss"."i_brand_id" "brand_id"
+      , "iss"."i_class_id" "class_id"
+      , "iss"."i_category_id" "category_id"
+      FROM
+        store_sales
+      , item iss
+      , date_dim d1
+      WHERE ("ss_item_sk" = "iss"."i_item_sk")
+         AND ("ss_sold_date_sk" = "d1"."d_date_sk")
+         AND ("d1"."d_year" BETWEEN 1999 AND (1999 + 2))
+INTERSECT       SELECT
+        "ics"."i_brand_id"
+      , "ics"."i_class_id"
+      , "ics"."i_category_id"
+      FROM
+        catalog_sales
+      , item ics
+      , date_dim d2
+      WHERE ("cs_item_sk" = "ics"."i_item_sk")
+         AND ("cs_sold_date_sk" = "d2"."d_date_sk")
+         AND ("d2"."d_year" BETWEEN 1999 AND (1999 + 2))
+INTERSECT       SELECT
+        "iws"."i_brand_id"
+      , "iws"."i_class_id"
+      , "iws"."i_category_id"
+      FROM
+        web_sales
+      , item iws
+      , date_dim d3
+      WHERE ("ws_item_sk" = "iws"."i_item_sk")
+         AND ("ws_sold_date_sk" = "d3"."d_date_sk")
+         AND ("d3"."d_year" BETWEEN 1999 AND (1999 + 2))
+   ) 
+   WHERE ("i_brand_id" = "brand_id")
+      AND ("i_class_id" = "class_id")
+      AND ("i_category_id" = "category_id")
+) 
+, avg_sales AS (
+   SELECT "avg"(("quantity" * "list_price")) "average_sales"
+   FROM
+     (
+      SELECT
+        "ss_quantity" "quantity"
+      , "ss_list_price" "list_price"
+      FROM
+        store_sales
+      , date_dim
+      WHERE ("ss_sold_date_sk" = "d_date_sk")
+         AND ("d_year" BETWEEN 1999 AND (1999 + 2))
+UNION ALL       SELECT
+        "cs_quantity" "quantity"
+      , "cs_list_price" "list_price"
+      FROM
+        catalog_sales
+      , date_dim
+      WHERE ("cs_sold_date_sk" = "d_date_sk")
+         AND ("d_year" BETWEEN 1999 AND (1999 + 2))
+UNION ALL       SELECT
+        "ws_quantity" "quantity"
+      , "ws_list_price" "list_price"
+      FROM
+        web_sales
+      , date_dim
+      WHERE ("ws_sold_date_sk" = "d_date_sk")
+         AND ("d_year" BETWEEN 1999 AND (1999 + 2))
+   )  x
+) 
+SELECT
+  "channel"
+, "i_brand_id"
+, "i_class_id"
+, "i_category_id"
+, "sum"("sales")
+, "sum"("number_sales")
+FROM
+  (
+   SELECT
+     'store' "channel"
+   , "i_brand_id"
+   , "i_class_id"
+   , "i_category_id"
+   , "sum"(("ss_quantity" * "ss_list_price")) "sales"
+   , "count"(*) "number_sales"
+   FROM
+     store_sales
+   , item
+   , date_dim
+   WHERE ("ss_item_sk" IN (
+      SELECT "ss_item_sk"
+      FROM
+        cross_items
+   ))
+      AND ("ss_item_sk" = "i_item_sk")
+      AND ("ss_sold_date_sk" = "d_date_sk")
+      AND ("d_year" = (1999 + 2))
+      AND ("d_moy" = 11)
+   GROUP BY "i_brand_id", "i_class_id", "i_category_id"
+   HAVING ("sum"(("ss_quantity" * "ss_list_price")) > (
+         SELECT "average_sales"
+         FROM
+           avg_sales
+      ))
+UNION ALL    SELECT
+     'catalog' "channel"
+   , "i_brand_id"
+   , "i_class_id"
+   , "i_category_id"
+   , "sum"(("cs_quantity" * "cs_list_price")) "sales"
+   , "count"(*) "number_sales"
+   FROM
+     catalog_sales
+   , item
+   , date_dim
+   WHERE ("cs_item_sk" IN (
+      SELECT "ss_item_sk"
+      FROM
+        cross_items
+   ))
+      AND ("cs_item_sk" = "i_item_sk")
+      AND ("cs_sold_date_sk" = "d_date_sk")
+      AND ("d_year" = (1999 + 2))
+      AND ("d_moy" = 11)
+   GROUP BY "i_brand_id", "i_class_id", "i_category_id"
+   HAVING ("sum"(("cs_quantity" * "cs_list_price")) > (
+         SELECT "average_sales"
+         FROM
+           avg_sales
+      ))
+UNION ALL    SELECT
+     'web' "channel"
+   , "i_brand_id"
+   , "i_class_id"
+   , "i_category_id"
+   , "sum"(("ws_quantity" * "ws_list_price")) "sales"
+   , "count"(*) "number_sales"
+   FROM
+     web_sales
+   , item
+   , date_dim
+   WHERE ("ws_item_sk" IN (
+      SELECT "ss_item_sk"
+      FROM
+        cross_items
+   ))
+      AND ("ws_item_sk" = "i_item_sk")
+      AND ("ws_sold_date_sk" = "d_date_sk")
+      AND ("d_year" = (1999 + 2))
+      AND ("d_moy" = 11)
+   GROUP BY "i_brand_id", "i_class_id", "i_category_id"
+   HAVING ("sum"(("ws_quantity" * "ws_list_price")) > (
+         SELECT "average_sales"
+         FROM
+           avg_sales
+      ))
+)  y
+GROUP BY ROLLUP (channel, i_brand_id, i_class_id, i_category_id)
+ORDER BY "channel" ASC, "i_brand_id" ASC, "i_class_id" ASC, "i_category_id" ASC
+LIMIT 100""",
+    "q16": """
+        SELECT
+  "count"(DISTINCT "cs_order_number") "order count"
+, "sum"("cs_ext_ship_cost") "total shipping cost"
+, "sum"("cs_net_profit") "total net profit"
+FROM
+  catalog_sales cs1
+, date_dim
+, customer_address
+, call_center
+WHERE ("d_date" BETWEEN CAST('2002-2-01' AS DATE) AND (CAST('2002-2-01' AS DATE) + INTERVAL  '60' DAY))
+   AND ("cs1"."cs_ship_date_sk" = "d_date_sk")
+   AND ("cs1"."cs_ship_addr_sk" = "ca_address_sk")
+   AND ("ca_state" = 'GA')
+   AND ("cs1"."cs_call_center_sk" = "cc_call_center_sk")
+   AND ("cc_county" IN ('Williamson County', 'Williamson County', 'Williamson County', 'Williamson County', 'Williamson County'))
+   AND (EXISTS (
+   SELECT *
+   FROM
+     catalog_sales cs2
+   WHERE ("cs1"."cs_order_number" = "cs2"."cs_order_number")
+      AND ("cs1"."cs_warehouse_sk" <> "cs2"."cs_warehouse_sk")
+))
+   AND (NOT (EXISTS (
+   SELECT *
+   FROM
+     catalog_returns cr1
+   WHERE ("cs1"."cs_order_number" = "cr1"."cr_order_number")
+)))
+ORDER BY "count"(DISTINCT "cs_order_number") ASC
+LIMIT 100""",
+    "q23": """
+        WITH
+  frequent_ss_items AS (
+   SELECT
+     "substr"("i_item_desc", 1, 30) "itemdesc"
+   , "i_item_sk" "item_sk"
+   , "d_date" "solddate"
+   , "count"(*) "cnt"
+   FROM
+     store_sales
+   , date_dim
+   , item
+   WHERE ("ss_sold_date_sk" = "d_date_sk")
+      AND ("ss_item_sk" = "i_item_sk")
+      AND ("d_year" IN (2000   , (2000 + 1)   , (2000 + 2)   , (2000 + 3)))
+   GROUP BY "substr"("i_item_desc", 1, 30), "i_item_sk", "d_date"
+   HAVING ("count"(*) > 4)
+) 
+, max_store_sales AS (
+   SELECT "max"("csales") "tpcds_cmax"
+   FROM
+     (
+      SELECT
+        "c_customer_sk"
+      , "sum"(("ss_quantity" * "ss_sales_price")) "csales"
+      FROM
+        store_sales
+      , customer
+      , date_dim
+      WHERE ("ss_customer_sk" = "c_customer_sk")
+         AND ("ss_sold_date_sk" = "d_date_sk")
+         AND ("d_year" IN (2000      , (2000 + 1)      , (2000 + 2)      , (2000 + 3)))
+      GROUP BY "c_customer_sk"
+   ) 
+) 
+, best_ss_customer AS (
+   SELECT
+     "c_customer_sk"
+   , "sum"(("ss_quantity" * "ss_sales_price")) "ssales"
+   FROM
+     store_sales
+   , customer
+   WHERE ("ss_customer_sk" = "c_customer_sk")
+   GROUP BY "c_customer_sk"
+   HAVING ("sum"(("ss_quantity" * "ss_sales_price")) > ((50 / DECIMAL '100.0') * (
+            SELECT *
+            FROM
+              max_store_sales
+         )))
+) 
+SELECT "sum"("sales")
+FROM
+  (
+   SELECT ("cs_quantity" * "cs_list_price") "sales"
+   FROM
+     catalog_sales
+   , date_dim
+   WHERE ("d_year" = 2000)
+      AND ("d_moy" = 2)
+      AND ("cs_sold_date_sk" = "d_date_sk")
+      AND ("cs_item_sk" IN (
+      SELECT "item_sk"
+      FROM
+        frequent_ss_items
+   ))
+      AND ("cs_bill_customer_sk" IN (
+      SELECT "c_customer_sk"
+      FROM
+        best_ss_customer
+   ))
+UNION ALL    SELECT ("ws_quantity" * "ws_list_price") "sales"
+   FROM
+     web_sales
+   , date_dim
+   WHERE ("d_year" = 2000)
+      AND ("d_moy" = 2)
+      AND ("ws_sold_date_sk" = "d_date_sk")
+      AND ("ws_item_sk" IN (
+      SELECT "item_sk"
+      FROM
+        frequent_ss_items
+   ))
+      AND ("ws_bill_customer_sk" IN (
+      SELECT "c_customer_sk"
+      FROM
+        best_ss_customer
+   ))
+) 
+LIMIT 100""",
+    "q24": """
+        WITH
+  ssales AS (
+   SELECT
+     "c_last_name"
+   , "c_first_name"
+   , "s_store_name"
+   , "ca_state"
+   , "s_state"
+   , "i_color"
+   , "i_current_price"
+   , "i_manager_id"
+   , "i_units"
+   , "i_size"
+   , "sum"("ss_net_paid") "netpaid"
+   FROM
+     store_sales
+   , store_returns
+   , store
+   , item
+   , customer
+   , customer_address
+   WHERE ("ss_ticket_number" = "sr_ticket_number")
+      AND ("ss_item_sk" = "sr_item_sk")
+      AND ("ss_customer_sk" = "c_customer_sk")
+      AND ("ss_item_sk" = "i_item_sk")
+      AND ("ss_store_sk" = "s_store_sk")
+      AND ("c_birth_country" = "upper"("ca_country"))
+      AND ("s_zip" = "ca_zip")
+      AND ("s_market_id" = 8)
+   GROUP BY "c_last_name", "c_first_name", "s_store_name", "ca_state", "s_state", "i_color", "i_current_price", "i_manager_id", "i_units", "i_size"
+) 
+SELECT
+  "c_last_name"
+, "c_first_name"
+, "s_store_name"
+, "sum"("netpaid") "paid"
+FROM
+  ssales
+WHERE ("i_color" = 'pale')
+GROUP BY "c_last_name", "c_first_name", "s_store_name"
+HAVING ("sum"("netpaid") > (
+      SELECT (DECIMAL '0.05' * "avg"("netpaid"))
+      FROM
+        ssales
+   ))""",
+    "q31": """
+        WITH
+  ss AS (
+   SELECT
+     "ca_county"
+   , "d_qoy"
+   , "d_year"
+   , "sum"("ss_ext_sales_price") "store_sales"
+   FROM
+     store_sales
+   , date_dim
+   , customer_address
+   WHERE ("ss_sold_date_sk" = "d_date_sk")
+      AND ("ss_addr_sk" = "ca_address_sk")
+   GROUP BY "ca_county", "d_qoy", "d_year"
+) 
+, ws AS (
+   SELECT
+     "ca_county"
+   , "d_qoy"
+   , "d_year"
+   , "sum"("ws_ext_sales_price") "web_sales"
+   FROM
+     web_sales
+   , date_dim
+   , customer_address
+   WHERE ("ws_sold_date_sk" = "d_date_sk")
+      AND ("ws_bill_addr_sk" = "ca_address_sk")
+   GROUP BY "ca_county", "d_qoy", "d_year"
+) 
+SELECT
+  "ss1"."ca_county"
+, "ss1"."d_year"
+, ("ws2"."web_sales" / "ws1"."web_sales") "web_q1_q2_increase"
+, ("ss2"."store_sales" / "ss1"."store_sales") "store_q1_q2_increase"
+, ("ws3"."web_sales" / "ws2"."web_sales") "web_q2_q3_increase"
+, ("ss3"."store_sales" / "ss2"."store_sales") "store_q2_q3_increase"
+FROM
+  ss ss1
+, ss ss2
+, ss ss3
+, ws ws1
+, ws ws2
+, ws ws3
+WHERE ("ss1"."d_qoy" = 1)
+   AND ("ss1"."d_year" = 2000)
+   AND ("ss1"."ca_county" = "ss2"."ca_county")
+   AND ("ss2"."d_qoy" = 2)
+   AND ("ss2"."d_year" = 2000)
+   AND ("ss2"."ca_county" = "ss3"."ca_county")
+   AND ("ss3"."d_qoy" = 3)
+   AND ("ss3"."d_year" = 2000)
+   AND ("ss1"."ca_county" = "ws1"."ca_county")
+   AND ("ws1"."d_qoy" = 1)
+   AND ("ws1"."d_year" = 2000)
+   AND ("ws1"."ca_county" = "ws2"."ca_county")
+   AND ("ws2"."d_qoy" = 2)
+   AND ("ws2"."d_year" = 2000)
+   AND ("ws1"."ca_county" = "ws3"."ca_county")
+   AND ("ws3"."d_qoy" = 3)
+   AND ("ws3"."d_year" = 2000)
+   AND ((CASE WHEN ("ws1"."web_sales" > 0) THEN (CAST("ws2"."web_sales" AS DECIMAL(38,3)) / "ws1"."web_sales") ELSE null END) > (CASE WHEN ("ss1"."store_sales" > 0) THEN (CAST("ss2"."store_sales" AS DECIMAL(38,3)) / "ss1"."store_sales") ELSE null END))
+   AND ((CASE WHEN ("ws2"."web_sales" > 0) THEN (CAST("ws3"."web_sales" AS DECIMAL(38,3)) / "ws2"."web_sales") ELSE null END) > (CASE WHEN ("ss2"."store_sales" > 0) THEN (CAST("ss3"."store_sales" AS DECIMAL(38,3)) / "ss2"."store_sales") ELSE null END))
+ORDER BY "ss1"."ca_county" ASC""",
+    "q36": """
+        SELECT
+  ("sum"("ss_net_profit") / "sum"("ss_ext_sales_price")) "gross_margin"
+, "i_category"
+, "i_class"
+, (GROUPING ("i_category") + GROUPING ("i_class")) "lochierarchy"
+, "rank"() OVER (PARTITION BY (GROUPING ("i_category") + GROUPING ("i_class")), (CASE WHEN (GROUPING ("i_class") = 0) THEN "i_category" END) ORDER BY ("sum"("ss_net_profit") / "sum"("ss_ext_sales_price")) ASC) "rank_within_parent"
+FROM
+  store_sales
+, date_dim d1
+, item
+, store
+WHERE ("d1"."d_year" = 2001)
+   AND ("d1"."d_date_sk" = "ss_sold_date_sk")
+   AND ("i_item_sk" = "ss_item_sk")
+   AND ("s_store_sk" = "ss_store_sk")
+   AND ("s_state" IN (
+     'TN'
+   , 'TN'
+   , 'TN'
+   , 'TN'
+   , 'TN'
+   , 'TN'
+   , 'TN'
+   , 'TN'))
+GROUP BY ROLLUP (i_category, i_class)
+ORDER BY "lochierarchy" DESC, (CASE WHEN ("lochierarchy" = 0) THEN "i_category" END) ASC, "rank_within_parent" ASC, "i_category", "i_class"
+LIMIT 100""",
+    "q45": """
+        SELECT
+  "ca_zip"
+, "ca_city"
+, "sum"("ws_sales_price")
+FROM
+  web_sales
+, customer
+, customer_address
+, date_dim
+, item
+WHERE ("ws_bill_customer_sk" = "c_customer_sk")
+   AND ("c_current_addr_sk" = "ca_address_sk")
+   AND ("ws_item_sk" = "i_item_sk")
+   AND (("substr"("ca_zip", 1, 5) IN ('85669'   , '86197'   , '88274'   , '83405'   , '86475'   , '85392'   , '85460'   , '80348'   , '81792'))
+      OR ("i_item_id" IN (
+      SELECT "i_item_id"
+      FROM
+        item
+      WHERE ("i_item_sk" IN (2      , 3      , 5      , 7      , 11      , 13      , 17      , 19      , 23      , 29))
+   )))
+   AND ("ws_sold_date_sk" = "d_date_sk")
+   AND ("d_qoy" = 2)
+   AND ("d_year" = 2001)
+GROUP BY "ca_zip", "ca_city"
+ORDER BY "ca_zip" ASC, "ca_city" ASC
+LIMIT 100""",
+    "q49": """
+        SELECT
+  'web' "channel"
+, "web"."item"
+, "web"."return_ratio"
+, "web"."return_rank"
+, "web"."currency_rank"
+FROM
+  (
+   SELECT
+     "item"
+   , "return_ratio"
+   , "currency_ratio"
+   , "rank"() OVER (ORDER BY "return_ratio" ASC) "return_rank"
+   , "rank"() OVER (ORDER BY "currency_ratio" ASC) "currency_rank"
+   FROM
+     (
+      SELECT
+        "ws"."ws_item_sk" "item"
+      , (CAST("sum"(COALESCE("wr"."wr_return_quantity", 0)) AS DECIMAL(15,4)) / CAST("sum"(COALESCE("ws"."ws_quantity", 0)) AS DECIMAL(15,4))) "return_ratio"
+      , (CAST("sum"(COALESCE("wr"."wr_return_amt", 0)) AS DECIMAL(15,4)) / CAST("sum"(COALESCE("ws"."ws_net_paid", 0)) AS DECIMAL(15,4))) "currency_ratio"
+      FROM
+        (web_sales ws
+      LEFT JOIN web_returns wr ON ("ws"."ws_order_number" = "wr"."wr_order_number")
+         AND ("ws"."ws_item_sk" = "wr"."wr_item_sk"))
+      , date_dim
+      WHERE ("wr"."wr_return_amt" > 10000)
+         AND ("ws"."ws_net_profit" > 1)
+         AND ("ws"."ws_net_paid" > 0)
+         AND ("ws"."ws_quantity" > 0)
+         AND ("ws_sold_date_sk" = "d_date_sk")
+         AND ("d_year" = 2001)
+         AND ("d_moy" = 12)
+      GROUP BY "ws"."ws_item_sk"
+   )  in_web
+)  web
+WHERE ("web"."return_rank" <= 10)
+   OR ("web"."currency_rank" <= 10)
+UNION SELECT
+  'catalog' "channel"
+, "catalog"."item"
+, "catalog"."return_ratio"
+, "catalog"."return_rank"
+, "catalog"."currency_rank"
+FROM
+  (
+   SELECT
+     "item"
+   , "return_ratio"
+   , "currency_ratio"
+   , "rank"() OVER (ORDER BY "return_ratio" ASC) "return_rank"
+   , "rank"() OVER (ORDER BY "currency_ratio" ASC) "currency_rank"
+   FROM
+     (
+      SELECT
+        "cs"."cs_item_sk" "item"
+      , (CAST("sum"(COALESCE("cr"."cr_return_quantity", 0)) AS DECIMAL(15,4)) / CAST("sum"(COALESCE("cs"."cs_quantity", 0)) AS DECIMAL(15,4))) "return_ratio"
+      , (CAST("sum"(COALESCE("cr"."cr_return_amount", 0)) AS DECIMAL(15,4)) / CAST("sum"(COALESCE("cs"."cs_net_paid", 0)) AS DECIMAL(15,4))) "currency_ratio"
+      FROM
+        (catalog_sales cs
+      LEFT JOIN catalog_returns cr ON ("cs"."cs_order_number" = "cr"."cr_order_number")
+         AND ("cs"."cs_item_sk" = "cr"."cr_item_sk"))
+      , date_dim
+      WHERE ("cr"."cr_return_amount" > 10000)
+         AND ("cs"."cs_net_profit" > 1)
+         AND ("cs"."cs_net_paid" > 0)
+         AND ("cs"."cs_quantity" > 0)
+         AND ("cs_sold_date_sk" = "d_date_sk")
+         AND ("d_year" = 2001)
+         AND ("d_moy" = 12)
+      GROUP BY "cs"."cs_item_sk"
+   )  in_cat
+)  "CATALOG"
+WHERE ("catalog"."return_rank" <= 10)
+   OR ("catalog"."currency_rank" <= 10)
+UNION SELECT
+  'store' "channel"
+, "store"."item"
+, "store"."return_ratio"
+, "store"."return_rank"
+, "store"."currency_rank"
+FROM
+  (
+   SELECT
+     "item"
+   , "return_ratio"
+   , "currency_ratio"
+   , "rank"() OVER (ORDER BY "return_ratio" ASC) "return_rank"
+   , "rank"() OVER (ORDER BY "currency_ratio" ASC) "currency_rank"
+   FROM
+     (
+      SELECT
+        "sts"."ss_item_sk" "item"
+      , (CAST("sum"(COALESCE("sr"."sr_return_quantity", 0)) AS DECIMAL(15,4)) / CAST("sum"(COALESCE("sts"."ss_quantity", 0)) AS DECIMAL(15,4))) "return_ratio"
+      , (CAST("sum"(COALESCE("sr"."sr_return_amt", 0)) AS DECIMAL(15,4)) / CAST("sum"(COALESCE("sts"."ss_net_paid", 0)) AS DECIMAL(15,4))) "currency_ratio"
+      FROM
+        (store_sales sts
+      LEFT JOIN store_returns sr ON ("sts"."ss_ticket_number" = "sr"."sr_ticket_number")
+         AND ("sts"."ss_item_sk" = "sr"."sr_item_sk"))
+      , date_dim
+      WHERE ("sr"."sr_return_amt" > 10000)
+         AND ("sts"."ss_net_profit" > 1)
+         AND ("sts"."ss_net_paid" > 0)
+         AND ("sts"."ss_quantity" > 0)
+         AND ("ss_sold_date_sk" = "d_date_sk")
+         AND ("d_year" = 2001)
+         AND ("d_moy" = 12)
+      GROUP BY "sts"."ss_item_sk"
+   )  in_store
+)  store
+WHERE ("store"."return_rank" <= 10)
+   OR ("store"."currency_rank" <= 10)
+ORDER BY 1 ASC, 4 ASC, 5 ASC, 2 ASC
+LIMIT 100""",
+    "q50": """
+        SELECT
+  "s_store_name"
+, "s_company_id"
+, "s_street_number"
+, "s_street_name"
+, "s_street_type"
+, "s_suite_number"
+, "s_city"
+, "s_county"
+, "s_state"
+, "s_zip"
+, "sum"((CASE WHEN (("sr_returned_date_sk" - "ss_sold_date_sk") <= 30) THEN 1 ELSE 0 END)) "30 days"
+, "sum"((CASE WHEN (("sr_returned_date_sk" - "ss_sold_date_sk") > 30)
+   AND (("sr_returned_date_sk" - "ss_sold_date_sk") <= 60) THEN 1 ELSE 0 END)) "31-60 days"
+, "sum"((CASE WHEN (("sr_returned_date_sk" - "ss_sold_date_sk") > 60)
+   AND (("sr_returned_date_sk" - "ss_sold_date_sk") <= 90) THEN 1 ELSE 0 END)) "61-90 days"
+, "sum"((CASE WHEN (("sr_returned_date_sk" - "ss_sold_date_sk") > 90)
+   AND (("sr_returned_date_sk" - "ss_sold_date_sk") <= 120) THEN 1 ELSE 0 END)) "91-120 days"
+, "sum"((CASE WHEN (("sr_returned_date_sk" - "ss_sold_date_sk") > 120) THEN 1 ELSE 0 END)) ">120 days"
+FROM
+  store_sales
+, store_returns
+, store
+, date_dim d1
+, date_dim d2
+WHERE ("d2"."d_year" = 2001)
+   AND ("d2"."d_moy" = 8)
+   AND ("ss_ticket_number" = "sr_ticket_number")
+   AND ("ss_item_sk" = "sr_item_sk")
+   AND ("ss_sold_date_sk" = "d1"."d_date_sk")
+   AND ("sr_returned_date_sk" = "d2"."d_date_sk")
+   AND ("ss_customer_sk" = "sr_customer_sk")
+   AND ("ss_store_sk" = "s_store_sk")
+GROUP BY "s_store_name", "s_company_id", "s_street_number", "s_street_name", "s_street_type", "s_suite_number", "s_city", "s_county", "s_state", "s_zip"
+ORDER BY "s_store_name" ASC, "s_company_id" ASC, "s_street_number" ASC, "s_street_name" ASC, "s_street_type" ASC, "s_suite_number" ASC, "s_city" ASC, "s_county" ASC, "s_state" ASC, "s_zip" ASC
+LIMIT 100""",
+    "q51": """
+        WITH
+  web_v1 AS (
+   SELECT
+     "ws_item_sk" "item_sk"
+   , "d_date"
+   , "sum"("sum"("ws_sales_price")) OVER (PARTITION BY "ws_item_sk" ORDER BY "d_date" ASC ROWS BETWEEN UNBOUNDED PRECEDING AND CURRENT ROW) "cume_sales"
+   FROM
+     web_sales
+   , date_dim
+   WHERE ("ws_sold_date_sk" = "d_date_sk")
+      AND ("d_month_seq" BETWEEN 1200 AND (1200 + 11))
+      AND ("ws_item_sk" IS NOT NULL)
+   GROUP BY "ws_item_sk", "d_date"
+) 
+, store_v1 AS (
+   SELECT
+     "ss_item_sk" "item_sk"
+   , "d_date"
+   , "sum"("sum"("ss_sales_price")) OVER (PARTITION BY "ss_item_sk" ORDER BY "d_date" ASC ROWS BETWEEN UNBOUNDED PRECEDING AND CURRENT ROW) "cume_sales"
+   FROM
+     store_sales
+   , date_dim
+   WHERE ("ss_sold_date_sk" = "d_date_sk")
+      AND ("d_month_seq" BETWEEN 1200 AND (1200 + 11))
+      AND ("ss_item_sk" IS NOT NULL)
+   GROUP BY "ss_item_sk", "d_date"
+) 
+SELECT *
+FROM
+  (
+   SELECT
+     "item_sk"
+   , "d_date"
+   , "web_sales"
+   , "store_sales"
+   , "max"("web_sales") OVER (PARTITION BY "item_sk" ORDER BY "d_date" ASC ROWS BETWEEN UNBOUNDED PRECEDING AND CURRENT ROW) "web_cumulative"
+   , "max"("store_sales") OVER (PARTITION BY "item_sk" ORDER BY "d_date" ASC ROWS BETWEEN UNBOUNDED PRECEDING AND CURRENT ROW) "store_cumulative"
+   FROM
+     (
+      SELECT
+        (CASE WHEN ("web"."item_sk" IS NOT NULL) THEN "web"."item_sk" ELSE "store"."item_sk" END) "item_sk"
+      , (CASE WHEN ("web"."d_date" IS NOT NULL) THEN "web"."d_date" ELSE "store"."d_date" END) "d_date"
+      , "web"."cume_sales" "web_sales"
+      , "store"."cume_sales" "store_sales"
+      FROM
+        (web_v1 web
+      FULL JOIN store_v1 store ON ("web"."item_sk" = "store"."item_sk")
+         AND ("web"."d_date" = "store"."d_date"))
+   )  x
+)  y
+WHERE ("web_cumulative" > "store_cumulative")
+ORDER BY "item_sk" ASC, "d_date" ASC
+LIMIT 100""",
+    "q53": """
+        SELECT *
+FROM
+  (
+   SELECT
+     "i_manufact_id"
+   , "sum"("ss_sales_price") "sum_sales"
+   , "avg"("sum"("ss_sales_price")) OVER (PARTITION BY "i_manufact_id") "avg_quarterly_sales"
+   FROM
+     item
+   , store_sales
+   , date_dim
+   , store
+   WHERE ("ss_item_sk" = "i_item_sk")
+      AND ("ss_sold_date_sk" = "d_date_sk")
+      AND ("ss_store_sk" = "s_store_sk")
+      AND ("d_month_seq" IN (1200   , (1200 + 1)   , (1200 + 2)   , (1200 + 3)   , (1200 + 4)   , (1200 + 5)   , (1200 + 6)   , (1200 + 7)   , (1200 + 8)   , (1200 + 9)   , (1200 + 10)   , (1200 + 11)))
+      AND ((("i_category" IN ('Books'         , 'Children'         , 'Electronics'))
+            AND ("i_class" IN ('personal'         , 'portable'         , 'reference'         , 'self-help'))
+            AND ("i_brand" IN ('scholaramalgamalg #14'         , 'scholaramalgamalg #7'         , 'exportiunivamalg #9'         , 'scholaramalgamalg #9')))
+         OR (("i_category" IN ('Women'         , 'Music'         , 'Men'))
+            AND ("i_class" IN ('accessories'         , 'classical'         , 'fragrances'         , 'pants'))
+            AND ("i_brand" IN ('amalgimporto #1'         , 'edu packscholar #1'         , 'exportiimporto #1'         , 'importoamalg #1'))))
+   GROUP BY "i_manufact_id", "d_qoy"
+)  tmp1
+WHERE ((CASE WHEN ("avg_quarterly_sales" > 0) THEN ("abs"((CAST("sum_sales" AS DECIMAL(38,4)) - "avg_quarterly_sales")) / "avg_quarterly_sales") ELSE null END) > DECIMAL '0.1')
+ORDER BY "avg_quarterly_sales" ASC, "sum_sales" ASC, "i_manufact_id" ASC
+LIMIT 100""",
+    "q64": """
+        WITH
+  cs_ui AS (
+   SELECT
+     "cs_item_sk"
+   , "sum"("cs_ext_list_price") "sale"
+   , "sum"((("cr_refunded_cash" + "cr_reversed_charge") + "cr_store_credit")) "refund"
+   FROM
+     catalog_sales
+   , catalog_returns
+   WHERE ("cs_item_sk" = "cr_item_sk")
+      AND ("cs_order_number" = "cr_order_number")
+   GROUP BY "cs_item_sk"
+   HAVING ("sum"("cs_ext_list_price") > (2 * "sum"((("cr_refunded_cash" + "cr_reversed_charge") + "cr_store_credit"))))
+) 
+, cross_sales AS (
+   SELECT
+     "i_product_name" "product_name"
+   , "i_item_sk" "item_sk"
+   , "s_store_name" "store_name"
+   , "s_zip" "store_zip"
+   , "ad1"."ca_street_number" "b_street_number"
+   , "ad1"."ca_street_name" "b_street_name"
+   , "ad1"."ca_city" "b_city"
+   , "ad1"."ca_zip" "b_zip"
+   , "ad2"."ca_street_number" "c_street_number"
+   , "ad2"."ca_street_name" "c_street_name"
+   , "ad2"."ca_city" "c_city"
+   , "ad2"."ca_zip" "c_zip"
+   , "d1"."d_year" "syear"
+   , "d2"."d_year" "fsyear"
+   , "d3"."d_year" "s2year"
+   , "count"(*) "cnt"
+   , "sum"("ss_wholesale_cost") "s1"
+   , "sum"("ss_list_price") "s2"
+   , "sum"("ss_coupon_amt") "s3"
+   FROM
+     store_sales
+   , store_returns
+   , cs_ui
+   , date_dim d1
+   , date_dim d2
+   , date_dim d3
+   , store
+   , customer
+   , customer_demographics cd1
+   , customer_demographics cd2
+   , promotion
+   , household_demographics hd1
+   , household_demographics hd2
+   , customer_address ad1
+   , customer_address ad2
+   , income_band ib1
+   , income_band ib2
+   , item
+   WHERE ("ss_store_sk" = "s_store_sk")
+      AND ("ss_sold_date_sk" = "d1"."d_date_sk")
+      AND ("ss_customer_sk" = "c_customer_sk")
+      AND ("ss_cdemo_sk" = "cd1"."cd_demo_sk")
+      AND ("ss_hdemo_sk" = "hd1"."hd_demo_sk")
+      AND ("ss_addr_sk" = "ad1"."ca_address_sk")
+      AND ("ss_item_sk" = "i_item_sk")
+      AND ("ss_item_sk" = "sr_item_sk")
+      AND ("ss_ticket_number" = "sr_ticket_number")
+      AND ("ss_item_sk" = "cs_ui"."cs_item_sk")
+      AND ("c_current_cdemo_sk" = "cd2"."cd_demo_sk")
+      AND ("c_current_hdemo_sk" = "hd2"."hd_demo_sk")
+      AND ("c_current_addr_sk" = "ad2"."ca_address_sk")
+      AND ("c_first_sales_date_sk" = "d2"."d_date_sk")
+      AND ("c_first_shipto_date_sk" = "d3"."d_date_sk")
+      AND ("ss_promo_sk" = "p_promo_sk")
+      AND ("hd1"."hd_income_band_sk" = "ib1"."ib_income_band_sk")
+      AND ("hd2"."hd_income_band_sk" = "ib2"."ib_income_band_sk")
+      AND ("cd1"."cd_marital_status" <> "cd2"."cd_marital_status")
+      AND ("i_color" IN ('purple'   , 'burlywood'   , 'indian'   , 'spring'   , 'floral'   , 'medium'))
+      AND ("i_current_price" BETWEEN 64 AND (64 + 10))
+      AND ("i_current_price" BETWEEN (64 + 1) AND (64 + 15))
+   GROUP BY "i_product_name", "i_item_sk", "s_store_name", "s_zip", "ad1"."ca_street_number", "ad1"."ca_street_name", "ad1"."ca_city", "ad1"."ca_zip", "ad2"."ca_street_number", "ad2"."ca_street_name", "ad2"."ca_city", "ad2"."ca_zip", "d1"."d_year", "d2"."d_year", "d3"."d_year"
+) 
+SELECT
+  "cs1"."product_name"
+, "cs1"."store_name"
+, "cs1"."store_zip"
+, "cs1"."b_street_number"
+, "cs1"."b_street_name"
+, "cs1"."b_city"
+, "cs1"."b_zip"
+, "cs1"."c_street_number"
+, "cs1"."c_street_name"
+, "cs1"."c_city"
+, "cs1"."c_zip"
+, "cs1"."syear"
+, "cs1"."cnt"
+, "cs1"."s1" "s11"
+, "cs1"."s2" "s21"
+, "cs1"."s3" "s31"
+, "cs2"."s1" "s12"
+, "cs2"."s2" "s22"
+, "cs2"."s3" "s32"
+, "cs2"."syear"
+, "cs2"."cnt"
+FROM
+  cross_sales cs1
+, cross_sales cs2
+WHERE ("cs1"."item_sk" = "cs2"."item_sk")
+   AND ("cs1"."syear" = 1999)
+   AND ("cs2"."syear" = (1999 + 1))
+   AND ("cs2"."cnt" <= "cs1"."cnt")
+   AND ("cs1"."store_name" = "cs2"."store_name")
+   AND ("cs1"."store_zip" = "cs2"."store_zip")
+ORDER BY "cs1"."product_name" ASC, "cs1"."store_name" ASC, "cs2"."cnt" ASC, 14, 15, 16, 17, 18""",
+    "q66": """
+        SELECT
+  "w_warehouse_name"
+, "w_warehouse_sq_ft"
+, "w_city"
+, "w_county"
+, "w_state"
+, "w_country"
+, "ship_carriers"
+, "year"
+, "sum"("jan_sales") "jan_sales"
+, "sum"("feb_sales") "feb_sales"
+, "sum"("mar_sales") "mar_sales"
+, "sum"("apr_sales") "apr_sales"
+, "sum"("may_sales") "may_sales"
+, "sum"("jun_sales") "jun_sales"
+, "sum"("jul_sales") "jul_sales"
+, "sum"("aug_sales") "aug_sales"
+, "sum"("sep_sales") "sep_sales"
+, "sum"("oct_sales") "oct_sales"
+, "sum"("nov_sales") "nov_sales"
+, "sum"("dec_sales") "dec_sales"
+, "sum"(("jan_sales" / "w_warehouse_sq_ft")) "jan_sales_per_sq_foot"
+, "sum"(("feb_sales" / "w_warehouse_sq_ft")) "feb_sales_per_sq_foot"
+, "sum"(("mar_sales" / "w_warehouse_sq_ft")) "mar_sales_per_sq_foot"
+, "sum"(("apr_sales" / "w_warehouse_sq_ft")) "apr_sales_per_sq_foot"
+, "sum"(("may_sales" / "w_warehouse_sq_ft")) "may_sales_per_sq_foot"
+, "sum"(("jun_sales" / "w_warehouse_sq_ft")) "jun_sales_per_sq_foot"
+, "sum"(("jul_sales" / "w_warehouse_sq_ft")) "jul_sales_per_sq_foot"
+, "sum"(("aug_sales" / "w_warehouse_sq_ft")) "aug_sales_per_sq_foot"
+, "sum"(("sep_sales" / "w_warehouse_sq_ft")) "sep_sales_per_sq_foot"
+, "sum"(("oct_sales" / "w_warehouse_sq_ft")) "oct_sales_per_sq_foot"
+, "sum"(("nov_sales" / "w_warehouse_sq_ft")) "nov_sales_per_sq_foot"
+, "sum"(("dec_sales" / "w_warehouse_sq_ft")) "dec_sales_per_sq_foot"
+, "sum"("jan_net") "jan_net"
+, "sum"("feb_net") "feb_net"
+, "sum"("mar_net") "mar_net"
+, "sum"("apr_net") "apr_net"
+, "sum"("may_net") "may_net"
+, "sum"("jun_net") "jun_net"
+, "sum"("jul_net") "jul_net"
+, "sum"("aug_net") "aug_net"
+, "sum"("sep_net") "sep_net"
+, "sum"("oct_net") "oct_net"
+, "sum"("nov_net") "nov_net"
+, "sum"("dec_net") "dec_net"
+FROM
+(
+      SELECT
+        "w_warehouse_name"
+      , "w_warehouse_sq_ft"
+      , "w_city"
+      , "w_county"
+      , "w_state"
+      , "w_country"
+      , "concat"("concat"('DHL', ','), 'BARIAN') "ship_carriers"
+      , "d_year" "YEAR"
+      , "sum"((CASE WHEN ("d_moy" = 1) THEN ("ws_ext_sales_price" * "ws_quantity") ELSE 0 END)) "jan_sales"
+      , "sum"((CASE WHEN ("d_moy" = 2) THEN ("ws_ext_sales_price" * "ws_quantity") ELSE 0 END)) "feb_sales"
+      , "sum"((CASE WHEN ("d_moy" = 3) THEN ("ws_ext_sales_price" * "ws_quantity") ELSE 0 END)) "mar_sales"
+      , "sum"((CASE WHEN ("d_moy" = 4) THEN ("ws_ext_sales_price" * "ws_quantity") ELSE 0 END)) "apr_sales"
+      , "sum"((CASE WHEN ("d_moy" = 5) THEN ("ws_ext_sales_price" * "ws_quantity") ELSE 0 END)) "may_sales"
+      , "sum"((CASE WHEN ("d_moy" = 6) THEN ("ws_ext_sales_price" * "ws_quantity") ELSE 0 END)) "jun_sales"
+      , "sum"((CASE WHEN ("d_moy" = 7) THEN ("ws_ext_sales_price" * "ws_quantity") ELSE 0 END)) "jul_sales"
+      , "sum"((CASE WHEN ("d_moy" = 8) THEN ("ws_ext_sales_price" * "ws_quantity") ELSE 0 END)) "aug_sales"
+      , "sum"((CASE WHEN ("d_moy" = 9) THEN ("ws_ext_sales_price" * "ws_quantity") ELSE 0 END)) "sep_sales"
+      , "sum"((CASE WHEN ("d_moy" = 10) THEN ("ws_ext_sales_price" * "ws_quantity") ELSE 0 END)) "oct_sales"
+      , "sum"((CASE WHEN ("d_moy" = 11) THEN ("ws_ext_sales_price" * "ws_quantity") ELSE 0 END)) "nov_sales"
+      , "sum"((CASE WHEN ("d_moy" = 12) THEN ("ws_ext_sales_price" * "ws_quantity") ELSE 0 END)) "dec_sales"
+      , "sum"((CASE WHEN ("d_moy" = 1) THEN ("ws_net_paid" * "ws_quantity") ELSE 0 END)) "jan_net"
+      , "sum"((CASE WHEN ("d_moy" = 2) THEN ("ws_net_paid" * "ws_quantity") ELSE 0 END)) "feb_net"
+      , "sum"((CASE WHEN ("d_moy" = 3) THEN ("ws_net_paid" * "ws_quantity") ELSE 0 END)) "mar_net"
+      , "sum"((CASE WHEN ("d_moy" = 4) THEN ("ws_net_paid" * "ws_quantity") ELSE 0 END)) "apr_net"
+      , "sum"((CASE WHEN ("d_moy" = 5) THEN ("ws_net_paid" * "ws_quantity") ELSE 0 END)) "may_net"
+      , "sum"((CASE WHEN ("d_moy" = 6) THEN ("ws_net_paid" * "ws_quantity") ELSE 0 END)) "jun_net"
+      , "sum"((CASE WHEN ("d_moy" = 7) THEN ("ws_net_paid" * "ws_quantity") ELSE 0 END)) "jul_net"
+      , "sum"((CASE WHEN ("d_moy" = 8) THEN ("ws_net_paid" * "ws_quantity") ELSE 0 END)) "aug_net"
+      , "sum"((CASE WHEN ("d_moy" = 9) THEN ("ws_net_paid" * "ws_quantity") ELSE 0 END)) "sep_net"
+      , "sum"((CASE WHEN ("d_moy" = 10) THEN ("ws_net_paid" * "ws_quantity") ELSE 0 END)) "oct_net"
+      , "sum"((CASE WHEN ("d_moy" = 11) THEN ("ws_net_paid" * "ws_quantity") ELSE 0 END)) "nov_net"
+      , "sum"((CASE WHEN ("d_moy" = 12) THEN ("ws_net_paid" * "ws_quantity") ELSE 0 END)) "dec_net"
+      FROM
+        web_sales
+      , warehouse
+      , date_dim
+      , time_dim
+      , ship_mode
+      WHERE ("ws_warehouse_sk" = "w_warehouse_sk")
+         AND ("ws_sold_date_sk" = "d_date_sk")
+         AND ("ws_sold_time_sk" = "t_time_sk")
+         AND ("ws_ship_mode_sk" = "sm_ship_mode_sk")
+         AND ("d_year" = 2001)
+         AND ("t_time" BETWEEN 30838 AND (30838 + 28800))
+         AND ("sm_carrier" IN ('DHL'      , 'BARIAN'))
+      GROUP BY "w_warehouse_name", "w_warehouse_sq_ft", "w_city", "w_county", "w_state", "w_country", "d_year"
+   UNION ALL
+      SELECT
+        "w_warehouse_name"
+      , "w_warehouse_sq_ft"
+      , "w_city"
+      , "w_county"
+      , "w_state"
+      , "w_country"
+      , "concat"("concat"('DHL', ','), 'BARIAN') "ship_carriers"
+      , "d_year" "YEAR"
+      , "sum"((CASE WHEN ("d_moy" = 1) THEN ("cs_sales_price" * "cs_quantity") ELSE 0 END)) "jan_sales"
+      , "sum"((CASE WHEN ("d_moy" = 2) THEN ("cs_sales_price" * "cs_quantity") ELSE 0 END)) "feb_sales"
+      , "sum"((CASE WHEN ("d_moy" = 3) THEN ("cs_sales_price" * "cs_quantity") ELSE 0 END)) "mar_sales"
+      , "sum"((CASE WHEN ("d_moy" = 4) THEN ("cs_sales_price" * "cs_quantity") ELSE 0 END)) "apr_sales"
+      , "sum"((CASE WHEN ("d_moy" = 5) THEN ("cs_sales_price" * "cs_quantity") ELSE 0 END)) "may_sales"
+      , "sum"((CASE WHEN ("d_moy" = 6) THEN ("cs_sales_price" * "cs_quantity") ELSE 0 END)) "jun_sales"
+      , "sum"((CASE WHEN ("d_moy" = 7) THEN ("cs_sales_price" * "cs_quantity") ELSE 0 END)) "jul_sales"
+      , "sum"((CASE WHEN ("d_moy" = 8) THEN ("cs_sales_price" * "cs_quantity") ELSE 0 END)) "aug_sales"
+      , "sum"((CASE WHEN ("d_moy" = 9) THEN ("cs_sales_price" * "cs_quantity") ELSE 0 END)) "sep_sales"
+      , "sum"((CASE WHEN ("d_moy" = 10) THEN ("cs_sales_price" * "cs_quantity") ELSE 0 END)) "oct_sales"
+      , "sum"((CASE WHEN ("d_moy" = 11) THEN ("cs_sales_price" * "cs_quantity") ELSE 0 END)) "nov_sales"
+      , "sum"((CASE WHEN ("d_moy" = 12) THEN ("cs_sales_price" * "cs_quantity") ELSE 0 END)) "dec_sales"
+      , "sum"((CASE WHEN ("d_moy" = 1) THEN ("cs_net_paid_inc_tax" * "cs_quantity") ELSE 0 END)) "jan_net"
+      , "sum"((CASE WHEN ("d_moy" = 2) THEN ("cs_net_paid_inc_tax" * "cs_quantity") ELSE 0 END)) "feb_net"
+      , "sum"((CASE WHEN ("d_moy" = 3) THEN ("cs_net_paid_inc_tax" * "cs_quantity") ELSE 0 END)) "mar_net"
+      , "sum"((CASE WHEN ("d_moy" = 4) THEN ("cs_net_paid_inc_tax" * "cs_quantity") ELSE 0 END)) "apr_net"
+      , "sum"((CASE WHEN ("d_moy" = 5) THEN ("cs_net_paid_inc_tax" * "cs_quantity") ELSE 0 END)) "may_net"
+      , "sum"((CASE WHEN ("d_moy" = 6) THEN ("cs_net_paid_inc_tax" * "cs_quantity") ELSE 0 END)) "jun_net"
+      , "sum"((CASE WHEN ("d_moy" = 7) THEN ("cs_net_paid_inc_tax" * "cs_quantity") ELSE 0 END)) "jul_net"
+      , "sum"((CASE WHEN ("d_moy" = 8) THEN ("cs_net_paid_inc_tax" * "cs_quantity") ELSE 0 END)) "aug_net"
+      , "sum"((CASE WHEN ("d_moy" = 9) THEN ("cs_net_paid_inc_tax" * "cs_quantity") ELSE 0 END)) "sep_net"
+      , "sum"((CASE WHEN ("d_moy" = 10) THEN ("cs_net_paid_inc_tax" * "cs_quantity") ELSE 0 END)) "oct_net"
+      , "sum"((CASE WHEN ("d_moy" = 11) THEN ("cs_net_paid_inc_tax" * "cs_quantity") ELSE 0 END)) "nov_net"
+      , "sum"((CASE WHEN ("d_moy" = 12) THEN ("cs_net_paid_inc_tax" * "cs_quantity") ELSE 0 END)) "dec_net"
+      FROM
+        catalog_sales
+      , warehouse
+      , date_dim
+      , time_dim
+      , ship_mode
+      WHERE ("cs_warehouse_sk" = "w_warehouse_sk")
+         AND ("cs_sold_date_sk" = "d_date_sk")
+         AND ("cs_sold_time_sk" = "t_time_sk")
+         AND ("cs_ship_mode_sk" = "sm_ship_mode_sk")
+         AND ("d_year" = 2001)
+         AND ("t_time" BETWEEN 30838 AND (30838 + 28800))
+         AND ("sm_carrier" IN ('DHL'      , 'BARIAN'))
+      GROUP BY "w_warehouse_name", "w_warehouse_sq_ft", "w_city", "w_county", "w_state", "w_country", "d_year"
+   )  x
+GROUP BY "w_warehouse_name", "w_warehouse_sq_ft", "w_city", "w_county", "w_state", "w_country", "ship_carriers", "year"
+ORDER BY "w_warehouse_name" ASC
+LIMIT 100""",
+    "q70": """
+        SELECT
+  "sum"("ss_net_profit") "total_sum"
+, "s_state"
+, "s_county"
+, (GROUPING ("s_state") + GROUPING ("s_county")) "lochierarchy"
+, "rank"() OVER (PARTITION BY (GROUPING ("s_state") + GROUPING ("s_county")), (CASE WHEN (GROUPING ("s_county") = 0) THEN "s_state" END) ORDER BY "sum"("ss_net_profit") DESC) "rank_within_parent"
+FROM
+  store_sales
+, date_dim d1
+, store
+WHERE ("d1"."d_month_seq" BETWEEN 1200 AND (1200 + 11))
+   AND ("d1"."d_date_sk" = "ss_sold_date_sk")
+   AND ("s_store_sk" = "ss_store_sk")
+   AND ("s_state" IN (
+   SELECT "s_state"
+   FROM
+     (
+      SELECT
+        "s_state" "s_state"
+      , "rank"() OVER (PARTITION BY "s_state" ORDER BY "sum"("ss_net_profit") DESC) "ranking"
+      FROM
+        store_sales
+      , store
+      , date_dim
+      WHERE ("d_month_seq" BETWEEN 1200 AND (1200 + 11))
+         AND ("d_date_sk" = "ss_sold_date_sk")
+         AND ("s_store_sk" = "ss_store_sk")
+      GROUP BY "s_state"
+   )  tmp1
+   WHERE ("ranking" <= 5)
+))
+GROUP BY ROLLUP (s_state, s_county)
+ORDER BY "lochierarchy" DESC, (CASE WHEN ("lochierarchy" = 0) THEN "s_state" END) ASC, "rank_within_parent" ASC
+LIMIT 100""",
+    "q74": """
+        WITH
+  year_total AS (
+   SELECT
+     "c_customer_id" "customer_id"
+   , "c_first_name" "customer_first_name"
+   , "c_last_name" "customer_last_name"
+   , "d_year" "YEAR"
+   , "sum"("ss_net_paid") "year_total"
+   , 's' "sale_type"
+   FROM
+     customer
+   , store_sales
+   , date_dim
+   WHERE ("c_customer_sk" = "ss_customer_sk")
+      AND ("ss_sold_date_sk" = "d_date_sk")
+      AND ("d_year" IN (2001   , (2001 + 1)))
+   GROUP BY "c_customer_id", "c_first_name", "c_last_name", "d_year"
+UNION ALL    SELECT
+     "c_customer_id" "customer_id"
+   , "c_first_name" "customer_first_name"
+   , "c_last_name" "customer_last_name"
+   , "d_year" "YEAR"
+   , "sum"("ws_net_paid") "year_total"
+   , 'w' "sale_type"
+   FROM
+     customer
+   , web_sales
+   , date_dim
+   WHERE ("c_customer_sk" = "ws_bill_customer_sk")
+      AND ("ws_sold_date_sk" = "d_date_sk")
+      AND ("d_year" IN (2001   , (2001 + 1)))
+   GROUP BY "c_customer_id", "c_first_name", "c_last_name", "d_year"
+) 
+SELECT
+  "t_s_secyear"."customer_id"
+, "t_s_secyear"."customer_first_name"
+, "t_s_secyear"."customer_last_name"
+FROM
+  year_total t_s_firstyear
+, year_total t_s_secyear
+, year_total t_w_firstyear
+, year_total t_w_secyear
+WHERE ("t_s_secyear"."customer_id" = "t_s_firstyear"."customer_id")
+   AND ("t_s_firstyear"."customer_id" = "t_w_secyear"."customer_id")
+   AND ("t_s_firstyear"."customer_id" = "t_w_firstyear"."customer_id")
+   AND ("t_s_firstyear"."sale_type" = 's')
+   AND ("t_w_firstyear"."sale_type" = 'w')
+   AND ("t_s_secyear"."sale_type" = 's')
+   AND ("t_w_secyear"."sale_type" = 'w')
+   AND ("t_s_firstyear"."year" = 2001)
+   AND ("t_s_secyear"."year" = (2001 + 1))
+   AND ("t_w_firstyear"."year" = 2001)
+   AND ("t_w_secyear"."year" = (2001 + 1))
+   AND ("t_s_firstyear"."year_total" > 0)
+   AND ("t_w_firstyear"."year_total" > 0)
+   AND ((CASE WHEN ("t_w_firstyear"."year_total" > 0) THEN ("t_w_secyear"."year_total" / "t_w_firstyear"."year_total") ELSE null END) > (CASE WHEN ("t_s_firstyear"."year_total" > 0) THEN ("t_s_secyear"."year_total" / "t_s_firstyear"."year_total") ELSE null END))
+ORDER BY 1 ASC, 1 ASC, 1 ASC
+LIMIT 100""",
+    "q77": """
+        WITH
+  ss AS (
+   SELECT
+     "s_store_sk"
+   , "sum"("ss_ext_sales_price") "sales"
+   , "sum"("ss_net_profit") "profit"
+   FROM
+     store_sales
+   , date_dim
+   , store
+   WHERE ("ss_sold_date_sk" = "d_date_sk")
+      AND ("d_date" BETWEEN CAST('2000-08-23' AS DATE) AND (CAST('2000-08-23' AS DATE) + INTERVAL  '30' DAY))
+      AND ("ss_store_sk" = "s_store_sk")
+   GROUP BY "s_store_sk"
+) 
+, sr AS (
+   SELECT
+     "s_store_sk"
+   , "sum"("sr_return_amt") "returns"
+   , "sum"("sr_net_loss") "profit_loss"
+   FROM
+     store_returns
+   , date_dim
+   , store
+   WHERE ("sr_returned_date_sk" = "d_date_sk")
+      AND ("d_date" BETWEEN CAST('2000-08-23' AS DATE) AND (CAST('2000-08-23' AS DATE) + INTERVAL  '30' DAY))
+      AND ("sr_store_sk" = "s_store_sk")
+   GROUP BY "s_store_sk"
+) 
+, cs AS (
+   SELECT
+     "cs_call_center_sk"
+   , "sum"("cs_ext_sales_price") "sales"
+   , "sum"("cs_net_profit") "profit"
+   FROM
+     catalog_sales
+   , date_dim
+   WHERE ("cs_sold_date_sk" = "d_date_sk")
+      AND ("d_date" BETWEEN CAST('2000-08-23' AS DATE) AND (CAST('2000-08-23' AS DATE) + INTERVAL  '30' DAY))
+   GROUP BY "cs_call_center_sk"
+) 
+, cr AS (
+   SELECT
+     "cr_call_center_sk"
+   , "sum"("cr_return_amount") "returns"
+   , "sum"("cr_net_loss") "profit_loss"
+   FROM
+     catalog_returns
+   , date_dim
+   WHERE ("cr_returned_date_sk" = "d_date_sk")
+      AND ("d_date" BETWEEN CAST('2000-08-23' AS DATE) AND (CAST('2000-08-23' AS DATE) + INTERVAL  '30' DAY))
+   GROUP BY "cr_call_center_sk"
+) 
+, ws AS (
+   SELECT
+     "wp_web_page_sk"
+   , "sum"("ws_ext_sales_price") "sales"
+   , "sum"("ws_net_profit") "profit"
+   FROM
+     web_sales
+   , date_dim
+   , web_page
+   WHERE ("ws_sold_date_sk" = "d_date_sk")
+      AND ("d_date" BETWEEN CAST('2000-08-23' AS DATE) AND (CAST('2000-08-23' AS DATE) + INTERVAL  '30' DAY))
+      AND ("ws_web_page_sk" = "wp_web_page_sk")
+   GROUP BY "wp_web_page_sk"
+) 
+, wr AS (
+   SELECT
+     "wp_web_page_sk"
+   , "sum"("wr_return_amt") "returns"
+   , "sum"("wr_net_loss") "profit_loss"
+   FROM
+     web_returns
+   , date_dim
+   , web_page
+   WHERE ("wr_returned_date_sk" = "d_date_sk")
+      AND ("d_date" BETWEEN CAST('2000-08-23' AS DATE) AND (CAST('2000-08-23' AS DATE) + INTERVAL  '30' DAY))
+      AND ("wr_web_page_sk" = "wp_web_page_sk")
+   GROUP BY "wp_web_page_sk"
+) 
+SELECT
+  "channel"
+, "id"
+, "sum"("sales") "sales"
+, "sum"("returns") "returns"
+, "sum"("profit") "profit"
+FROM
+  (
+   SELECT
+     'store channel' "channel"
+   , "ss"."s_store_sk" "id"
+   , "sales"
+   , COALESCE("returns", 0) "returns"
+   , ("profit" - COALESCE("profit_loss", 0)) "profit"
+   FROM
+     (ss
+   LEFT JOIN sr ON ("ss"."s_store_sk" = "sr"."s_store_sk"))
+UNION ALL    SELECT
+     'catalog channel' "channel"
+   , "cs_call_center_sk" "id"
+   , "sales"
+   , "returns"
+   , ("profit" - "profit_loss") "profit"
+   FROM
+     cs
+   , cr
+UNION ALL    SELECT
+     'web channel' "channel"
+   , "ws"."wp_web_page_sk" "id"
+   , "sales"
+   , COALESCE("returns", 0) "returns"
+   , ("profit" - COALESCE("profit_loss", 0)) "profit"
+   FROM
+     (ws
+   LEFT JOIN wr ON ("ws"."wp_web_page_sk" = "wr"."wp_web_page_sk"))
+)  x
+GROUP BY ROLLUP (channel, id)
+ORDER BY "channel" ASC, "id" ASC, "sales" ASC
+LIMIT 100""",
+    "q78": """
+        WITH
+  ws AS (
+   SELECT
+     "d_year" "ws_sold_year"
+   , "ws_item_sk"
+   , "ws_bill_customer_sk" "ws_customer_sk"
+   , "sum"("ws_quantity") "ws_qty"
+   , "sum"("ws_wholesale_cost") "ws_wc"
+   , "sum"("ws_sales_price") "ws_sp"
+   FROM
+     ((web_sales
+   LEFT JOIN web_returns ON ("wr_order_number" = "ws_order_number")
+      AND ("ws_item_sk" = "wr_item_sk"))
+   INNER JOIN date_dim ON ("ws_sold_date_sk" = "d_date_sk"))
+   WHERE ("wr_order_number" IS NULL)
+   GROUP BY "d_year", "ws_item_sk", "ws_bill_customer_sk"
+) 
+, cs AS (
+   SELECT
+     "d_year" "cs_sold_year"
+   , "cs_item_sk"
+   , "cs_bill_customer_sk" "cs_customer_sk"
+   , "sum"("cs_quantity") "cs_qty"
+   , "sum"("cs_wholesale_cost") "cs_wc"
+   , "sum"("cs_sales_price") "cs_sp"
+   FROM
+     ((catalog_sales
+   LEFT JOIN catalog_returns ON ("cr_order_number" = "cs_order_number")
+      AND ("cs_item_sk" = "cr_item_sk"))
+   INNER JOIN date_dim ON ("cs_sold_date_sk" = "d_date_sk"))
+   WHERE ("cr_order_number" IS NULL)
+   GROUP BY "d_year", "cs_item_sk", "cs_bill_customer_sk"
+) 
+, ss AS (
+   SELECT
+     "d_year" "ss_sold_year"
+   , "ss_item_sk"
+   , "ss_customer_sk"
+   , "sum"("ss_quantity") "ss_qty"
+   , "sum"("ss_wholesale_cost") "ss_wc"
+   , "sum"("ss_sales_price") "ss_sp"
+   FROM
+     ((store_sales
+   LEFT JOIN store_returns ON ("sr_ticket_number" = "ss_ticket_number")
+      AND ("ss_item_sk" = "sr_item_sk"))
+   INNER JOIN date_dim ON ("ss_sold_date_sk" = "d_date_sk"))
+   WHERE ("sr_ticket_number" IS NULL)
+   GROUP BY "d_year", "ss_item_sk", "ss_customer_sk"
+) 
+SELECT
+  "ss_sold_year"
+, "ss_item_sk"
+, "ss_customer_sk"
+, "round"((CAST("ss_qty" AS DECIMAL(10,2)) / COALESCE(("ws_qty" + "cs_qty"), 1)), 2) "ratio"
+, "ss_qty" "store_qty"
+, "ss_wc" "store_wholesale_cost"
+, "ss_sp" "store_sales_price"
+, (COALESCE("ws_qty", 0) + COALESCE("cs_qty", 0)) "other_chan_qty"
+, (COALESCE("ws_wc", 0) + COALESCE("cs_wc", 0)) "other_chan_wholesale_cost"
+, (COALESCE("ws_sp", 0) + COALESCE("cs_sp", 0)) "other_chan_sales_price"
+FROM
+  ((ss
+LEFT JOIN ws ON ("ws_sold_year" = "ss_sold_year")
+   AND ("ws_item_sk" = "ss_item_sk")
+   AND ("ws_customer_sk" = "ss_customer_sk"))
+LEFT JOIN cs ON ("cs_sold_year" = "ss_sold_year")
+   AND ("cs_item_sk" = "cs_item_sk")
+   AND ("cs_customer_sk" = "ss_customer_sk"))
+WHERE (COALESCE("ws_qty", 0) > 0)
+   AND (COALESCE("cs_qty", 0) > 0)
+   AND ("ss_sold_year" = 2000)
+ORDER BY "ss_sold_year" ASC, "ss_item_sk" ASC, "ss_customer_sk" ASC, "ss_qty" DESC, "ss_wc" DESC, "ss_sp" DESC, "other_chan_qty" ASC, "other_chan_wholesale_cost" ASC, "other_chan_sales_price" ASC, "round"((CAST("ss_qty" AS DECIMAL(10,2)) / COALESCE(("ws_qty" + "cs_qty"), 1)), 2) ASC
+LIMIT 100""",
+    "q94": """
+        SELECT
+  "count"(DISTINCT "ws_order_number") "order count"
+, "sum"("ws_ext_ship_cost") "total shipping cost"
+, "sum"("ws_net_profit") "total net profit"
+FROM
+  web_sales ws1
+, date_dim
+, customer_address
+, web_site
+WHERE ("d_date" BETWEEN CAST('1999-2-01' AS DATE) AND (CAST('1999-2-01' AS DATE) + INTERVAL  '60' DAY))
+   AND ("ws1"."ws_ship_date_sk" = "d_date_sk")
+   AND ("ws1"."ws_ship_addr_sk" = "ca_address_sk")
+   AND ("ca_state" = 'IL')
+   AND ("ws1"."ws_web_site_sk" = "web_site_sk")
+   AND ("web_company_name" = 'pri')
+   AND (EXISTS (
+   SELECT *
+   FROM
+     web_sales ws2
+   WHERE ("ws1"."ws_order_number" = "ws2"."ws_order_number")
+      AND ("ws1"."ws_warehouse_sk" <> "ws2"."ws_warehouse_sk")
+))
+   AND (NOT (EXISTS (
+   SELECT *
+   FROM
+     web_returns wr1
+   WHERE ("ws1"."ws_order_number" = "wr1"."wr_order_number")
+)))
+ORDER BY "count"(DISTINCT "ws_order_number") ASC
+LIMIT 100""",
+    "q35": """
+        SELECT
+  "ca_state"
+, "cd_gender"
+, "cd_marital_status"
+, "cd_dep_count"
+, "count"(*) "cnt1"
+, "min"("cd_dep_count")
+, "max"("cd_dep_count")
+, "avg"("cd_dep_count")
+, "cd_dep_employed_count"
+, "count"(*) "cnt2"
+, "min"("cd_dep_employed_count")
+, "max"("cd_dep_employed_count")
+, "avg"("cd_dep_employed_count")
+, "cd_dep_college_count"
+, "count"(*) "cnt3"
+, "min"("cd_dep_college_count")
+, "max"("cd_dep_college_count")
+, "avg"("cd_dep_college_count")
+FROM
+  customer c
+, customer_address ca
+, customer_demographics
+WHERE ("c"."c_current_addr_sk" = "ca"."ca_address_sk")
+   AND ("cd_demo_sk" = "c"."c_current_cdemo_sk")
+   AND (EXISTS (
+   SELECT *
+   FROM
+     store_sales
+   , date_dim
+   WHERE ("c"."c_customer_sk" = "ss_customer_sk")
+      AND ("ss_sold_date_sk" = "d_date_sk")
+      AND ("d_year" = 2002)
+      AND ("d_qoy" < 4)
+))
+   AND ((EXISTS (
+      SELECT *
+      FROM
+        web_sales
+      , date_dim
+      WHERE ("c"."c_customer_sk" = "ws_bill_customer_sk")
+         AND ("ws_sold_date_sk" = "d_date_sk")
+         AND ("d_year" = 2002)
+         AND ("d_qoy" < 4)
+   ))
+      OR (EXISTS (
+      SELECT *
+      FROM
+        catalog_sales
+      , date_dim
+      WHERE ("c"."c_customer_sk" = "cs_ship_customer_sk")
+         AND ("cs_sold_date_sk" = "d_date_sk")
+         AND ("d_year" = 2002)
+         AND ("d_qoy" < 4)
+   )))
+GROUP BY "ca_state", "cd_gender", "cd_marital_status", "cd_dep_count", "cd_dep_employed_count", "cd_dep_college_count"
+ORDER BY "ca_state" ASC, "cd_gender" ASC, "cd_marital_status" ASC, "cd_dep_count" ASC, "cd_dep_employed_count" ASC, "cd_dep_college_count" ASC
+LIMIT 100""",
+    "q97": """
+        WITH
+  ssci AS (
+   SELECT
+     "ss_customer_sk" "customer_sk"
+   , "ss_item_sk" "item_sk"
+   FROM
+     store_sales
+   , date_dim
+   WHERE ("ss_sold_date_sk" = "d_date_sk")
+      AND ("d_month_seq" BETWEEN 1200 AND (1200 + 11))
+   GROUP BY "ss_customer_sk", "ss_item_sk"
+) 
+, csci AS (
+   SELECT
+     "cs_bill_customer_sk" "customer_sk"
+   , "cs_item_sk" "item_sk"
+   FROM
+     catalog_sales
+   , date_dim
+   WHERE ("cs_sold_date_sk" = "d_date_sk")
+      AND ("d_month_seq" BETWEEN 1200 AND (1200 + 11))
+   GROUP BY "cs_bill_customer_sk", "cs_item_sk"
+) 
+SELECT
+  "sum"((CASE WHEN ("ssci"."customer_sk" IS NOT NULL)
+   AND ("csci"."customer_sk" IS NULL) THEN 1 ELSE 0 END)) "store_only"
+, "sum"((CASE WHEN ("ssci"."customer_sk" IS NULL)
+   AND ("csci"."customer_sk" IS NOT NULL) THEN 1 ELSE 0 END)) "catalog_only"
+, "sum"((CASE WHEN ("ssci"."customer_sk" IS NOT NULL)
+   AND ("csci"."customer_sk" IS NOT NULL) THEN 1 ELSE 0 END)) "store_and_catalog"
+FROM
+  (ssci
+FULL JOIN csci ON ("ssci"."customer_sk" = "csci"."customer_sk")
+   AND ("ssci"."item_sk" = "csci"."item_sk"))
+LIMIT 100""",
 }
 
 
