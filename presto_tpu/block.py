@@ -192,3 +192,56 @@ def _decode_column(dtype: T.DataType, data: np.ndarray, dictionary):
     if isinstance(dtype, T.DoubleType):
         return data.astype(np.float64)
     return data
+
+
+# --- ARRAY column bridging (host object lists <-> padded 2D device) --------
+
+
+def pad_object_lists(element: T.DataType, data: np.ndarray):
+    """Host object array of Python lists -> (data2d, lengths, emask,
+    dictionary) in the fixed-capacity device layout (expr/compile.Val).
+    NULL rows / NULL elements become dead padding."""
+    n = len(data)
+    lens = np.array([0 if row is None else len(row) for row in data],
+                    np.int32)
+    cap = max(int(lens.max()) if n else 1, 1)
+    emask = np.zeros((n, cap), bool)
+    if isinstance(element, T.VarcharType):
+        vocab = sorted({str(x) for row in data if row is not None
+                        for x in row if x is not None})
+        code_of = {x: i for i, x in enumerate(vocab)}
+        d2 = np.zeros((n, cap), np.int32)
+        for i, row in enumerate(data):
+            for j, x in enumerate(row or ()):
+                if x is not None:
+                    d2[i, j] = code_of[str(x)]
+                    emask[i, j] = True
+        return d2, lens, emask, np.array(vocab, dtype=object)
+    d2 = np.zeros((n, cap), element.physical_dtype)
+    for i, row in enumerate(data):
+        for j, x in enumerate(row or ()):
+            if x is not None:
+                d2[i, j] = x
+                emask[i, j] = True
+    return d2, lens, emask, None
+
+
+def lists_from_padded(element: T.DataType, data2d: np.ndarray,
+                      lengths: np.ndarray, emask: np.ndarray | None,
+                      dictionary) -> np.ndarray:
+    """Inverse of pad_object_lists: decode to an object array of
+    Python lists (NULL elements as None)."""
+    n, cap = data2d.shape[:2]
+    flat = _decode_column(element, data2d.reshape(-1),
+                          dictionary).reshape(n, cap)
+    out = np.empty(n, object)
+    for i in range(n):
+        ln = int(lengths[i])
+        row = []
+        for j in range(ln):
+            if emask is not None and not emask[i, j]:
+                row.append(None)
+            else:
+                row.append(flat[i, j])
+        out[i] = row
+    return out

@@ -76,11 +76,23 @@ def collect_scans(plan: N.PlanNode, engine) -> list[ScanInput]:
             arrays, dicts, types = {}, {}, {}
             for sym, colname in node.assignments.items():
                 col = tbl.columns[colname]
-                arrays[sym] = np.asarray(col.data)
+                if isinstance(col.dtype, T.ArrayType) and np.asarray(
+                        col.data).dtype == object:
+                    # host object lists (varlen-aggregate outputs) ->
+                    # padded 2D device layout + companion arrays
+                    from presto_tpu.block import pad_object_lists
+                    d2, lens, emask, d = pad_object_lists(
+                        col.dtype.element, np.asarray(col.data))
+                    arrays[sym] = d2
+                    arrays[f"{sym}$len"] = lens
+                    arrays[f"{sym}$emask"] = emask
+                    dicts[sym] = d
+                else:
+                    arrays[sym] = np.asarray(col.data)
+                    dicts[sym] = col.dictionary
                 if col.valid is not None:
                     # NULL masks ship as sibling arrays (spi Block.isNull)
                     arrays[f"{sym}$valid"] = np.asarray(col.valid)
-                dicts[sym] = col.dictionary
                 types[sym] = col.dtype
             if tbl.mask is not None:
                 # table-level row mask (padded exchange buffers ship a
@@ -219,7 +231,9 @@ class PlanInterpreter:
         for sym in node.assignments:
             cols[sym] = Val(scan.types[sym], traced[sym],
                             traced.get(f"{sym}$valid"),
-                            scan.dictionaries[sym])
+                            scan.dictionaries[sym],
+                            traced.get(f"{sym}$len"),
+                            traced.get(f"{sym}$emask"))
         # block-streamed scans pad the last block; the pad rows are dead
         nrows = next(iter(traced.values())).shape[0] if traced else scan.nrows
         return DTable(cols, traced.get("__live__"), nrows)
@@ -347,6 +361,9 @@ class PlanInterpreter:
         self._note_ok(node, ok)
         return out
 
+    def _r_unnest(self, node: N.Unnest) -> DTable:
+        return OP.apply_unnest(self.run(node.source), node)
+
     def _r_exchange(self, node: N.Exchange) -> DTable:
         # single-device execution: exchanges are no-ops (the sharded
         # executor in parallel/ lowers them to collectives)
@@ -386,6 +403,11 @@ def make_traced(scan_inputs: list[ScanInput], plan: N.PlanNode,
             res.append(v.data)
             res.append(v.valid if v.valid is not None
                        else jnp.ones((out.n,), dtype=bool))
+            if v.is_array:
+                # arrays ship lengths + element mask after (data, valid)
+                res.append(v.lengths)
+                res.append(v.elem_valid if v.elem_valid is not None
+                           else jnp.ones(v.data.shape, dtype=bool))
         # ok flags ship as ONE stacked array: a tuple of device scalars
         # costs one host round-trip EACH to inspect (~90ms over a
         # tunneled device), a (k,) bool array costs one total
@@ -569,6 +591,10 @@ def run_plan_device(engine, plan: N.PlanNode,
         if has_valid:
             arrays[f"{sym}$valid"] = res[i + 1]
         i += 2
+        if isinstance(dtype, T.ArrayType):
+            arrays[f"{sym}$len"] = res[i]
+            arrays[f"{sym}$emask"] = res[i + 1]
+            i += 2
         dicts[sym] = dictionary
         types[sym] = dtype
     n = int(live.shape[0])
@@ -707,6 +733,17 @@ def run_plan(engine, plan: N.PlanNode,
             data = res_np[i]
             valid = res_np[i + 1]
             i += 2
+            if isinstance(dtype, T.ArrayType):
+                from presto_tpu.block import lists_from_padded
+                lengths, emask = res_np[i], res_np[i + 1]
+                i += 2
+                data = lists_from_padded(dtype.element, data, lengths,
+                                         emask, dictionary)
+                cols[sym] = Column(
+                    dtype, data,
+                    valid if has_valid or not valid.all() else None,
+                    None)
+                continue
             cols[sym] = Column(
                 dtype, data,
                 valid if has_valid or not valid.all() else None,

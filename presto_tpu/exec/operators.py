@@ -59,7 +59,21 @@ def apply_project(dt: DTable, assignments: dict[str, ir.Expr]) -> DTable:
     for sym, expr in assignments.items():
         v = c.compile(expr)
         data = v.data
-        if getattr(data, "ndim", 1) == 0:  # broadcast scalar literal
+        if v.is_array:
+            # literal arrays built from scalars have one row: broadcast
+            # to the table's row count
+            if data.shape[0] == 1 and dt.n != 1:
+                data = jnp.broadcast_to(data, (dt.n,) + data.shape[1:])
+                lengths = jnp.broadcast_to(v.lengths, (dt.n,))
+                ev = (jnp.broadcast_to(
+                    v.elem_valid, (dt.n,) + v.elem_valid.shape[1:])
+                    if v.elem_valid is not None else None)
+                valid = v.valid
+                if valid is not None and valid.shape[0] == 1:
+                    valid = jnp.broadcast_to(valid, (dt.n,))
+                v = Val(v.dtype, data, valid, v.dictionary, lengths,
+                        ev, v.map_keys)
+        elif getattr(data, "ndim", 1) == 0:  # broadcast scalar literal
             data = jnp.broadcast_to(data, (dt.n,))
             valid = v.valid
             if valid is not None and getattr(valid, "ndim", 1) == 0:
@@ -1332,6 +1346,61 @@ def _segmented_scan(vals, restart, op):
 
     out, _ = jax.lax.associative_scan(combine, (vals, restart))
     return out
+
+
+def apply_unnest(dt: DTable, node: N.Unnest) -> DTable:
+    """Expand array elements into rows (reference UnnestOperator over
+    UnnestNode): output row (i, j) carries source row i's columns and
+    each array's j-th element; static output size n * max_capacity.
+    Multiple arrays zip to the longest length (NULL-padding shorter
+    ones); NULL arrays produce no rows."""
+    arrays = [dt.cols[s] for s in node.array_syms]
+    cap = max(a.data.shape[1] for a in arrays)
+    n = dt.n
+    live = dt.live_mask()
+
+    # per-row zip length: max of array lengths (NULL array counts 0)
+    zlen = None
+    for a in arrays:
+        ln = a.lengths
+        if a.valid is not None:
+            ln = jnp.where(a.valid, ln, 0)
+        zlen = ln if zlen is None else jnp.maximum(zlen, ln)
+
+    out: dict[str, Val] = {}
+    for sym, v in dt.cols.items():
+        if sym in node.array_syms and sym not in node.out_syms:
+            continue  # consumed arrays drop from the output
+        data = jnp.repeat(v.data, cap, axis=0)
+        valid = (None if v.valid is None
+                 else jnp.repeat(v.valid, cap, axis=0))
+        out[sym] = Val(v.dtype, data, valid, v.dictionary,
+                       None if v.lengths is None
+                       else jnp.repeat(v.lengths, cap, axis=0),
+                       None if v.elem_valid is None
+                       else jnp.repeat(v.elem_valid, cap, axis=0))
+    j = jnp.tile(jnp.arange(cap, dtype=jnp.int32), n)
+    for osym, asym in zip(node.out_syms, node.array_syms):
+        a = dt.cols[asym]
+        acap = a.data.shape[1]
+        data2, em2 = a.data, a.elem_valid
+        if acap != cap:  # re-pad to the common capacity
+            data2 = jnp.pad(data2, [(0, 0), (0, cap - acap)])
+            if em2 is not None:
+                em2 = jnp.pad(em2, [(0, 0), (0, cap - acap)])
+        flat = data2.reshape(n * cap)
+        em = em2.reshape(n * cap) if em2 is not None else None
+        within = j < jnp.repeat(a.lengths, cap)
+        if a.valid is not None:
+            within = within & jnp.repeat(a.valid, cap)
+        valid = within if em is None else (within & em)
+        out[osym] = Val(node.out_types[osym], flat, valid,
+                        a.dictionary)
+    if node.ordinality_sym:
+        out[node.ordinality_sym] = Val(
+            T.BIGINT, (j + 1).astype(jnp.int64), None)
+    out_live = jnp.repeat(live, cap) & (j < jnp.repeat(zlen, cap))
+    return DTable(out, out_live, n * cap)
 
 
 def apply_mark_distinct(dt: DTable, node: N.MarkDistinct,

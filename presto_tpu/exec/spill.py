@@ -104,11 +104,21 @@ def _carrier_scan(name: str, tbl: Table, pad_to: int | None = None
     dicts: dict[str, np.ndarray | None] = {}
     for s, c in tbl.columns.items():
         a = np.asarray(c.data)
-        arrays[s] = np.pad(a, [(0, total - n)] + [(0, 0)] * (a.ndim - 1))
+        if isinstance(c.dtype, T.ArrayType) and a.dtype == object:
+            from presto_tpu.block import pad_object_lists
+            d2, lens, emask, d = pad_object_lists(c.dtype.element, a)
+            arrays[s] = np.pad(d2, [(0, total - n), (0, 0)])
+            arrays[f"{s}$len"] = np.pad(lens, (0, total - n))
+            arrays[f"{s}$emask"] = np.pad(emask,
+                                          [(0, total - n), (0, 0)])
+            dicts[s] = d
+        else:
+            arrays[s] = np.pad(a, [(0, total - n)]
+                               + [(0, 0)] * (a.ndim - 1))
+            dicts[s] = c.dictionary
         if c.valid is not None:
             arrays[f"{s}$valid"] = np.pad(np.asarray(c.valid),
                                           (0, total - n))
-        dicts[s] = c.dictionary
     if pad_to is not None:
         arrays["__live__"] = np.arange(total) < n
     return node, ScanInput(node, arrays, dicts, types, total)

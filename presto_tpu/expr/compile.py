@@ -25,6 +25,7 @@ import dataclasses
 import re
 from typing import Callable
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -34,14 +35,41 @@ from presto_tpu.expr import ir
 
 @dataclasses.dataclass
 class Val:
+    """One columnar value during trace.
+
+    Scalar columns: data [n]. ARRAY columns are FIXED-CAPACITY padded
+    2D device values — data [n, cap] element values (codes for string
+    elements), ``lengths`` [n] element counts, ``elem_valid`` [n, cap]
+    per-element non-NULL mask (None = no NULL elements); positions past
+    the length are dead padding. MAP columns additionally carry their
+    key array in ``map_keys``. The 2D layout keeps every array
+    operation (constructors, subscripts, lambdas, unnest) inside the
+    traced XLA program — the TPU-native answer to the reference's
+    variable-width ArrayBlock (spi/block/ArrayBlock.java)."""
+
     dtype: T.DataType
     data: object
     valid: object | None = None
     dictionary: np.ndarray | None = None
+    lengths: object | None = None
+    elem_valid: object | None = None
+    map_keys: "Val | None" = None
 
     @property
     def is_string(self) -> bool:
         return isinstance(self.dtype, T.VarcharType)
+
+    @property
+    def is_array(self) -> bool:
+        return isinstance(self.dtype, T.ArrayType)
+
+    def elem_mask(self):
+        """[n, cap] mask of live (present, non-NULL) elements."""
+        cap = self.data.shape[1]
+        m = jnp.arange(cap)[None, :] < self.lengths[:, None]
+        if self.elem_valid is not None:
+            m = m & self.elem_valid
+        return m
 
 
 def and_valid(*vs):
@@ -57,6 +85,10 @@ def and_valid(*vs):
 
 def _bool(data, valid=None) -> Val:
     return Val(T.BOOLEAN, data, valid)
+
+
+# column bindings of the innermost _c_call in flight (lambda captures)
+_COMPILER_COLUMNS: list[dict] = []
 
 
 # --- dictionary helpers (host side, trace time) ----------------------------
@@ -201,7 +233,18 @@ class ExprCompiler:
         fn = SCALARS.get(e.fn)
         if fn is None:
             raise NotImplementedError(f"scalar function {e.fn}")
-        return fn(e, args)
+        # higher-order kernels re-enter compilation for lambda bodies
+        # and need this call's column bindings (outer captures)
+        _COMPILER_COLUMNS.append(self.columns)
+        try:
+            return fn(e, args)
+        finally:
+            _COMPILER_COLUMNS.pop()
+
+    def _c_lambda(self, e: "ir.Lambda") -> Val:
+        # lambdas are not values: higher-order kernels read them from
+        # e.args and bind the params themselves
+        return Val(e.dtype, None)
 
 
 def _merge_dicts(a: Val, b: Val) -> tuple[Val, Val]:
@@ -222,9 +265,60 @@ def _merge_dicts(a: Val, b: Val) -> tuple[Val, Val]:
 # --- casts -----------------------------------------------------------------
 
 
+def _parse_numeric_dictionary(v: Val, to: T.DataType) -> Val:
+    """varchar -> numeric cast: parse each DICTIONARY entry host-side
+    into a LUT, rows gather by code; malformed strings become NULL
+    (try_cast) / the row's validity carries the failure."""
+    k = len(v.dictionary)
+    ok = np.zeros(k, bool)
+    if isinstance(to, T.DoubleType):
+        vals = np.zeros(k, np.float64)
+        for i, s in enumerate(v.dictionary):
+            try:
+                vals[i] = float(str(s).strip())
+                ok[i] = True
+            except ValueError:
+                pass
+    elif isinstance(to, T.DecimalType):
+        from decimal import Decimal, InvalidOperation
+        vals = np.zeros(k, np.int64)
+        for i, s in enumerate(v.dictionary):
+            try:
+                vals[i] = int(Decimal(str(s).strip())
+                              .scaleb(to.scale).to_integral_value())
+                ok[i] = True
+            except (InvalidOperation, ValueError, OverflowError):
+                pass
+    else:
+        vals = np.zeros(k, to.physical_dtype)
+        for i, s in enumerate(v.dictionary):
+            t = str(s).strip()
+            try:
+                vals[i] = int(t)
+                ok[i] = True
+            except ValueError:
+                try:  # integral-valued decimals cast too ('5.0')
+                    f = float(t)
+                    if f == int(f):
+                        vals[i] = int(f)
+                        ok[i] = True
+                except (ValueError, OverflowError):
+                    pass
+    codes = jnp.clip(v.data, 0, max(k - 1, 0))
+    data = (jnp.asarray(vals)[codes] if k
+            else jnp.zeros_like(v.data, dtype=vals.dtype))
+    okrow = (jnp.asarray(ok)[codes] if k
+             else jnp.zeros_like(v.data, dtype=bool))
+    return Val(to, data, and_valid(v.valid, okrow))
+
+
 def cast_val(v: Val, to: T.DataType) -> Val:
     if v.dtype == to:
         return v
+    if v.is_string and isinstance(
+            to, (T.BigintType, T.IntegerType, T.DoubleType,
+                 T.DecimalType)) and v.dictionary is not None:
+        return _parse_numeric_dictionary(v, to)
     d = v.data
     if isinstance(to, T.DoubleType):
         if isinstance(v.dtype, T.DecimalType):
@@ -612,8 +706,7 @@ def _regexp_extract(e, args):
     return Val(v.dtype, v.data, valid, v.dictionary)
 
 
-@scalar("contains")
-def _contains(e, args):
+def _string_contains(e, args):
     col = args[0]
     if not isinstance(e.args[1], ir.Literal):
         raise NotImplementedError("contains with non-literal needle")
@@ -1021,6 +1114,8 @@ _CONCAT_PRODUCT_MAX = 1 << 16
 @scalar("concat")
 def _concat(e, args):
     a, b = args
+    if a.is_array and b.is_array:
+        return _array_concat_fn(e, args)
     if len(a.dictionary) == 1:  # literal + column
         s = str(a.dictionary[0])
         return _dict_transform(b, lambda d: np.array([s + x for x in d], object))
@@ -1428,3 +1523,453 @@ def _json_identity(e, args):
     # format are type adapters with no physical change
     a = args[0]
     return Val(T.VARCHAR, a.data, a.valid, a.dictionary)
+
+
+# --- arrays / maps (fixed-capacity 2D device layout; see Val) ---------------
+
+
+def _elem_string(t: T.DataType) -> bool:
+    return isinstance(t, T.VarcharType)
+
+
+def _broadcast_cols_2d(columns: dict[str, Val], cap: int) -> dict:
+    """Outer scalar columns as [n, 1] views so lambda bodies broadcast
+    against [n, cap] element values."""
+    out = {}
+    for sym, v in columns.items():
+        if v.is_array or getattr(v.data, "ndim", 1) != 1:
+            out[sym] = v
+            continue
+        out[sym] = Val(v.dtype, v.data[:, None],
+                       None if v.valid is None else v.valid[:, None],
+                       v.dictionary)
+    return out
+
+
+def _bind_lambda(lam: ir.Lambda, arrays: list[Val],
+                 columns: dict[str, Val] | None = None) -> Val:
+    """Compile a lambda body with each param bound to its array's
+    [n, cap] element values (outer columns broadcast to [n, 1]);
+    returns the body's [n, cap] Val."""
+    if columns is None:
+        columns = _COMPILER_COLUMNS[-1] if _COMPILER_COLUMNS else {}
+    cap = arrays[0].data.shape[1]
+    cols = _broadcast_cols_2d(columns, cap)
+    for p, arr in zip(lam.params, arrays):
+        ev = arr.elem_mask()
+        cols[p] = Val(arr.dtype.element, arr.data,
+                      ev if arr.elem_valid is not None else None,
+                      arr.dictionary)
+    return ExprCompiler(cols).compile(lam.body)
+
+
+@scalar("array_ctor")
+def _array_ctor(e, args):
+    """ARRAY[e1, ..., ek]: stack k scalar columns into [n, k]."""
+    if not args:
+        return Val(e.dtype, jnp.zeros((1, 1), jnp.int64), None, None,
+                   jnp.zeros((1,), jnp.int32), None)
+    et = e.dtype.element
+    if _elem_string(et):
+        base = args[0]
+        unified = [base]
+        for v in args[1:]:
+            v, base = _merge_dicts(v, base)
+            unified.append(v)
+        # re-unify earlier args against the final dictionary
+        args = [_merge_dicts(v, base)[0] for v in unified]
+        dictionary = args[0].dictionary
+    else:
+        dictionary = None
+    n = None
+    for v in args:
+        if getattr(v.data, "ndim", 0) == 1:
+            n = v.data.shape[0]
+            break
+    if n is None:
+        n = 1
+    datas = []
+    valids = []
+    for v in args:
+        d = v.data
+        if getattr(d, "ndim", 0) == 0:
+            d = jnp.broadcast_to(d, (n,))
+        datas.append(d)
+        va = v.valid
+        if va is None:
+            va = jnp.ones((n,), bool)
+        elif getattr(va, "ndim", 0) == 0:
+            va = jnp.broadcast_to(va, (n,))
+        valids.append(va)
+    data = jnp.stack(datas, axis=1)
+    elem_valid = jnp.stack(valids, axis=1)
+    lengths = jnp.full((n,), len(args), jnp.int32)
+    return Val(e.dtype, data, None, dictionary, lengths, elem_valid)
+
+
+@scalar("element_at")
+@scalar("subscript")
+def _element_at(e, args):
+    v, idx = args
+    if isinstance(v.dtype, T.MapType):
+        # map lookup: position of the matching key
+        keys = v.map_keys
+        if _elem_string(keys.dtype.element) and idx.is_string:
+            kd, _ = _align_strings(
+                Val(T.VARCHAR, keys.data, None, keys.dictionary), idx)
+            want = idx.data
+            hit = (kd == (want[:, None] if getattr(
+                want, "ndim", 0) == 1 else want)) & keys.elem_mask()
+        else:
+            want = idx.data
+            hit = (keys.data == (want[:, None] if getattr(
+                want, "ndim", 0) == 1 else want)) & keys.elem_mask()
+        pos = jnp.argmax(hit, axis=1)
+        found = jnp.any(hit, axis=1)
+        data = jnp.take_along_axis(v.data, pos[:, None], axis=1)[:, 0]
+        ev = (jnp.take_along_axis(v.elem_valid, pos[:, None],
+                                  axis=1)[:, 0]
+              if v.elem_valid is not None else True)
+        valid = and_valid(v.valid, found & ev)
+        return Val(e.dtype, data, valid, v.dictionary)
+    # SQL arrays are 1-based; out-of-range -> NULL
+    cap = v.data.shape[1]
+    i0 = idx.data - 1
+    if getattr(i0, "ndim", 0) == 0:
+        i0 = jnp.broadcast_to(i0, (v.data.shape[0],))
+    in_range = (i0 >= 0) & (i0 < v.lengths.astype(i0.dtype))
+    pos = jnp.clip(i0, 0, cap - 1).astype(jnp.int32)
+    data = jnp.take_along_axis(v.data, pos[:, None], axis=1)[:, 0]
+    ev = (jnp.take_along_axis(v.elem_valid, pos[:, None], axis=1)[:, 0]
+          if v.elem_valid is not None else True)
+    valid = and_valid(v.valid, and_valid(idx.valid, in_range & ev))
+    return Val(e.dtype, data, valid, v.dictionary)
+
+
+@scalar("cardinality")
+def _cardinality(e, args):
+    (v,) = args
+    return Val(e.dtype, v.lengths.astype(jnp.int64), v.valid)
+
+
+@scalar("contains")
+def _contains_dispatch(e, args):
+    v, x = args
+    if not v.is_array:  # string contains (substring test) kept as-is
+        return _string_contains(e, args)
+    if _elem_string(v.dtype.element) and x.is_string:
+        vd, _ = _align_strings(
+            Val(T.VARCHAR, v.data, None, v.dictionary), x)
+        want = x.data
+    else:
+        vd, want = v.data, x.data
+    if getattr(want, "ndim", 0) <= 1:
+        want = want[..., None] if getattr(want, "ndim", 0) else want
+    hit = (vd == want) & v.elem_mask()
+    return Val(e.dtype, jnp.any(hit, axis=1),
+               and_valid(v.valid, x.valid))
+
+
+@scalar("transform")
+def _transform(e, args):
+    v = args[0]
+    lam = e.args[1]
+    body = _bind_lambda(lam, [v])
+    data = body.data
+    if getattr(data, "ndim", 0) != 2:
+        data = jnp.broadcast_to(data, v.data.shape)
+    # an outer-column capture widens a literal array's single row to
+    # the table's row count: companion arrays follow the body shape
+    n_out = data.shape[0]
+    lengths = v.lengths
+    if lengths.shape[0] != n_out:
+        lengths = jnp.broadcast_to(lengths, (n_out,))
+    valid = v.valid
+    if valid is not None and valid.shape[0] != n_out:
+        valid = jnp.broadcast_to(valid, (n_out,))
+    ev = body.valid
+    if ev is not None and ev.shape != data.shape:
+        ev = jnp.broadcast_to(ev, data.shape)
+    return Val(e.dtype, data, valid, body.dictionary, lengths, ev)
+
+
+@scalar("filter")
+def _filter_array(e, args):
+    v = args[0]
+    lam = e.args[1]
+    body = _bind_lambda(lam, [v])
+    keep = body.data
+    if body.valid is not None:
+        keep = keep & body.valid
+    # PRESENT positions only (a NULL element the lambda accepts stays:
+    # Trino filter(array[1,null], x -> x IS NULL) keeps the NULL)
+    cap = v.data.shape[1]
+    present = jnp.arange(cap)[None, :] < v.lengths[:, None]
+    keep = keep & present
+    key = (~keep).astype(jnp.int32)
+    pos = jnp.broadcast_to(jnp.arange(cap, dtype=jnp.int32),
+                           v.data.shape)
+    operands = [key, pos, v.data]
+    has_ev = v.elem_valid is not None
+    if has_ev:
+        operands.append(v.elem_valid)
+    out = jax.lax.sort(tuple(operands), num_keys=2, is_stable=True,
+                       dimension=1)
+    data = out[2]
+    elem_valid = out[3] if has_ev else None
+    lengths = jnp.sum(keep, axis=1).astype(jnp.int32)
+    return Val(e.dtype, data, v.valid, v.dictionary, lengths,
+               elem_valid)
+
+
+@scalar("reduce")
+def _reduce_array(e, args):
+    v, init = args[0], args[1]
+    lam = e.args[2]  # (acc, x) -> expr
+    out_lam = e.args[3] if len(e.args) > 3 else None
+    n, cap = v.data.shape
+    acc_t = init.dtype
+    acc_data = init.data
+    if getattr(acc_data, "ndim", 0) == 0:
+        acc_data = jnp.broadcast_to(acc_data, (n,))
+    acc = Val(acc_t, acc_data, init.valid)
+    mask = v.elem_mask()
+    for j in range(cap):
+        elem = Val(v.dtype.element, v.data[:, j], None, v.dictionary)
+        cols = dict(_COMPILER_COLUMNS[-1]) if _COMPILER_COLUMNS else {}
+        cols[lam.params[0]] = acc
+        cols[lam.params[1]] = elem
+        stepped = ExprCompiler(cols).compile(lam.body)
+        take = mask[:, j]
+        sd = stepped.data
+        if getattr(sd, "ndim", 0) == 0:
+            sd = jnp.broadcast_to(sd, (n,))
+        new_data = jnp.where(take, sd, acc.data)
+        if acc.valid is None and stepped.valid is None:
+            new_valid = None
+        else:
+            av = acc.valid if acc.valid is not None \
+                else jnp.ones((n,), bool)
+            sv = stepped.valid if stepped.valid is not None \
+                else jnp.ones((n,), bool)
+            new_valid = jnp.where(take, sv, av)
+        acc = Val(acc_t, new_data, new_valid)
+    if out_lam is not None:
+        cols = dict(_COMPILER_COLUMNS[-1]) if _COMPILER_COLUMNS else {}
+        cols[out_lam.params[0]] = acc
+        acc = ExprCompiler(cols).compile(out_lam.body)
+    return Val(e.dtype, acc.data, and_valid(v.valid, acc.valid))
+
+
+def _match_reduce(e, args, op):
+    v = args[0]
+    lam = e.args[1]
+    body = _bind_lambda(lam, [v])
+    hit = body.data
+    if body.valid is not None:
+        hit = hit & body.valid
+    m = v.elem_mask()
+    if op == "any":
+        out = jnp.any(hit & m, axis=1)
+    else:
+        out = jnp.all(jnp.where(m, hit, True), axis=1)
+    return Val(e.dtype, out, v.valid)
+
+
+@scalar("any_match")
+def _any_match(e, args):
+    return _match_reduce(e, args, "any")
+
+
+@scalar("all_match")
+def _all_match(e, args):
+    return _match_reduce(e, args, "all")
+
+
+@scalar("none_match")
+def _none_match(e, args):
+    r = _match_reduce(e, args, "any")
+    return Val(e.dtype, ~r.data, r.valid)
+
+
+@scalar("array_position")
+def _array_position(e, args):
+    v, x = args
+    if _elem_string(v.dtype.element) and x.is_string:
+        vd, _ = _align_strings(
+            Val(T.VARCHAR, v.data, None, v.dictionary), x)
+        want = x.data
+    else:
+        vd, want = v.data, x.data
+    if getattr(want, "ndim", 0) == 1:
+        want = want[:, None]
+    hit = (vd == want) & v.elem_mask()
+    pos = jnp.argmax(hit, axis=1) + 1
+    found = jnp.any(hit, axis=1)
+    return Val(e.dtype, jnp.where(found, pos, 0).astype(jnp.int64),
+               and_valid(v.valid, x.valid))
+
+
+@scalar("array_max")
+@scalar("array_min")
+def _array_minmax(e, args):
+    (v,) = args
+    is_max = e.fn == "array_max"
+    m = v.elem_mask()
+    if jnp.issubdtype(v.data.dtype, jnp.integer):
+        ident = (jnp.iinfo(v.data.dtype).min if is_max
+                 else jnp.iinfo(v.data.dtype).max)
+    else:
+        ident = -jnp.inf if is_max else jnp.inf
+    masked = jnp.where(m, v.data, ident)
+    out = masked.max(axis=1) if is_max else masked.min(axis=1)
+    nonempty = jnp.any(m, axis=1)
+    return Val(e.dtype, out, and_valid(v.valid, nonempty),
+               v.dictionary)
+
+
+@scalar("array_sum")
+def _array_sum(e, args):
+    (v,) = args
+    m = v.elem_mask()
+    out = jnp.sum(jnp.where(m, v.data, 0), axis=1)
+    return Val(e.dtype, out, v.valid)
+
+
+@scalar("array_concat_fn")
+def _array_concat_fn(e, args):
+    a, b = args
+    if _elem_string(e.dtype.element):
+        av = Val(T.VARCHAR, a.data, None, a.dictionary)
+        bv = Val(T.VARCHAR, b.data, None, b.dictionary)
+        av, bv = _merge_dicts(av, bv)
+        a = dataclasses.replace(a, data=av.data,
+                                dictionary=av.dictionary)
+        b = dataclasses.replace(b, data=bv.data,
+                                dictionary=bv.dictionary)
+    n, ca = a.data.shape
+    cb = b.data.shape[1]
+    # concatenate then compact b's elements to follow a's lengths
+    data = jnp.concatenate([a.data, b.data], axis=1)
+    am, bm = a.elem_mask(), b.elem_mask()
+    keep = jnp.concatenate([am, bm], axis=1)
+    pos = jnp.broadcast_to(jnp.arange(ca + cb, dtype=jnp.int32),
+                           data.shape)
+    out = jax.lax.sort(((~keep).astype(jnp.int32), pos, data),
+                       num_keys=2, is_stable=True, dimension=1)
+    lengths = (jnp.sum(am, axis=1) + jnp.sum(bm, axis=1)) \
+        .astype(jnp.int32)
+    return Val(e.dtype, out[2], and_valid(a.valid, b.valid),
+               a.dictionary, lengths, None)
+
+
+@scalar("array_distinct")
+def _array_distinct(e, args):
+    (v,) = args
+    m = v.elem_mask()
+    n, cap = v.data.shape
+    # sort elements (dead padding last), mark the first of each equal
+    # run, compact the marks. Output order is value-sorted, NOT
+    # first-occurrence order (Trino preserves occurrence order;
+    # documented divergence).
+    big = jnp.where(m, v.data, jnp.asarray(
+        jnp.iinfo(v.data.dtype).max if jnp.issubdtype(
+            v.data.dtype, jnp.integer) else jnp.inf, v.data.dtype))
+    sdata = jnp.sort(big, axis=1)
+    first = jnp.concatenate(
+        [jnp.ones((n, 1), bool), sdata[:, 1:] != sdata[:, :-1]], axis=1)
+    cnt = jnp.sum(m, axis=1)
+    slive = (jnp.arange(cap)[None, :] < cnt[:, None])
+    keep = first & slive
+    pos = jnp.broadcast_to(jnp.arange(cap, dtype=jnp.int32),
+                           sdata.shape)
+    out = jax.lax.sort(((~keep).astype(jnp.int32), pos, sdata),
+                       num_keys=2, is_stable=True, dimension=1)
+    lengths = jnp.sum(keep, axis=1).astype(jnp.int32)
+    return Val(e.dtype, out[2], v.valid, v.dictionary, lengths, None)
+
+
+@scalar("array_sort_fn")
+def _array_sort_fn(e, args):
+    (v,) = args
+    m = v.elem_mask()
+    big = jnp.where(m, v.data, jnp.asarray(
+        jnp.iinfo(v.data.dtype).max if jnp.issubdtype(
+            v.data.dtype, jnp.integer) else jnp.inf, v.data.dtype))
+    sdata = jnp.sort(big, axis=1)
+    return Val(e.dtype, sdata, v.valid, v.dictionary,
+               jnp.sum(m, axis=1).astype(jnp.int32), None)
+
+
+@scalar("sequence")
+def _sequence(e, args):
+    lo, hi = e.args[0], e.args[1]
+    if not (isinstance(lo, ir.Literal) and isinstance(hi, ir.Literal)):
+        raise NotImplementedError(
+            "sequence() requires literal bounds (static array "
+            "capacity)")
+    step = int(e.args[2].value) if len(e.args) > 2 else 1
+    vals = np.arange(int(lo.value), int(hi.value) + (1 if step > 0
+                                                     else -1), step,
+                     dtype=np.int64)
+    n = 1
+    for v in args:
+        if getattr(v.data, "ndim", 0) == 1:
+            n = v.data.shape[0]
+            break
+    data = jnp.broadcast_to(jnp.asarray(vals)[None, :],
+                            (n, len(vals)))
+    lengths = jnp.full((n,), len(vals), jnp.int32)
+    return Val(e.dtype, data, None, None, lengths, None)
+
+
+@scalar("split")
+def _split(e, args):
+    """split(string, delim): per-dictionary-entry split into a padded
+    2D LUT, rows gather by code (dictionary transform generalized to
+    array outputs)."""
+    v, delim = args[0], args[1]
+    if not isinstance(e.args[1], ir.Literal):
+        raise NotImplementedError("split() delimiter must be a literal")
+    d = str(e.args[1].value)
+    parts = [str(s).split(d) for s in v.dictionary]
+    cap = max((len(p) for p in parts), default=1)
+    vocab = sorted({x for p in parts for x in p})
+    code_of = {x: i for i, x in enumerate(vocab)}
+    lut = np.zeros((len(parts), cap), np.int32)
+    lens = np.zeros(len(parts), np.int32)
+    for i, p in enumerate(parts):
+        lens[i] = len(p)
+        for j, x in enumerate(p):
+            lut[i, j] = code_of[x]
+    codes = v.data
+    if getattr(codes, "ndim", 0) == 0:
+        codes = codes[None]
+    codes = jnp.clip(codes, 0, max(len(parts) - 1, 0))
+    data = jnp.asarray(lut)[codes]
+    lengths = jnp.asarray(lens)[codes]
+    return Val(e.dtype, data, v.valid,
+               np.array(vocab, dtype=object), lengths, None)
+
+
+@scalar("map_ctor")
+def _map_ctor(e, args):
+    karr, varr = args
+    return Val(e.dtype, varr.data, and_valid(karr.valid, varr.valid),
+               varr.dictionary, varr.lengths, varr.elem_valid,
+               map_keys=karr)
+
+
+@scalar("map_keys")
+def _map_keys(e, args):
+    (v,) = args
+    k = v.map_keys
+    return Val(e.dtype, k.data, v.valid, k.dictionary, k.lengths,
+               k.elem_valid)
+
+
+@scalar("map_values")
+def _map_values(e, args):
+    (v,) = args
+    return Val(e.dtype, v.data, v.valid, v.dictionary, v.lengths,
+               v.elem_valid)

@@ -55,6 +55,20 @@ class Call(Expr):
 
 
 @dataclasses.dataclass(frozen=True)
+class Lambda(Expr):
+    """Lambda argument of a higher-order array function. ``params``
+    name the element variables; the body references them as ColumnRefs
+    with those names (compile binds them to per-element 2D values).
+    ``dtype`` is the body's result type."""
+
+    params: tuple[str, ...] = ()
+    body: Expr = None  # type: ignore[assignment]
+
+    def __str__(self) -> str:
+        return f"({', '.join(self.params)}) -> {self.body}"
+
+
+@dataclasses.dataclass(frozen=True)
 class Cast(Expr):
     arg: Expr = None  # type: ignore[assignment]
 
@@ -120,14 +134,43 @@ def walk(expr: Expr):
             yield from walk(v)
     elif isinstance(expr, IsNull):
         yield from walk(expr.arg)
+    elif isinstance(expr, Lambda):
+        yield from walk(expr.body)
 
 
 def referenced_columns(exprs: Sequence[Expr]) -> set[str]:
+    """FREE column references (lambda parameters are bound names)."""
     out: set[str] = set()
+
+    def visit(e: Expr, bound: frozenset) -> None:
+        if isinstance(e, ColumnRef):
+            if e.name not in bound:
+                out.add(e.name)
+            return
+        if isinstance(e, Lambda):
+            visit(e.body, bound | frozenset(e.params))
+            return
+        if isinstance(e, Call):
+            for a in e.args:
+                visit(a, bound)
+        elif isinstance(e, Cast):
+            visit(e.arg, bound)
+        elif isinstance(e, CaseWhen):
+            for c in e.conditions:
+                visit(c, bound)
+            for r in e.results:
+                visit(r, bound)
+            if e.default is not None:
+                visit(e.default, bound)
+        elif isinstance(e, InList):
+            visit(e.arg, bound)
+            for v in e.values:
+                visit(v, bound)
+        elif isinstance(e, IsNull):
+            visit(e.arg, bound)
+
     for e in exprs:
-        for node in walk(e):
-            if isinstance(node, ColumnRef):
-                out.add(node.name)
+        visit(e, frozenset())
     return out
 
 
@@ -151,4 +194,9 @@ def rewrite_refs(expr: Expr, mapping: dict[str, Expr]) -> Expr:
         return InList(expr.dtype, rewrite_refs(expr.arg, mapping), expr.values)
     if isinstance(expr, IsNull):
         return IsNull(expr.dtype, rewrite_refs(expr.arg, mapping), expr.negated)
+    if isinstance(expr, Lambda):
+        inner = {k: v for k, v in mapping.items()
+                 if k not in expr.params}
+        return Lambda(expr.dtype, expr.params,
+                      rewrite_refs(expr.body, inner))
     return expr

@@ -469,6 +469,38 @@ class MatchRecognize(PlanNode):
         return out
 
 
+@dataclasses.dataclass
+class Unnest(PlanNode):
+    """Expand array-typed columns into one output row per element
+    (reference plan/UnnestNode.java). Multiple arrays zip to the
+    longest length (shorter ones pad with NULLs); ``ordinality_sym``
+    adds the 1-based element index."""
+
+    source: PlanNode = None  # type: ignore[assignment]
+    array_syms: list[str] = dataclasses.field(default_factory=list)
+    out_syms: list[str] = dataclasses.field(default_factory=list)
+    out_types: dict[str, T.DataType] = dataclasses.field(
+        default_factory=dict)
+    ordinality_sym: Optional[str] = None
+
+    def sources(self):
+        return [self.source]
+
+    @property
+    def output_symbols(self):
+        out = list(self.source.output_symbols) + list(self.out_syms)
+        if self.ordinality_sym:
+            out.append(self.ordinality_sym)
+        return out
+
+    def output_types(self):
+        out = dict(self.source.output_types())
+        out.update(self.out_types)
+        if self.ordinality_sym:
+            out[self.ordinality_sym] = T.BIGINT
+        return out
+
+
 class ExchangeType(enum.Enum):
     GATHER = "gather"  # all shards -> one
     REPARTITION = "repartition"  # hash all_to_all

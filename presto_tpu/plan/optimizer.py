@@ -168,6 +168,14 @@ def prune_columns(node: N.PlanNode,
                             needed | set(node.partition_keys))
         return dataclasses.replace(node, source=src)
 
+    if isinstance(node, N.Unnest):
+        child = (needed - set(node.out_syms)
+                 - ({node.ordinality_sym} if node.ordinality_sym
+                    else set())) | set(node.array_syms)
+        child &= set(node.source.output_types())
+        src = prune_columns(node.source, child)
+        return dataclasses.replace(node, source=src)
+
     if isinstance(node, N.MatchRecognize):
         sub = set(node.partition_by)
         sub |= {o.symbol for o in node.orderings}
@@ -193,7 +201,7 @@ def inline_trivial_projects(node: N.PlanNode) -> N.PlanNode:
             rebuilt = dataclasses.replace(node, source=new_kids[0])
         elif isinstance(node, (N.Filter, N.Project, N.Aggregate, N.Sort,
                                N.TopN, N.Limit, N.Distinct, N.Exchange,
-                               N.Window, N.MarkDistinct)):
+                               N.Window, N.MarkDistinct, N.Unnest)):
             rebuilt = dataclasses.replace(node, source=new_kids[0])
         elif isinstance(node, (N.Join, N.CrossJoin)):
             rebuilt = dataclasses.replace(node, left=new_kids[0],

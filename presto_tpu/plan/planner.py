@@ -221,6 +221,17 @@ def _interval_months_days(e: A.IntervalLiteral) -> tuple[int, int]:
     raise SemanticError(f"unsupported interval unit {e.unit}")
 
 
+def _unwrap_unnest(rel: A.Relation):
+    """(A.Unnest, alias, column_aliases) when ``rel`` is an (aliased)
+    UNNEST relation, else (None, None, None)."""
+    if isinstance(rel, A.AliasedRelation) \
+            and isinstance(rel.relation, A.Unnest):
+        return rel.relation, rel.alias, rel.column_aliases
+    if isinstance(rel, A.Unnest):
+        return rel, None, ()
+    return None, None, None
+
+
 def _const_eq_symbol(e: ir.Expr) -> str | None:
     """The column symbol of an eq(column, literal) predicate, else
     None."""
@@ -297,6 +308,8 @@ def _decimal_scale(t: T.DataType) -> int:
 
 def arith_result_type(op: str, a: T.DataType, b: T.DataType) -> T.DataType:
     if op == "||":
+        if isinstance(a, T.ArrayType) and isinstance(b, T.ArrayType):
+            return a
         return T.VARCHAR
     if isinstance(a, T.TimestampType) or isinstance(b, T.TimestampType):
         return T.TIMESTAMP
@@ -445,6 +458,15 @@ class ExprPlanner:
                                (o, ir.Literal(T.BIGINT, months),
                                 ir.Literal(T.BIGINT, days)))
         a, b = self.plan(e.left), self.plan(e.right)
+        if e.op == "||" and (isinstance(a.dtype, T.ArrayType)
+                             or isinstance(b.dtype, T.ArrayType)):
+            # array || element / element || array wraps the scalar side
+            # (reference ConcatFunction array forms)
+            if not isinstance(a.dtype, T.ArrayType):
+                a = ir.Call(T.ArrayType(a.dtype), "array_ctor", (a,))
+            if not isinstance(b.dtype, T.ArrayType):
+                b = ir.Call(T.ArrayType(b.dtype), "array_ctor", (b,))
+            return ir.Call(a.dtype, "concat", (a, b))
         out = arith_result_type(e.op, a.dtype, b.dtype)
         return ir.Call(out, _ARITH[e.op], (a, b))
 
@@ -512,6 +534,138 @@ class ExprPlanner:
         "hour": "hour", "minute": "minute", "second": "second",
     }
 
+    def _plan_higher_order(self, name: str,
+                           e: A.FunctionCall) -> ir.Expr | None:
+        """Array functions with special typing / lambda arguments
+        (reference operator/scalar/ArrayTransformFunction.java,
+        ArrayFilterFunction, ReduceFunction + array function family)."""
+        if name not in ("transform", "filter", "reduce", "any_match",
+                        "all_match", "none_match", "cardinality",
+                        "element_at", "array_position", "array_max",
+                        "array_min", "array_sum", "array_distinct",
+                        "array_sort", "sequence", "split", "map",
+                        "map_keys", "map_values", "repeat"):
+            return None
+        if name in ("transform", "filter", "any_match", "all_match",
+                    "none_match"):
+            arr = self.plan(e.args[0])
+            if not isinstance(arr.dtype, T.ArrayType):
+                raise SemanticError(f"{name}() expects an array")
+            lam_ast = e.args[1]
+            if not isinstance(lam_ast, A.Lambda):
+                raise SemanticError(f"{name}() expects a lambda")
+            lam = self._plan_lambda(lam_ast, [arr.dtype.element])
+            if name == "transform":
+                out_t: T.DataType = T.ArrayType(lam.dtype)
+            elif name == "filter":
+                out_t = arr.dtype
+            else:
+                out_t = T.BOOLEAN
+            return ir.Call(out_t, name, (arr, lam))
+        if name == "reduce":
+            arr = self.plan(e.args[0])
+            init = self.plan(e.args[1])
+            if not isinstance(arr.dtype, T.ArrayType):
+                raise SemanticError("reduce() expects an array")
+            lam = self._plan_lambda(
+                e.args[2], [init.dtype, arr.dtype.element])
+            args: tuple = (arr, init, lam)
+            out_t = lam.dtype
+            if len(e.args) > 3:
+                out_lam = self._plan_lambda(e.args[3], [lam.dtype])
+                args = args + (out_lam,)
+                out_t = out_lam.dtype
+            return ir.Call(out_t, "reduce", args)
+        args = tuple(self.plan(a) for a in e.args)
+        if name == "cardinality":
+            return ir.Call(T.BIGINT, "cardinality", args)
+        if name == "element_at":
+            v = args[0]
+            if isinstance(v.dtype, T.ArrayType):
+                return ir.Call(v.dtype.element, "element_at", args)
+            if isinstance(v.dtype, T.MapType):
+                return ir.Call(v.dtype.value, "element_at", args)
+            raise SemanticError("element_at expects an array or map")
+        if name == "array_position":
+            return ir.Call(T.BIGINT, "array_position", args)
+        if name in ("array_max", "array_min"):
+            return ir.Call(args[0].dtype.element, name, args)
+        if name == "array_sum":
+            et = args[0].dtype.element
+            out_t = (T.DOUBLE if isinstance(et, T.DoubleType)
+                     else et if isinstance(et, T.DecimalType)
+                     else T.BIGINT)
+            return ir.Call(out_t, "array_sum", args)
+        if name == "array_distinct":
+            return ir.Call(args[0].dtype, "array_distinct", args)
+        if name == "array_sort":
+            return ir.Call(args[0].dtype, "array_sort_fn", args)
+        if name == "sequence":
+            return ir.Call(T.ArrayType(T.BIGINT), "sequence", args)
+        if name == "split":
+            return ir.Call(T.ArrayType(T.VARCHAR), "split", args)
+        if name == "map":
+            ka, va = args
+            if not (isinstance(ka.dtype, T.ArrayType)
+                    and isinstance(va.dtype, T.ArrayType)):
+                raise SemanticError("map() expects two arrays")
+            return ir.Call(T.MapType(ka.dtype.element,
+                                     va.dtype.element),
+                           "map_ctor", args)
+        if name == "map_keys":
+            return ir.Call(T.ArrayType(args[0].dtype.key),
+                           "map_keys", args)
+        if name == "map_values":
+            return ir.Call(T.ArrayType(args[0].dtype.value),
+                           "map_values", args)
+        return None
+
+    def _p_arrayconstructor(self, e: A.ArrayConstructor) -> ir.Expr:
+        if not e.items:
+            return ir.Call(T.ArrayType(T.BIGINT), "array_ctor", ())
+        items = [self.plan(i) for i in e.items]
+        et: T.DataType = T.UNKNOWN
+        for it in items:
+            et = T.common_super_type(et, it.dtype)
+        if isinstance(et, T.UnknownType):
+            et = T.BIGINT
+        items = [it if it.dtype == et else ir.Cast(et, it)
+                 for it in items]
+        return ir.Call(T.ArrayType(et), "array_ctor", tuple(items))
+
+    def _p_subscript(self, e: A.Subscript) -> ir.Expr:
+        v = self.plan(e.operand)
+        i = self.plan(e.index)
+        if isinstance(v.dtype, T.ArrayType):
+            return ir.Call(v.dtype.element, "element_at", (v, i))
+        if isinstance(v.dtype, T.MapType):
+            return ir.Call(v.dtype.value, "element_at", (v, i))
+        raise SemanticError(
+            f"cannot subscript a value of type {v.dtype}")
+
+    def _p_lambda(self, e: A.Lambda) -> ir.Expr:
+        raise SemanticError(
+            "lambda expressions are only valid as higher-order "
+            "function arguments")
+
+    _LAM_COUNTER = [0]
+
+    def _plan_lambda(self, lam: A.Lambda,
+                     param_types: list[T.DataType]) -> ir.Lambda:
+        """Plan a lambda body with params bound as fresh symbols."""
+        if len(lam.params) != len(param_types):
+            raise SemanticError(
+                f"lambda expects {len(param_types)} parameters")
+        self._LAM_COUNTER[0] += 1
+        n = self._LAM_COUNTER[0]
+        syms = [f"$lam{n}_{p}" for p in lam.params]
+        fields = [Field(p, None, s, t) for p, s, t in
+                  zip(lam.params, syms, param_types)]
+        ctx2 = dataclasses.replace(
+            self.ctx, scope=Scope(list(self.ctx.scope.fields) + fields))
+        body = ExprPlanner(ctx2).plan(lam.body)
+        return ir.Lambda(body.dtype, tuple(syms), body)
+
     def _p_extract(self, e: A.Extract) -> ir.Expr:
         fn = self._EXTRACT_FIELDS.get(e.field)
         if fn is None:
@@ -537,6 +691,9 @@ class ExprPlanner:
                 f"ORDER BY inside {name}() is not supported")
         if name in ("substr", "substring"):
             name = "substring"
+        hof = self._plan_higher_order(name, e)
+        if hof is not None:
+            return hof
         args = tuple(self.plan(a) for a in e.args)
         if name in ("year", "month", "day", "hour", "minute", "second",
                     "millisecond"):
@@ -1485,6 +1642,9 @@ class LogicalPlanner:
                          decorrelate: bool) -> QState:
         legs: list[RelationPlan] = []
         on_conjuncts: list[A.Expression] = []
+        # UNNEST legs are LATERAL (their array expressions may reference
+        # earlier legs): collected here and applied after the join graph
+        unnest_legs: list[tuple] = []  # (A.Unnest, alias, col_aliases)
 
         def flatten(rel: A.Relation):
             if isinstance(rel, A.JoinRelation) and rel.join_type in (
@@ -1496,6 +1656,10 @@ class LogicalPlanner:
                 return
             if isinstance(rel, A.JoinRelation) and rel.using:
                 legs.append(self.plan_outer_join(rel, ctes, outer))
+                return
+            un, alias, cols = _unwrap_unnest(rel)
+            if un is not None:
+                unnest_legs.append((un, alias, cols))
                 return
             legs.append(self.plan_relation(rel, ctes, outer))
 
@@ -1509,6 +1673,11 @@ class LogicalPlanner:
             return qs
 
         flatten(spec.from_relation)
+        if not legs and unnest_legs:
+            # FROM UNNEST(...) alone: expand over a one-row dual
+            legs.append(RelationPlan(
+                N.Values(["dual"], {"dual": T.BIGINT}, [[1]]),
+                Scope([]), 1, [frozenset()]))
         combined = Scope([f for leg in legs for f in leg.scope.fields])
         sym_to_leg = {}
         for i, leg in enumerate(legs):
@@ -1522,12 +1691,21 @@ class LogicalPlanner:
         corr_pairs: list[tuple[str, str, T.DataType]] = []
         corr_residual: list[ir.Expr] = []
 
+        late_unnest: list[A.Expression] = []
         for c in conjuncts:
             if find_subquery_nodes(c):
                 deferred.append(c)
                 continue
             ctx = ExprCtx(combined, self, outer if decorrelate else None)
-            planned = ExprPlanner(ctx).plan(c)
+            try:
+                planned = ExprPlanner(ctx).plan(c)
+            except SemanticError:
+                if unnest_legs:
+                    # references UNNEST output columns: plan after the
+                    # unnest legs apply
+                    late_unnest.append(c)
+                    continue
+                raise
             if ctx.correlated:
                 outer_syms = {f.symbol for f in ctx.correlated}
                 pair = self._extract_corr_pair(planned, outer_syms)
@@ -1591,6 +1769,12 @@ class LogicalPlanner:
         qs = self._order_joins(legs, edges, combined)
         qs.corr_pairs = corr_pairs
         qs.residual_corr = corr_residual
+        for un, alias, col_aliases in unnest_legs:
+            self._apply_unnest(qs, un, alias, col_aliases, outer
+                               if decorrelate else None)
+        for c in late_unnest:
+            ctx = ExprCtx(qs.scope, self, outer if decorrelate else None)
+            post.append(ExprPlanner(ctx).plan(c))
         for p in post:
             qs.node = N.Filter(qs.node, p)
         for c in deferred:
@@ -2206,6 +2390,64 @@ class LogicalPlanner:
         return planned
 
     # -- predicate application (WHERE/HAVING conjuncts) ---------------------
+
+    def _apply_unnest(self, qs: QState, un: "A.Unnest",
+                      alias: str | None, col_aliases: tuple,
+                      outer: Scope | None) -> None:
+        """LATERAL UNNEST over the joined-so-far relation (reference
+        plan/UnnestNode.java planning in RelationPlanner.visitUnnest):
+        each array expression projects to a symbol, the Unnest node
+        expands rows, output fields take the alias's column names."""
+        ctx = ExprCtx(qs.scope, self, outer)
+        arr_syms: list[str] = []
+        out_syms: list[str] = []
+        out_types: dict[str, T.DataType] = {}
+        names: list[str] = []
+        for expr_ast in un.expressions:
+            planned = ExprPlanner(ctx).plan(expr_ast)
+            if isinstance(planned.dtype, T.MapType):
+                # UNNEST(map) yields (key, value) columns
+                ksym = qs.add_projection(
+                    ir.Call(T.ArrayType(planned.dtype.key),
+                            "map_keys", (planned,)), "unnest_k", self)
+                vsym = qs.add_projection(
+                    ir.Call(T.ArrayType(planned.dtype.value),
+                            "map_values", (planned,)),
+                    "unnest_v", self)
+                for s, t in ((ksym, planned.dtype.key),
+                             (vsym, planned.dtype.value)):
+                    arr_syms.append(s)
+                    o = self.symbols.fresh("unnest")
+                    out_syms.append(o)
+                    out_types[o] = t
+                    names.append(None)
+                continue
+            if not isinstance(planned.dtype, T.ArrayType):
+                raise SemanticError("UNNEST expects array or map "
+                                    f"values, got {planned.dtype}")
+            sym = qs.add_projection(planned, "unnest_in", self)
+            arr_syms.append(sym)
+            o = self.symbols.fresh("unnest")
+            out_syms.append(o)
+            out_types[o] = planned.dtype.element
+            names.append(None)
+        ord_sym = (self.symbols.fresh("ordinality")
+                   if un.with_ordinality else None)
+        qs.node = N.Unnest(qs.node, arr_syms, out_syms, out_types,
+                           ord_sym)
+        fields = list(qs.scope.fields)
+        for i, (o, nm) in enumerate(zip(out_syms, names)):
+            name = (col_aliases[i] if i < len(col_aliases)
+                    else nm or f"col{i + 1}")
+            fields.append(Field(name, alias, o, out_types[o]))
+        if ord_sym:
+            name = (col_aliases[len(out_syms)]
+                    if len(col_aliases) > len(out_syms)
+                    else "ordinality")
+            fields.append(Field(name, alias, ord_sym, T.BIGINT))
+        qs.scope = Scope(fields)
+        qs.est = max(qs.est * 4, qs.est)
+        qs.unique = []
 
     def _apply_conjunct(self, qs: QState, c: A.Expression, ctx: ExprCtx,
                         ctes, group_map: dict[ir.Expr, str]) -> None:

@@ -31,7 +31,7 @@ def _register(*classes):
 _register(
     # plan nodes
     N.TableScan, N.Values, N.Filter, N.Project, N.Aggregate, N.Join,
-    N.SemiJoin, N.CrossJoin, N.Union, N.Sort, N.TopN, N.Limit,
+    N.SemiJoin, N.CrossJoin, N.Union, N.Unnest, N.Sort, N.TopN, N.Limit,
     N.Distinct, N.MarkDistinct, N.Window, N.Exchange, N.Output,
     # plan helpers
     N.Ordering, N.WindowCall, AggCall,

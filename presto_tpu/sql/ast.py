@@ -185,6 +185,30 @@ class CaseExpression(Expression):
 
 
 @dataclasses.dataclass(frozen=True)
+class ArrayConstructor(Expression):
+    """ARRAY[e1, e2, ...]"""
+
+    items: tuple["Expression", ...] = ()
+
+
+@dataclasses.dataclass(frozen=True)
+class Subscript(Expression):
+    """e[index] — array element access (SQL 1-based) / map lookup."""
+
+    operand: "Expression" = None  # type: ignore[assignment]
+    index: "Expression" = None  # type: ignore[assignment]
+
+
+@dataclasses.dataclass(frozen=True)
+class Lambda(Expression):
+    """x -> body / (x, y) -> body (argument of array higher-order
+    functions)."""
+
+    params: tuple[str, ...] = ()
+    body: "Expression" = None  # type: ignore[assignment]
+
+
+@dataclasses.dataclass(frozen=True)
 class Extract(Expression):
     field: str  # year|month|day|...
     operand: Expression

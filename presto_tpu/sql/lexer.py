@@ -23,7 +23,7 @@ class Token:
 
 
 _OPERATORS = [
-    "<>", "!=", ">=", "<=", "||", "=>",
+    "<>", "!=", ">=", "<=", "||", "=>", "->",
     "(", ")", ",", ".", ";", "+", "-", "*", "/", "%", "<", ">", "=", "?",
     "[", "]", "|", "{", "}",
 ]

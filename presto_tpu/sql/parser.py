@@ -667,6 +667,35 @@ class Parser:
     # -- expressions --------------------------------------------------------
 
     def expression(self) -> A.Expression:
+        # lambda: `x -> body` or `(x, y) -> body` (only meaningful as a
+        # higher-order function argument; the planner rejects misuse)
+        t = self.peek()
+        if t.kind in ("ident", "qident") and self.peek(1).kind == "op" \
+                and self.peek(1).value == "->":
+            self.advance()
+            self.advance()
+            return A.Lambda((t.value,), self.expression())
+        if t.kind == "op" and t.value == "(" \
+                and self.peek(1).kind in ("ident", "qident"):
+            save = self.i
+            j = 1
+            params = []
+            while self.peek(j).kind in ("ident", "qident"):
+                params.append(self.peek(j).value)
+                j += 1
+                if self.peek(j).kind == "op" \
+                        and self.peek(j).value == ",":
+                    j += 1
+                    continue
+                break
+            if params and self.peek(j).kind == "op" \
+                    and self.peek(j).value == ")" \
+                    and self.peek(j + 1).kind == "op" \
+                    and self.peek(j + 1).value == "->":
+                for _ in range(j + 2):
+                    self.advance()
+                return A.Lambda(tuple(params), self.expression())
+            self.i = save
         return self._or_expr()
 
     def _or_expr(self) -> A.Expression:
@@ -761,7 +790,16 @@ class Parser:
         if self.at_op("-", "+"):
             op = self.advance().value
             return A.UnaryOp(op, self._unary())
-        return self._primary()
+        return self._postfix()
+
+    def _postfix(self) -> A.Expression:
+        e = self._primary()
+        while self.at_op("["):
+            self.advance()
+            idx = self.expression()
+            self.expect_op("]")
+            e = A.Subscript(e, idx)
+        return e
 
     def _primary(self) -> A.Expression:
         t = self.peek()
@@ -790,6 +828,17 @@ class Parser:
         if kw == "null":
             self.advance()
             return A.NullLiteral()
+        if kw == "array" and self.peek(1).kind == "op" \
+                and self.peek(1).value == "[":
+            self.advance()
+            self.advance()
+            items: list[A.Expression] = []
+            if not self.at_op("]"):
+                items.append(self.expression())
+                while self.accept_op(","):
+                    items.append(self.expression())
+            self.expect_op("]")
+            return A.ArrayConstructor(tuple(items))
         if kw in ("true", "false"):
             self.advance()
             return A.BooleanLiteral(kw == "true")

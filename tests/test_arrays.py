@@ -1,0 +1,162 @@
+"""ARRAY/MAP expressions, UNNEST, lambdas (VERDICT r3 item 4).
+
+TPU-first design: arrays are fixed-capacity padded 2D device values
+(expr/compile.Val), so constructors, subscripts, higher-order lambdas
+and UNNEST all run inside the traced XLA program — the counterpart of
+the reference's ArrayType/ArrayBlock + UnnestNode + lambda functions
+(spi/type/ArrayType.java, sql/planner/plan/UnnestNode.java,
+operator/scalar/ArrayTransformFunction.java).
+"""
+
+import pytest
+
+
+def test_array_constructor_and_subscript(engine):
+    [(a, e1, e2)] = engine.execute(
+        "select array[1, 2, 3], array[10, 20][2], element_at("
+        "array[5, 6], 1)")
+    assert list(a) == [1, 2, 3]
+    assert (int(e1), int(e2)) == (20, 5)
+
+
+def test_subscript_out_of_range_is_null(engine):
+    [(v,)] = engine.execute("select array[1, 2][5]")
+    assert v is None
+
+
+def test_cardinality_contains_position(engine):
+    [(c, has, pos)] = engine.execute(
+        "select cardinality(array[1,2,3]), contains(array[1,2,3], 2), "
+        "array_position(array[7,8,9], 9)")
+    assert (int(c), bool(has), int(pos)) == (3, True, 3)
+
+
+def test_transform_filter_reduce(engine):
+    # the r3 VERDICT's named done-criteria expressions
+    [(t,)] = engine.execute("select transform(array[1,2,3], x -> x + 1)")
+    assert list(t) == [2, 3, 4]
+    [(f,)] = engine.execute(
+        "select filter(array[1,2,3,4], x -> x % 2 = 0)")
+    assert list(f) == [2, 4]
+    [(r,)] = engine.execute(
+        "select reduce(array[1,2,3], 0, (acc, x) -> acc + x)")
+    assert int(r) == 6
+
+
+def test_match_lambdas(engine):
+    [(a, b, c)] = engine.execute(
+        "select any_match(array[1,2], x -> x > 1), "
+        "all_match(array[1,2], x -> x > 0), "
+        "none_match(array[1,2], x -> x > 5)")
+    assert (bool(a), bool(b), bool(c)) == (True, True, True)
+
+
+def test_array_concat_minmax_sum(engine):
+    [(cc, mx, mn, sm)] = engine.execute(
+        "select array[1,2] || array[3], array_max(array[3,1]), "
+        "array_min(array[3,1]), array_sum(array[1,2,3])")
+    assert list(cc) == [1, 2, 3]
+    assert (int(mx), int(mn), int(sm)) == (3, 1, 6)
+
+
+def test_unnest_basic(engine):
+    rows = engine.execute(
+        "select x from unnest(array[1,2,3]) t(x) order by x")
+    assert [int(r[0]) for r in rows] == [1, 2, 3]
+
+
+def test_unnest_with_ordinality(engine):
+    rows = engine.execute(
+        "select x, o from unnest(array[10,20,30]) with ordinality "
+        "t(x, o) order by o")
+    assert [(int(a), int(b)) for a, b in rows] == [
+        (10, 1), (20, 2), (30, 3)]
+
+
+def test_unnest_lateral_over_table(engine):
+    rows = engine.execute(
+        "select n_name, x from nation, "
+        "unnest(array[n_nationkey, n_regionkey]) t(x) "
+        "where n_name = 'BRAZIL' order by x")
+    assert [(r[0], int(r[1])) for r in rows] == [
+        ("BRAZIL", 1), ("BRAZIL", 2)]
+
+
+def test_unnest_aggregate(engine):
+    [(s,)] = engine.execute(
+        "select sum(x) from unnest(sequence(1, 100)) t(x)")
+    assert int(s) == 5050
+
+
+def test_unnest_map(engine):
+    rows = engine.execute(
+        "select k, v from unnest(map(array['a','b'], array[1,2])) "
+        "t(k, v) order by k")
+    assert [(a, int(b)) for a, b in rows] == [("a", 1), ("b", 2)]
+
+
+def test_map_functions(engine):
+    [(v, ks, vs, c)] = engine.execute(
+        "select element_at(map(array['a','b'], array[1,2]), 'b'), "
+        "map_keys(map(array['a'], array[1])), "
+        "map_values(map(array['a'], array[7])), "
+        "cardinality(map(array['a','b'], array[1,2]))")
+    assert int(v) == 2
+    assert list(ks) == ["a"] and [int(x) for x in vs] == [7]
+    assert int(c) == 2
+
+
+def test_split_and_string_elements(engine):
+    [(p, up)] = engine.execute(
+        "select split('a,b,c', ','), "
+        "transform(split('x,y', ','), s -> upper(s))")
+    assert list(p) == ["a", "b", "c"]
+    assert list(up) == ["X", "Y"]
+
+
+def test_string_to_number_cast_parses_values(engine):
+    # regression: casts used to convert dictionary CODES, not values
+    [(i, d, dec, bad)] = engine.execute(
+        "select cast('5' as bigint), cast('2.5' as double), "
+        "cast('3.25' as decimal(10,2)), try_cast('x' as bigint)")
+    assert int(i) == 5 and float(d) == 2.5 and float(dec) == 3.25
+    assert bad is None
+
+
+def test_array_agg_output_feeds_expressions(engine):
+    # varlen aggregate outputs bridge into the 2D array layout
+    rows = engine.execute(
+        "select n_regionkey, cardinality(ks) from ("
+        " select n_regionkey, array_agg(n_nationkey) ks"
+        " from nation group by n_regionkey) order by 1")
+    assert all(int(c) == 5 for _, c in rows)
+
+
+def test_array_agg_output_unnests(engine):
+    rows = engine.execute(
+        "select r, x from (select n_regionkey r, array_agg(n_name) ns"
+        " from nation group by n_regionkey), unnest(ns) t(x) "
+        "where r = 1 order by x")
+    assert [x for _, x in rows] == [
+        "ARGENTINA", "BRAZIL", "CANADA", "PERU", "UNITED STATES"]
+
+
+def test_array_distinct_sort(engine):
+    [(d, s)] = engine.execute(
+        "select array_distinct(array[3,1,3,2]), "
+        "array_sort(array[3,1,2])")
+    assert sorted(int(x) for x in d) == [1, 2, 3]
+    assert [int(x) for x in s] == [1, 2, 3]
+
+
+def test_nulls_in_arrays(engine):
+    [(a, c)] = engine.execute(
+        "select array[1, null, 3], cardinality(array[1, null, 3])")
+    assert a[0] == 1 and a[1] is None and a[2] == 3
+    assert int(c) == 3
+
+
+def test_empty_array_unnest_produces_no_rows(engine):
+    rows = engine.execute(
+        "select x from unnest(filter(array[1], v -> v > 5)) t(x)")
+    assert rows == []

@@ -200,10 +200,16 @@ def test_dbapi_comment_and_ident_handling(server):
     # leftover placeholder with no params fails client-side
     with pytest.raises(dbapi.ProgrammingError, match="not enough"):
         cur.execute("select 1 where 1 = ?")
-    # datetime.datetime binds are rejected loudly
+    # datetime.datetime binds as a TIMESTAMP literal and round-trips
     import datetime
+    cur.execute("select ?", (datetime.datetime(2026, 7, 30, 12, 0),))
+    [(v,)] = cur.fetchall()
+    assert v == datetime.datetime(2026, 7, 30, 12, 0)
+    # timezone-aware datetimes are rejected loudly (no TZ type)
     with pytest.raises(dbapi.NotSupportedError):
-        cur.execute("select ?", (datetime.datetime(2026, 7, 30, 12, 0),))
+        cur.execute("select ?", (datetime.datetime(
+            2026, 7, 30, 12, 0,
+            tzinfo=datetime.timezone.utc),))
 
 
 def test_http_set_session_scoped_per_client(server):
