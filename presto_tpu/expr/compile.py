@@ -1973,3 +1973,254 @@ def _map_values(e, args):
     (v,) = args
     return Val(e.dtype, v.data, v.valid, v.dictionary, v.lengths,
                v.elem_valid)
+
+
+# --- math tail / bitwise / url / binary-string functions --------------------
+# (reference operator/scalar/MathFunctions.java, BitwiseFunctions.java,
+# UrlFunctions.java, StringFunctions.java, VarbinaryFunctions.java)
+
+_mathfn("sin", jnp.sin)
+_mathfn("cos", jnp.cos)
+_mathfn("tan", jnp.tan)
+_mathfn("asin", jnp.arcsin)
+_mathfn("acos", jnp.arccos)
+_mathfn("atan", jnp.arctan)
+_mathfn("atan2", jnp.arctan2, arity=2)
+_mathfn("sinh", jnp.sinh)
+_mathfn("cosh", jnp.cosh)
+_mathfn("tanh", jnp.tanh)
+_mathfn("degrees", jnp.degrees)
+_mathfn("radians", jnp.radians)
+_mathfn("exp2", jnp.exp2)
+
+
+@scalar("log")
+def _log_base(e, args):
+    # log(base, x) — the reference's two-argument log
+    b, x = args
+    return Val(e.dtype, jnp.log(_as_f64(x)) / jnp.log(_as_f64(b)),
+               and_valid(b.valid, x.valid))
+
+
+@scalar("is_nan")
+def _is_nan(e, args):
+    (a,) = args
+    return Val(e.dtype, jnp.isnan(_as_f64(a)), a.valid)
+
+
+@scalar("is_finite")
+def _is_finite(e, args):
+    (a,) = args
+    return Val(e.dtype, jnp.isfinite(_as_f64(a)), a.valid)
+
+
+@scalar("is_infinite")
+def _is_infinite(e, args):
+    (a,) = args
+    return Val(e.dtype, jnp.isinf(_as_f64(a)), a.valid)
+
+
+def _bitfn(name, op, arity=2):
+    @scalar(name)
+    def _f(e, args, _op=op, _n=arity):
+        if _n == 1:
+            (a,) = args
+            return Val(e.dtype, _op(a.data.astype(jnp.int64)), a.valid)
+        a, b = args
+        return Val(e.dtype, _op(a.data.astype(jnp.int64),
+                                b.data.astype(jnp.int64)),
+                   and_valid(a.valid, b.valid))
+    return _f
+
+
+_bitfn("bitwise_and", jnp.bitwise_and)
+_bitfn("bitwise_or", jnp.bitwise_or)
+_bitfn("bitwise_xor", jnp.bitwise_xor)
+_bitfn("bitwise_not", jnp.bitwise_not, arity=1)
+_bitfn("bitwise_left_shift", jnp.left_shift)
+_bitfn("bitwise_right_shift",
+       lambda a, b: (a.astype(jnp.uint64) >> b.astype(jnp.uint64))
+       .astype(jnp.int64))
+
+
+@scalar("bit_count")
+def _bit_count(e, args):
+    a = args[0]
+    bits = int(e.args[1].value) if len(e.args) > 1 else 64
+    v = a.data.astype(jnp.int64)
+    if bits < 64:  # interpret as a ``bits``-wide two's complement value
+        v = v & jnp.int64((1 << bits) - 1)
+    cnt = jax.lax.population_count(v.view(jnp.uint64))
+    return Val(e.dtype, cnt.astype(jnp.int64), a.valid)
+
+
+@scalar("width_bucket")
+def _width_bucket(e, args):
+    x, lo, hi, nb = (cast_val(a, T.DOUBLE) for a in args)
+    n = nb.data.astype(jnp.int64)
+    span = hi.data - lo.data
+    frac = (x.data - lo.data) / jnp.where(span == 0, 1.0, span)
+    b = jnp.floor(frac * n).astype(jnp.int64) + 1
+    b = jnp.where(x.data < lo.data, 0, b)
+    b = jnp.where(x.data >= hi.data, n + 1, b)
+    return Val(e.dtype, b, and_valid(*[a.valid for a in args]))
+
+
+@scalar("codepoint")
+def _codepoint(e, args):
+    (col,) = args
+    lut = jnp.asarray(np.array(
+        [ord(str(s)[0]) if len(str(s)) else 0
+         for s in col.dictionary], np.int64))
+    return Val(e.dtype, lut[col.data], col.valid)
+
+
+@scalar("chr")
+def _chr(e, args):
+    (a,) = args
+    if not isinstance(e.args[0], ir.Literal):
+        raise NotImplementedError("chr() requires a literal")
+    return Val(T.VARCHAR, jnp.asarray(np.int32(0)), a.valid,
+               np.array([chr(int(e.args[0].value))], object))
+
+
+@scalar("translate")
+def _translate(e, args):
+    col = args[0]
+    if not all(isinstance(a, ir.Literal) for a in e.args[1:]):
+        raise NotImplementedError("translate with non-literal maps")
+    src, dst = str(e.args[1].value), str(e.args[2].value)
+    table = {ord(c): (dst[i] if i < len(dst) else None)
+             for i, c in enumerate(src)}
+    return _dict_transform(
+        col, lambda d: np.array([str(s).translate(table) for s in d],
+                                object))
+
+
+@scalar("levenshtein_distance")
+def _levenshtein(e, args):
+    a, b = args
+    if len(e.args) < 2 or not isinstance(e.args[1], ir.Literal):
+        raise NotImplementedError(
+            "levenshtein_distance needs a literal second argument")
+    want = str(e.args[1].value)
+
+    def dist(s: str) -> int:
+        prev = list(range(len(want) + 1))
+        for i, ca in enumerate(s, 1):
+            cur = [i]
+            for j, cb in enumerate(want, 1):
+                cur.append(min(prev[j] + 1, cur[j - 1] + 1,
+                               prev[j - 1] + (ca != cb)))
+            prev = cur
+        return prev[-1]
+
+    lut = jnp.asarray(np.array([dist(str(s)) for s in a.dictionary],
+                               np.int64))
+    return Val(e.dtype, lut[a.data], and_valid(a.valid, b.valid))
+
+
+@scalar("hamming_distance")
+def _hamming(e, args):
+    a, b = args
+    if len(e.args) < 2 or not isinstance(e.args[1], ir.Literal):
+        raise NotImplementedError(
+            "hamming_distance needs a literal second argument")
+    want = str(e.args[1].value)
+
+    def dist(s: str) -> int:
+        s = str(s)
+        if len(s) != len(want):
+            return -1
+        return sum(x != y for x, y in zip(s, want))
+
+    lut = jnp.asarray(np.array([dist(s) for s in a.dictionary],
+                               np.int64))
+    ok = lut >= 0
+    return Val(e.dtype, lut[a.data],
+               and_valid(a.valid, ok[a.data]))
+
+
+def _urlfn(name, extract):
+    @scalar(name)
+    def _f(e, args, _x=extract):
+        return _dict_transform(
+            args[0], lambda d: np.array([_x(str(s)) for s in d],
+                                        object))
+    return _f
+
+
+def _url_parts(s: str):
+    from urllib.parse import urlparse
+    try:
+        return urlparse(s)
+    except ValueError:
+        return urlparse("")
+
+
+_urlfn("url_extract_protocol", lambda s: _url_parts(s).scheme)
+_urlfn("url_extract_host", lambda s: _url_parts(s).hostname or "")
+_urlfn("url_extract_path", lambda s: _url_parts(s).path)
+_urlfn("url_extract_query", lambda s: _url_parts(s).query)
+_urlfn("url_extract_fragment", lambda s: _url_parts(s).fragment)
+
+
+@scalar("url_extract_port")
+def _url_port(e, args):
+    (col,) = args
+    ports = np.array(
+        [(_url_parts(str(s)).port or -1) for s in col.dictionary],
+        np.int64)
+    lut = jnp.asarray(ports)
+    has = lut >= 0
+    return Val(e.dtype, jnp.clip(lut[col.data], 0, None),
+               and_valid(col.valid, has[col.data]))
+
+
+@scalar("url_extract_parameter")
+def _url_param(e, args):
+    if not isinstance(e.args[1], ir.Literal):
+        raise NotImplementedError(
+            "url_extract_parameter needs a literal name")
+    name = str(e.args[1].value)
+
+    def get(s: str):
+        from urllib.parse import parse_qs
+        vals = parse_qs(_url_parts(s).query,
+                        keep_blank_values=True).get(name)
+        return vals[0] if vals else ""
+
+    col = args[0]
+    out = _dict_transform(
+        col, lambda d: np.array([get(str(s)) for s in d], object))
+    has = np.array(
+        [name in parse_qs_keys(str(s)) for s in col.dictionary])
+    hasr = jnp.asarray(has)[jnp.clip(col.data, 0,
+                                     max(len(has) - 1, 0))]
+    return Val(T.VARCHAR, out.data, and_valid(col.valid, hasr),
+               out.dictionary)
+
+
+def parse_qs_keys(s: str):
+    from urllib.parse import parse_qs
+    return parse_qs(_url_parts(s).query, keep_blank_values=True).keys()
+
+
+_urlfn("url_encode",
+       lambda s: __import__("urllib.parse", fromlist=["quote_plus"])
+       .quote_plus(s))
+_urlfn("url_decode",
+       lambda s: __import__("urllib.parse", fromlist=["unquote_plus"])
+       .unquote_plus(s))
+_urlfn("to_hex", lambda s: s.encode().hex().upper())
+_urlfn("from_hex", lambda s: bytes.fromhex(s).decode("utf-8",
+                                                     "replace"))
+_urlfn("md5",
+       lambda s: __import__("hashlib").md5(s.encode()).hexdigest())
+_urlfn("sha256",
+       lambda s: __import__("hashlib").sha256(s.encode()).hexdigest())
+_urlfn("to_base64",
+       lambda s: __import__("base64").b64encode(s.encode()).decode())
+_urlfn("from_base64",
+       lambda s: __import__("base64").b64decode(s.encode())
+       .decode("utf-8", "replace"))

@@ -774,8 +774,50 @@ class ExprPlanner:
                 return ir.Call(out, "round", args)
             return ir.Call(a.dtype, "round", args)
         if name in ("sqrt", "cbrt", "floor", "ceil", "ceiling", "power",
-                    "pow", "exp", "ln", "log10", "log2", "truncate"):
+                    "pow", "exp", "ln", "log10", "log2", "truncate",
+                    "sin", "cos", "tan", "asin", "acos", "atan",
+                    "atan2", "sinh", "cosh", "tanh", "degrees",
+                    "radians", "log", "exp2"):
             return ir.Call(T.DOUBLE, name, args)
+        if name in ("pi", "e"):
+            import math
+            return ir.Literal(T.DOUBLE,
+                              math.pi if name == "pi" else math.e)
+        if name in ("infinity", "nan"):
+            return ir.Literal(T.DOUBLE,
+                              float("inf") if name == "infinity"
+                              else float("nan"))
+        if name in ("is_nan", "is_finite", "is_infinite"):
+            return ir.Call(T.BOOLEAN, name, args)
+        if name in ("bitwise_and", "bitwise_or", "bitwise_xor",
+                    "bitwise_not", "bitwise_left_shift",
+                    "bitwise_right_shift", "bit_count"):
+            return ir.Call(T.BIGINT, name, args)
+        if name == "width_bucket":
+            return ir.Call(T.BIGINT, "width_bucket", args)
+        if name in ("codepoint", "levenshtein_distance",
+                    "hamming_distance"):
+            return ir.Call(T.BIGINT, name, args)
+        if name in ("chr", "translate", "repeat_str", "normalize",
+                    "url_extract_protocol", "url_extract_host",
+                    "url_extract_path", "url_extract_query",
+                    "url_extract_fragment", "url_extract_parameter",
+                    "url_encode", "url_decode", "to_hex", "from_hex",
+                    "md5", "sha256", "to_base64", "from_base64"):
+            return ir.Call(T.VARCHAR, name, args)
+        if name == "url_extract_port":
+            return ir.Call(T.BIGINT, name, args)
+        if name == "if":
+            if len(args) not in (2, 3):
+                raise SemanticError("if() takes 2 or 3 arguments")
+            out_t = args[1].dtype
+            if len(args) > 2:
+                out_t = T.common_super_type(out_t, args[2].dtype)
+            default = (args[2] if len(args) > 2
+                       else ir.Literal(out_t, None))
+            return ir.CaseWhen(out_t, (args[0],), (args[1],), default)
+        if name == "typeof":
+            return ir.Literal(T.VARCHAR, str(args[0].dtype))
         raise SemanticError(f"unknown function {name}")
 
     def _p_scalarsubquery(self, e: A.ScalarSubquery) -> ir.Expr:

@@ -480,3 +480,69 @@ def test_select_verbatim_group_expression(eng, oracle):
                  "select substring(n_name, 1, 2), count(*) from nation "
                  "group by substring(n_name, 1, 2) "
                  "order by substring(n_name, 1, 2)")
+
+
+def test_math_tail(engine, oracle):
+    import math
+    [(s, c, t, d, r, lg, a2)] = engine.execute(
+        "select sin(0), cos(0), tan(0), degrees(pi()), radians(180), "
+        "log(2, 8), atan2(1, 1)")
+    assert (float(s), float(c), float(t)) == (0.0, 1.0, 0.0)
+    assert abs(float(d) - 180) < 1e-9
+    assert abs(float(r) - math.pi) < 1e-9
+    assert abs(float(lg) - 3) < 1e-12
+    assert abs(float(a2) - math.pi / 4) < 1e-12
+
+
+def test_bitwise(engine):
+    [(a, o, x, n, ls, rs, bc)] = engine.execute(
+        "select bitwise_and(12, 10), bitwise_or(12, 10), "
+        "bitwise_xor(12, 10), bitwise_not(0), "
+        "bitwise_left_shift(1, 4), bitwise_right_shift(16, 2), "
+        "bit_count(255)")
+    assert tuple(int(v) for v in (a, o, x, n, ls, rs, bc)) == (
+        8, 14, 6, -1, 16, 4, 8)
+
+
+def test_width_bucket_and_nan(engine):
+    [(w0, w1, w2, nn, fin)] = engine.execute(
+        "select width_bucket(-1, 0, 10, 5), width_bucket(3, 0, 10, 5), "
+        "width_bucket(11, 0, 10, 5), is_nan(nan()), "
+        "is_finite(infinity())")
+    assert tuple(int(v) for v in (w0, w1, w2)) == (0, 2, 6)
+    assert bool(nn) is True and bool(fin) is False
+
+
+def test_char_functions(engine):
+    [(cp, ch, tr, lev, ham)] = engine.execute(
+        "select codepoint('A'), chr(66), translate('abc', 'ab', 'xy'), "
+        "levenshtein_distance('kitten', 'sitting'), "
+        "hamming_distance('abc', 'abd')")
+    assert int(cp) == 65 and ch == "B" and tr == "xyc"
+    assert int(lev) == 3 and int(ham) == 1
+
+
+def test_url_functions(engine):
+    u = "'https://user@example.com:8443/a/b?k=v&z=#frag'"
+    [(proto, host, path, q, frag, port, param)] = engine.execute(
+        f"select url_extract_protocol({u}), url_extract_host({u}), "
+        f"url_extract_path({u}), url_extract_query({u}), "
+        f"url_extract_fragment({u}), url_extract_port({u}), "
+        f"url_extract_parameter({u}, 'k')")
+    assert (proto, host, path, q, frag, int(port), param) == (
+        "https", "example.com", "/a/b", "k=v&z=", "frag", 8443, "v")
+
+
+def test_binary_string_functions(engine):
+    [(hx, b64, m, enc)] = engine.execute(
+        "select to_hex('AB'), to_base64('hi'), md5(''), "
+        "url_encode('a b&c')")
+    assert hx == "4142" and b64 == "aGk="
+    assert m == "d41d8cd98f00b204e9800998ecf8427e"
+    assert enc == "a+b%26c"
+
+
+def test_if_and_typeof(engine):
+    [(y, n, t)] = engine.execute(
+        "select if(1 > 0, 'yes', 'no'), if(1 > 2, 5), typeof(1)")
+    assert y == "yes" and n is None and t == "bigint"
