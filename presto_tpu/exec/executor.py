@@ -14,6 +14,9 @@ rehash (MultiChannelGroupByHash.java:140).
 from __future__ import annotations
 
 import dataclasses
+import os
+import sys
+import time
 
 import jax
 import jax.numpy as jnp
@@ -449,7 +452,7 @@ def execute_plan(engine, plan: N.PlanNode) -> Table:
     grouped = try_execute_grouped(engine, plan)
     if grouped is not None:
         return grouped
-    if _count_joins(plan) > MAX_JOINS_PER_PROGRAM:
+    if _find_split(plan, engine) is not None:
         return _execute_segmented(engine, plan)
     scan_inputs = collect_scans(plan, engine)
     return run_plan(engine, plan, scan_inputs)
@@ -499,7 +502,12 @@ def prepare_plan(engine, plan: N.PlanNode, scan_inputs: list[ScanInput]):
             traced_fn, _host_arrays, meta = make_traced(
                 scan_inputs, plan, capacities, engine.session)
             compiled = jax.jit(traced_fn)
+            _t0 = time.perf_counter()
             out = compiled(*flat_arrays)
+            if os.environ.get("PRESTO_TPU_LOG_COMPILES"):
+                print(f"[compile] {time.perf_counter() - _t0:.1f}s "
+                      f"caps={dict(capacities)} "
+                      f"root={type(plan).__name__}", file=sys.stderr)
             # meta fills during the trace triggered by the first call
             engine._program_cache[(base_key, caps_key)] = (compiled, meta)
         else:
@@ -529,29 +537,93 @@ def _count_joins(node: N.PlanNode) -> int:
     return int(own) + sum(_count_joins(s) for s in node.sources())
 
 
-def _find_split(node: N.PlanNode):
+def _find_split(node: N.PlanNode, engine=None):
     """A subtree with <= MAX_JOINS_PER_PROGRAM joins (at least one) to
     materialize first, or None when the plan fits one program."""
     if _count_joins(node) <= MAX_JOINS_PER_PROGRAM:
-        return None
+        return _find_agg_input_split(node, engine)
     kids = node.sources()
     best = max(kids, key=_count_joins)
     c = _count_joins(best)
     if c > MAX_JOINS_PER_PROGRAM:
-        return _find_split(best)
-    return best if c >= 1 else None
+        return _find_split(best, engine)
+    if c < 1:
+        return None
+    # a grouped aggregate inside the chosen subtree still wants its own
+    # pre-compaction boundary (its group-by must not run at join width)
+    inner = _find_agg_input_split(best, engine)
+    return inner if inner is not None else best
+
+
+# minimum estimated scan rows under an aggregate before its input gets
+# its own compaction boundary: below this, two compiles + a host sync
+# cost more than grouping a small buffer at full width
+AGG_SPLIT_MIN_ROWS = 1 << 21
+
+
+def _subtree_scan_rows(node: N.PlanNode, engine) -> int:
+    """Largest base-scan row estimate in a subtree (carrier scans count
+    their materialized width)."""
+    if isinstance(node, N.TableScan):
+        conn = engine.catalogs.get(node.catalog)
+        if conn is None:
+            return 0
+        try:
+            return int(conn.row_count_estimate(node.table))
+        except Exception:
+            return 0
+    return max((_subtree_scan_rows(s, engine) for s in node.sources()),
+               default=0)
+
+
+def _find_agg_input_split(node: N.PlanNode, engine=None):
+    """Pre-aggregation compaction boundary: the input subtree of the
+    lowest grouped Aggregate that sits above at least one join.
+
+    Joins + selective filters leave most of a static-shape buffer dead
+    (TPC-H Q3 keeps ~3M of 60M lineitem rows), yet a monolithic program
+    runs the group-by's sort and payload permutations at full width —
+    random-access HBM passes at 60M rows cost ~1.5s each on v5e.
+    Materializing the aggregate's input as a segment lets
+    run_plan_device compact it to pow2(live) first, so grouping runs at
+    live width (15-20x narrower on Q3). The reference gets the same
+    effect for free from row-at-a-time paging between operators
+    (operator/HashAggregationOperator.java consumes compacted Pages);
+    a fixed-shape dataflow needs an explicit re-bucketing boundary."""
+    for s in node.sources():
+        found = _find_agg_input_split(s, engine)
+        if found is not None:
+            return found
+    if isinstance(node, N.Aggregate) and node.group_keys \
+            and not isinstance(node.source, N.TableScan) \
+            and _count_joins(node.source) >= 1 \
+            and (engine is None or _subtree_scan_rows(
+                node.source, engine) >= AGG_SPLIT_MIN_ROWS):
+        return node.source
+    return None
 
 
 def _collect_with_carriers(plan: N.PlanNode, engine,
                            carriers: dict[int, "ScanInput"]
                            ) -> list["ScanInput"]:
     out: list[ScanInput] = []
+    # segment carriers also resolve by their unique table name: the
+    # boundary-pruning pass (prune_columns in _prune_subtree) rebuilds
+    # every TableScan node, so identity alone cannot find a carrier
+    # inside a narrowed later segment
+    by_name = {
+        si.node.table: si for si in carriers.values()
+        if isinstance(si.node, N.TableScan)
+        and si.node.catalog == "__segment__"}
 
     def visit(node):
         if id(node) in carriers:
             out.append(carriers[id(node)])
             return
         if isinstance(node, N.TableScan):
+            if node.catalog == "__segment__" and node.table in by_name:
+                out.append(_rebind_carrier(by_name[node.table], node))
+                return
             out.extend(collect_scans(node, engine))
             return
         for s in node.sources():
@@ -563,9 +635,19 @@ def _collect_with_carriers(plan: N.PlanNode, engine,
 
 def _compact_kernel(live, data, cap: int):
     """Gather live rows to the front of a ``cap``-row buffer (device
-    gather; the page-compaction analog). Padding rows replicate the
-    last row and are marked dead in the returned live mask."""
-    idx = jnp.nonzero(live, size=cap, fill_value=live.shape[0] - 1)[0]
+    gather; the page-compaction analog). Padding slots hold arbitrary
+    dead rows' data and are marked dead in the returned live mask.
+
+    Live positions extract via one (u32 key, index) sort — stable, so
+    row order is preserved — then every column gathers at ``cap``
+    width. (jnp.nonzero's TPU lowering was measured at 5.4s on a
+    60M-row mask, ~20x the cost of the sort it replaces.)"""
+    n = live.shape[0]
+    key = jnp.where(live, jnp.uint32(0), jnp.uint32(1))
+    _, idx = jax.lax.sort(
+        (key, jnp.arange(n, dtype=jnp.int32)), num_keys=1,
+        is_stable=True)
+    idx = idx[:cap]
     out = {k: v[idx] for k, v in data.items()}
     newlive = jnp.arange(cap) < jnp.sum(live)
     return out, newlive
@@ -618,11 +700,15 @@ def _segment_carriers(engine, plan: N.PlanNode, pool_tag: str):
     carriers: dict[int, ScanInput] = {}
     seg = 0
     while True:
-        sub = _find_split(plan)
+        sub = _find_split(plan, engine)
         if sub is None:
             break
-        scans = _collect_with_carriers(sub, engine, carriers)
-        arrays, dicts, types, n = run_plan_device(engine, sub, scans)
+        needed = _needed_above(plan, sub)
+        mat = sub  # what actually materializes (possibly narrowed)
+        if needed is not None and needed < set(sub.output_symbols):
+            mat = _prune_subtree(sub, needed)
+        scans = _collect_with_carriers(mat, engine, carriers)
+        arrays, dicts, types, n = run_plan_device(engine, mat, scans)
         if pool is not None:
             pool.reserve(pool_tag, sum(
                 int(a.nbytes) for a in arrays.values()))
@@ -632,6 +718,94 @@ def _segment_carriers(engine, plan: N.PlanNode, pool_tag: str):
         carriers[id(cnode)] = ScanInput(cnode, arrays, dicts, types, n)
         plan = _replace_node(plan, sub, cnode)
     return plan, carriers
+
+
+def _rebind_carrier(si: "ScanInput", node: N.TableScan) -> "ScanInput":
+    """A carrier ScanInput re-pointed at a rebuilt (possibly
+    column-narrowed) copy of its scan node, arrays restricted to the
+    surviving symbols (+ their $valid/$len/$emask companions and the
+    table-level live mask)."""
+    if node is si.node and set(node.assignments) == set(si.types):
+        return si
+    keep = set(node.assignments)
+
+    def base(k: str) -> str:
+        # companion arrays ($valid/$len/$emask) follow their symbol;
+        # note partial-agg STATE symbols legitimately contain '$'
+        # (e.g. "rev$sum"), so only the companion suffix strips
+        if "$" in k:
+            b, suf = k.rsplit("$", 1)
+            if suf in ("valid", "len", "emask"):
+                return b
+        return k
+
+    arrays = {k: v for k, v in si.arrays.items()
+              if k == "__live__" or base(k) in keep}
+    return dataclasses.replace(
+        si, node=node, arrays=arrays,
+        dictionaries={s: si.dictionaries.get(s) for s in keep},
+        types={s: si.types[s] for s in keep})
+
+
+def _needed_above(plan: N.PlanNode, sub: N.PlanNode):
+    """Symbols of ``sub``'s output the rest of ``plan`` actually
+    consumes, or None when it cannot be determined.
+
+    A monolithic program gets this for free from XLA dead-code
+    elimination; a segment boundary materializes every output column,
+    so an unpruned boundary pays full-width gathers for columns only
+    ever used BELOW the split (join keys, filter inputs). Reuses the
+    optimizer's prune_columns per-node knowledge: splice a placeholder
+    scan where ``sub`` stands, prune the outer plan, and read back
+    which placeholder columns survived."""
+    from presto_tpu.exec.streaming import _replace_node
+    from presto_tpu.plan.optimizer import prune_columns
+
+    tag = "__needed_probe__"
+    probe = N.TableScan(tag, tag, {s: s for s in sub.output_symbols},
+                        dict(sub.output_types()))
+    try:
+        shadow = _replace_node(plan, sub, probe)
+        if isinstance(shadow, N.Output):
+            pruned = prune_columns(shadow)
+        else:
+            pruned = prune_columns(
+                shadow, set(shadow.output_symbols))
+    except Exception:
+        return None  # unprunable shape: materialize everything
+
+    found: list = []
+
+    def visit(node):
+        if isinstance(node, N.TableScan) and node.catalog == tag:
+            found.append(node)
+            return
+        for s in node.sources():
+            visit(s)
+
+    visit(pruned)
+    if len(found) != 1:
+        return None
+    return set(found[0].assignments)
+
+
+def _prune_subtree(sub: N.PlanNode, needed: set):
+    """Narrow a to-be-materialized subtree to ``needed`` output
+    symbols (falling back to the unpruned subtree on any failure).
+    An identity Project caps the subtree because relational nodes
+    (joins above all) cannot drop their own pass-through columns."""
+    from presto_tpu.expr import ir
+    from presto_tpu.plan.optimizer import prune_columns
+    types = dict(sub.output_types())
+    keep = [s for s in sub.output_symbols if s in needed]
+    cap = N.Project(sub, {s: ir.ColumnRef(types[s], s) for s in keep})
+    try:
+        pruned = prune_columns(cap, set(needed))
+    except Exception:
+        return sub
+    if not needed <= set(pruned.output_symbols):
+        return sub
+    return pruned
 
 
 def _execute_segmented(engine, plan: N.PlanNode) -> Table:

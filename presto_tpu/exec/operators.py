@@ -1076,10 +1076,15 @@ def apply_window(dt: DTable, node: N.Window) -> DTable:
                              v.dictionary)
                       for s, v in dt.cols.items()})
 
+    key_val = (c.columns.get(node.orderings[0].symbol)
+               if len(node.orderings) == 1 else None)
+    fctx = {"orderings": node.orderings, "same_peer": same_peer,
+            "same_part": same_part, "peer_start": peer_start,
+            "peer_end": peer_end, "key": key_val}
     for sym, call in node.functions.items():
         data, valid, dictionary = _window_fn(
             call, c, idx, part_start, peer_start, part_end, peer_end,
-            same_part, slive, n)
+            same_part, slive, n, fctx)
         # scatter back to original order
         data = data[inv]
         valid = None if valid is None else valid[inv]
@@ -1088,7 +1093,8 @@ def apply_window(dt: DTable, node: N.Window) -> DTable:
 
 
 def _window_fn(call: N.WindowCall, c: ExprCompiler, idx, part_start,
-               peer_start, part_end, peer_end, same_part, slive, n):
+               peer_start, part_end, peer_end, same_part, slive, n,
+               fctx=None):
     fn = call.fn
     if fn == "row_number":
         return (idx - part_start + 1), None, None
@@ -1124,7 +1130,7 @@ def _window_fn(call: N.WindowCall, c: ExprCompiler, idx, part_start,
     if fn in ("first_value", "last_value", "nth_value"):
         v = c.compile(call.args[0])
         lo, hi = _frame_bounds(call, idx, part_start, part_end,
-                               peer_end)
+                               peer_end, fctx)
         if fn == "first_value":
             at = lo
         elif fn == "last_value":
@@ -1165,11 +1171,13 @@ def _window_fn(call: N.WindowCall, c: ExprCompiler, idx, part_start,
         if jnp.issubdtype(vals.dtype, jnp.integer):
             vals = vals.astype(jnp.int64)
 
-        if call.rows_frame is not None and (
-                call.rows_frame[0] is not None
-                or call.rows_frame[1] is not None):
+        if (call.range_frame is not None
+                or call.groups_frame is not None
+                or (call.rows_frame is not None and (
+                    call.rows_frame[0] is not None
+                    or call.rows_frame[1] is not None))):
             return _frame_agg(call, fn, v, vals, w, idx, part_start,
-                              part_end, restart, n)
+                              part_end, restart, n, fctx)
 
         if call.rows_frame == (None, None) \
                 or call.frame == "full_partition":
@@ -1220,10 +1228,13 @@ def _window_fn(call: N.WindowCall, c: ExprCompiler, idx, part_start,
 
 
 def _frame_bounds(call: N.WindowCall, idx, part_start, part_end,
-                  peer_end):
+                  peer_end, fctx=None):
     """Inclusive sorted-position frame [lo, hi] for value functions and
     framed aggregates. Default (no explicit frame): RANGE UNBOUNDED
     PRECEDING..CURRENT ROW = partition start .. peer-group end."""
+    if call.range_frame is not None or call.groups_frame is not None:
+        return _dynamic_frame_bounds(call, fctx, idx, part_start,
+                                     part_end)
     rf = call.rows_frame
     if rf is not None:
         p, f = rf
@@ -1238,19 +1249,155 @@ def _frame_bounds(call: N.WindowCall, idx, part_start, part_end,
     return part_start, peer_end
 
 
+def _bounded_bsearch(vals, targets, lo0, hi0, left: bool, n: int):
+    """Per-row binary search: the insertion position of ``targets[i]``
+    in ascending ``vals`` restricted to [lo0[i], hi0[i]) — the
+    partition-respecting vectorized searchsorted behind RANGE frames
+    (log2(n) gather rounds; reference window/RangeFraming.java walks
+    row-at-a-time from the previous frame instead)."""
+
+    def body(_k, lh):
+        lo, hi = lh
+        mid = (lo + hi) >> 1
+        v = vals[jnp.clip(mid, 0, n - 1).astype(jnp.int32)]
+        go = (v < targets) if left else (v <= targets)
+        active = lo < hi
+        return (jnp.where(active & go, mid + 1, lo),
+                jnp.where(active & ~go, mid, hi))
+
+    iters = max(int(n - 1).bit_length(), 1) + 1
+    lo, hi = jax.lax.fori_loop(
+        0, iters, body,
+        (lo0.astype(jnp.int64), hi0.astype(jnp.int64)))
+    return lo
+
+
+def _dynamic_frame_bounds(call: N.WindowCall, fctx, idx, part_start,
+                          part_end):
+    """Inclusive [lo, hi] sorted positions of a value-based RANGE or a
+    GROUPS frame (reference window/RangeFraming.java,
+    GroupsFraming.java).
+
+    GROUPS: peer groups carry a GLOBALLY ascending dense id (cumsum of
+    group starts), so both bounds are one vectorized searchsorted each,
+    clamped into the partition. RANGE: the sort key is ascending within
+    each partition's non-null span, so bounds come from a
+    partition-bounded binary search over [key - preceding,
+    key + following]; null-key rows frame over their peer group (all
+    nulls), and UNBOUNDED sides keep whole-partition bounds (nulls
+    included), matching the reference's null handling."""
+    n = idx.shape[0]
+    peer_start, peer_end = fctx["peer_start"], fctx["peer_end"]
+    if call.groups_frame is not None:
+        p, f = call.groups_frame
+        gg = jnp.cumsum((~fctx["same_peer"]).astype(jnp.int64))
+        lo = part_start if p is None else jnp.maximum(
+            jnp.searchsorted(gg, gg - jnp.int64(p), side="left"),
+            part_start)
+        hi = part_end if f is None else jnp.minimum(
+            jnp.searchsorted(gg, gg + jnp.int64(f), side="right") - 1,
+            part_end)
+        return lo, hi
+
+    p, f = call.range_frame
+    o = fctx["orderings"][0]
+    kv = fctx["key"]
+    if jnp.issubdtype(kv.data.dtype, jnp.floating):
+        key = kv.data.astype(jnp.float64)
+        pv = jnp.float64(0 if p is None else p)
+        fv = jnp.float64(0 if f is None else f)
+    else:
+        key = kv.data.astype(jnp.int64)
+        pv = jnp.int64(0 if p is None else p)
+        fv = jnp.int64(0 if f is None else f)
+    if not o.ascending:
+        # descending keys negate into an ascending search; PRECEDING
+        # still points at the partition start side
+        key = -key
+    valid = kv.valid
+    if valid is None:
+        nn_start, nn_end = part_start, part_end
+        isnull = None
+    else:
+        isnull = ~valid
+        restart = ~fctx["same_part"]
+        npref = _segmented_scan(isnull.astype(jnp.int64), restart,
+                                jnp.add)
+        tot = npref[jnp.clip(part_end, 0, n - 1)]
+        if _nulls_last(o):
+            nn_start, nn_end = part_start, part_end - tot
+        else:
+            nn_start, nn_end = part_start + tot, part_end
+    lo = part_start if p is None else jnp.maximum(
+        _bounded_bsearch(key, key - pv, nn_start, nn_end + 1, True, n),
+        part_start)
+    hi = part_end if f is None else jnp.minimum(
+        _bounded_bsearch(key, key + fv, nn_start, nn_end + 1, False,
+                         n) - 1,
+        part_end)
+    if isnull is not None:
+        # a null-key row's offset frame is its peer group (all nulls)
+        if p is not None:
+            lo = jnp.where(isnull, peer_start, lo)
+        if f is not None:
+            hi = jnp.where(isnull, peer_end, hi)
+    return lo, hi
+
+
+def _sparse_minmax(masked, lo, hi, op, ident, n: int):
+    """min/max over arbitrary inclusive [lo, hi] spans via a doubling
+    sparse table: tables[k][i] covers [i, i + 2^k), a query is
+    op(T[k][lo], T[k][hi-2^k+1]) with k = floor(log2(width)) — log2(n)
+    elementwise passes to build, two 2D gathers to query (the
+    RMQ-sparse-table classic; the reference's per-row accumulator loop
+    has no vectorized analog)."""
+    if n > (1 << 23):
+        raise NotImplementedError(
+            "doubly-bounded RANGE/GROUPS min/max frames over >8M "
+            "sorted rows")
+    levels = [masked]
+    t = masked
+    k = 1
+    while (1 << k) <= n:
+        sh = 1 << (k - 1)
+        shifted = jnp.concatenate(
+            [t[sh:], jnp.full((sh,), ident, t.dtype)])
+        t = op(t, shifted)
+        levels.append(t)
+        k += 1
+    table = jnp.stack(levels)  # [K, n]
+    width = jnp.maximum(hi - lo + 1, 1)
+    kq = jnp.floor(jnp.log2(width.astype(jnp.float64))).astype(
+        jnp.int32)
+    kq = jnp.clip(kq, 0, len(levels) - 1)
+    span = jnp.left_shift(jnp.int64(1), kq.astype(jnp.int64))
+    a = table[kq, jnp.clip(lo, 0, n - 1).astype(jnp.int32)]
+    b = table[kq, jnp.clip(hi - span + 1, 0, n - 1).astype(jnp.int32)]
+    return op(a, b)
+
+
 def _frame_agg(call: N.WindowCall, fn: str, v, vals, w, idx,
-               part_start, part_end, restart, n):
-    """Aggregate over a general ROWS frame (reference
-    window/RowsFraming.java). sum/count/avg difference two points of
-    the segmented prefix scan; one-sided-unbounded min/max take a
-    (possibly reversed) running scan; doubly-bounded min/max unroll one
-    static shift+select pass per frame offset — linear in frame width,
-    so the width guard below caps the unrolled graph (a doubling
-    sparse table would cut this to log2(width) passes if wide frames
-    ever matter)."""
-    p, f = call.rows_frame
-    lo = part_start if p is None else jnp.maximum(idx - p, part_start)
-    hi = part_end if f is None else jnp.minimum(idx + f, part_end)
+               part_start, part_end, restart, n, fctx=None):
+    """Aggregate over a general ROWS/RANGE/GROUPS frame (reference
+    window/RowsFraming.java, RangeFraming.java, GroupsFraming.java).
+    sum/count/avg difference two points of the segmented prefix scan;
+    one-sided-unbounded min/max take a (possibly reversed) running
+    scan; doubly-bounded min/max unroll one static shift+select pass
+    per frame offset for ROWS (frames in practice are narrow) and use
+    a doubling sparse table for value/group frames whose width is
+    data-dependent."""
+    if call.rows_frame is not None:
+        p, f = call.rows_frame
+        lo = part_start if p is None else jnp.maximum(idx - p,
+                                                      part_start)
+        hi = part_end if f is None else jnp.minimum(idx + f, part_end)
+        rows_static = True
+    else:
+        p, f = (call.range_frame if call.range_frame is not None
+                else call.groups_frame)
+        lo, hi = _dynamic_frame_bounds(call, fctx, idx, part_start,
+                                       part_end)
+        rows_static = False
     empty = hi < lo
     hi_c = jnp.clip(hi, 0, n - 1).astype(jnp.int32)
     lo_c = jnp.clip(lo, 0, n - 1).astype(jnp.int32)
@@ -1296,6 +1443,10 @@ def _frame_agg(call: N.WindowCall, fn: str, v, vals, w, idx,
             s = _rsegmented_scan(masked, rrestart, op)
             run = s[lo_c]
         return jnp.where(empty, ident, run), cnt > 0, \
+            (v.dictionary if v is not None else None)
+    if not rows_static:
+        res = _sparse_minmax(masked, lo, hi, op, ident, n)
+        return jnp.where(empty, ident, res), cnt > 0, \
             (v.dictionary if v is not None else None)
     # bounded frame: one static shift + select per offset (width total
     # elementwise passes, no gathers; frames in practice are narrow —

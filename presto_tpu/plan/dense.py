@@ -230,21 +230,6 @@ def annotate_dense(plan: N.PlanNode, engine) -> N.PlanNode:
     """Attach dense_key hints to Join/SemiJoin nodes (bottom-up)."""
 
     def visit(node: N.PlanNode) -> N.PlanNode:
-        updates = {}
-        for f in dataclasses.fields(node):
-            v = getattr(node, f.name)
-            if isinstance(v, N.PlanNode):
-                nv = visit(v)
-                if nv is not v:
-                    updates[f.name] = nv
-            elif isinstance(v, list) and v \
-                    and isinstance(v[0], N.PlanNode):
-                nv = [visit(x) for x in v]
-                if any(a is not b for a, b in zip(nv, v)):
-                    updates[f.name] = nv
-        if updates:
-            node = dataclasses.replace(node, **updates)
-
         if isinstance(node, N.Join) and node.criteria \
                 and not node.build_unique \
                 and node.join_type in (N.JoinType.INNER,
@@ -300,4 +285,4 @@ def annotate_dense(plan: N.PlanNode, engine) -> N.PlanNode:
                 node = dataclasses.replace(node, dense_key=(lo, hi))
         return node
 
-    return visit(plan)
+    return N.rewrite_bottom_up(plan, visit)

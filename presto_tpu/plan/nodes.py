@@ -407,6 +407,16 @@ class WindowCall:
     # 1 PRECEDING -> (3, -1)) allowed (reference
     # operator/window/RowsFraming.java)
     rows_frame: Optional[tuple] = None
+    # value-based RANGE frame (preceding, following): offsets in the
+    # single sort key's PHYSICAL units (decimals scaled, dates in days,
+    # timestamps in micros), None = UNBOUNDED on that side, 0 = the
+    # CURRENT ROW peer group. Signs as in rows_frame. (reference
+    # operator/window/RangeFraming.java)
+    range_frame: Optional[tuple] = None
+    # GROUPS frame (preceding, following): peer-group distances from
+    # the current row's group, None = UNBOUNDED. (reference
+    # operator/window/GroupsFraming.java)
+    groups_frame: Optional[tuple] = None
 
 
 @dataclasses.dataclass
@@ -545,3 +555,28 @@ class Output(PlanNode):
     def output_types(self):
         src = self.source.output_types()
         return {s: src[s] for s in self.symbols}
+
+
+def rewrite_bottom_up(plan: PlanNode, fn) -> PlanNode:
+    """Rebuild a plan bottom-up, applying ``fn`` to every node after its
+    children (functional: unchanged subtrees keep their identity). The
+    shared walker behind annotate_dense / late_materialize-class passes
+    (the engine's analog of the reference's SimplePlanRewriter)."""
+
+    def visit(node: PlanNode) -> PlanNode:
+        updates = {}
+        for f in dataclasses.fields(node):
+            v = getattr(node, f.name)
+            if isinstance(v, PlanNode):
+                nv = visit(v)
+                if nv is not v:
+                    updates[f.name] = nv
+            elif isinstance(v, list) and v and isinstance(v[0], PlanNode):
+                nv = [visit(x) for x in v]
+                if any(a is not b for a, b in zip(nv, v)):
+                    updates[f.name] = nv
+        if updates:
+            node = dataclasses.replace(node, **updates)
+        return fn(node)
+
+    return visit(plan)

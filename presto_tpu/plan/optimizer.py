@@ -23,12 +23,21 @@ from presto_tpu.plan import nodes as N
 
 def optimize(plan: N.PlanNode, engine) -> N.PlanNode:
     from presto_tpu.plan.dense import annotate_dense
+    from presto_tpu.plan.latemat import late_materialize
     from presto_tpu.plan.rules import apply_rules
     plan = apply_rules(plan)
     plan = prune_columns(plan)
     plan = inline_trivial_projects(plan)
-    # physical-choice annotation runs last, over final plan shapes
+    # physical-choice annotation needs final plan shapes; late
+    # materialization needs its fd_keys annotations, then re-prunes (the
+    # narrowed aggregate source drops dependent columns) and
+    # re-annotates (its new re-join gets a dense hint)
     plan = annotate_dense(plan, engine)
+    lm = late_materialize(plan, engine)
+    if lm is not plan:
+        plan = prune_columns(lm)
+        plan = inline_trivial_projects(plan)
+        plan = annotate_dense(plan, engine)
     return plan
 
 

@@ -2287,12 +2287,64 @@ class LogicalPlanner:
             prod = min(prod * max(nd, 1), 1 << 40)
         return _next_pow2(max(2 * min(prod, est_rows, 1 << 21), 16))
 
-    def _plan_frame(self, frame_ast: "A.WindowFrame"):
-        """(frame tag, rows_frame) of an explicit frame clause.
-        General ROWS frames become (preceding, following) offsets
-        (reference window/RowsFraming.java); RANGE supports only the
-        SQL-default UNBOUNDED PRECEDING..CURRENT ROW shape (value-based
-        RANGE offsets — window/RangeFraming.java — not yet)."""
+    def _range_offset_value(self, bvalue, key_type: T.DataType):
+        """Convert a RANGE frame offset literal to the sort key's
+        PHYSICAL units (reference window/RangeFraming.java operates on
+        the native block encoding the same way: decimals are scaled
+        longs, dates are epoch days, timestamps epoch micros)."""
+        if key_type is None:
+            raise SemanticError(
+                "RANGE frame offsets require exactly one sort key")
+        if isinstance(bvalue, A.IntervalLiteral):
+            itype, iv = _interval_value(bvalue)
+            if isinstance(key_type, T.DateType):
+                if isinstance(itype, T.IntervalDayTimeType):
+                    if iv % 86_400_000_000:
+                        raise SemanticError(
+                            "RANGE offset for a DATE key must be a "
+                            "whole number of days")
+                    return iv // 86_400_000_000
+                raise SemanticError(
+                    "year-month RANGE offsets are not supported")
+            if isinstance(key_type, (T.TimestampType, T.TimeType)):
+                if isinstance(itype, T.IntervalDayTimeType):
+                    return iv
+                raise SemanticError(
+                    "year-month RANGE offsets are not supported")
+            raise SemanticError(
+                "interval RANGE offset requires a temporal sort key")
+        if isinstance(bvalue, A.NumericLiteral):
+            text = bvalue.text
+            if isinstance(key_type, (T.BigintType, T.IntegerType)):
+                if not text.isdigit():
+                    raise SemanticError(
+                        "RANGE offset must be a non-negative integer "
+                        "for an integer sort key")
+                return int(text)
+            if isinstance(key_type, T.DecimalType):
+                from decimal import Decimal
+                d = Decimal(text).scaleb(key_type.scale)
+                if d != d.to_integral_value():
+                    raise SemanticError(
+                        "RANGE offset has more decimal places than "
+                        "the sort key's scale")
+                return int(d)
+            if isinstance(key_type, T.DoubleType):
+                return float(text)
+            raise SemanticError(
+                f"RANGE offsets are not supported over "
+                f"{key_type} sort keys")
+        raise SemanticError("RANGE frame offsets must be literals")
+
+    def _plan_frame(self, frame_ast: "A.WindowFrame",
+                    key_type: T.DataType | None = None):
+        """(frame tag, rows_frame, range_frame, groups_frame) of an
+        explicit frame clause. ROWS/GROUPS frames become (preceding,
+        following) offsets (reference window/RowsFraming.java,
+        GroupsFraming.java); value-based RANGE offsets convert to the
+        sort key's physical units (RangeFraming.java)."""
+        unit = frame_ast.unit
+
         def bound_offset(btype, bvalue, is_start):
             if btype == "unbounded_preceding":
                 return None if is_start else 0  # degenerate, clamped
@@ -2300,39 +2352,56 @@ class LogicalPlanner:
                 return None
             if btype == "current":
                 return 0
-            if bvalue is None or not isinstance(
-                    bvalue, A.NumericLiteral) \
-                    or not bvalue.text.isdigit():
-                raise SemanticError(
-                    "frame offsets must be non-negative integer "
-                    "literals")
-            k = int(bvalue.text)
+            if unit == "range":
+                k = self._range_offset_value(bvalue, key_type)
+            else:
+                if bvalue is None or not isinstance(
+                        bvalue, A.NumericLiteral) \
+                        or not bvalue.text.isdigit():
+                    raise SemanticError(
+                        "frame offsets must be non-negative integer "
+                        "literals")
+                k = int(bvalue.text)
             return k if btype == "preceding" else -k
 
         start_t, end_t = frame_ast.start_type, frame_ast.end_type
-        if frame_ast.unit == "range":
-            if start_t == "unbounded_preceding" \
-                    and end_t in ("current", None):
-                return None, None  # the SQL default running frame
-            raise SemanticError(
-                "RANGE frames support only UNBOUNDED PRECEDING.."
-                "CURRENT ROW")
-        if frame_ast.unit != "rows":
-            raise SemanticError(f"{frame_ast.unit} frames unsupported")
         if start_t == "unbounded_preceding" and end_t in ("current",
                                                           None):
-            return "rows_unbounded_current", None
-        # rows_frame is (preceding, following): the frame covers sorted
-        # positions [idx - preceding, idx + following], so a start
-        # bound negates "following" and an end bound negates "preceding"
+            # the SQL default running frame (RANGE peers included;
+            # ROWS/GROUPS distinguished in the executor)
+            if unit == "rows":
+                return "rows_unbounded_current", None, None, None
+            # RANGE/GROUPS UNBOUNDED PRECEDING..CURRENT ROW both cover
+            # partition start through the current peer group's end —
+            # exactly the default running frame
+            return None, None, None, None
+        if unit == "range" \
+                and start_t not in ("preceding", "following") \
+                and end_t not in ("preceding", "following"):
+            # offset-free RANGE bounds (UNBOUNDED/CURRENT ROW) are
+            # peer-group positional, identical to the GROUPS frame with
+            # 0 standing for CURRENT ROW — no sort-key arithmetic, so
+            # multi-key windows are fine (reference RangeFraming
+            # special-cases these the same way)
+            p = None if start_t == "unbounded_preceding" else 0
+            f = None if end_t == "unbounded_following" else 0
+            return None, None, None, (p, f)
+        # (preceding, following): the frame covers sorted positions /
+        # key values / peer groups in [cur - preceding, cur +
+        # following], so a start bound negates "following" and an end
+        # bound negates "preceding"
         p = bound_offset(start_t, frame_ast.start_value, True)
         if end_t is None:
-            f = 0  # 'ROWS k PRECEDING' means k PRECEDING..CURRENT
+            f = 0  # 'k PRECEDING' alone means k PRECEDING..CURRENT
         else:
             f = bound_offset(end_t, frame_ast.end_value, False)
             if f is not None:
                 f = -f
-        return None, (p, f)
+        if unit == "rows":
+            return None, (p, f), None, None
+        if unit == "range":
+            return None, None, (p, f), None
+        return None, None, None, (p, f)
 
     def _plan_windows(self, qs: QState,
                       calls: list[A.FunctionCall], ctx: ExprCtx,
@@ -2352,21 +2421,28 @@ class LogicalPlanner:
                 p_ir = self._plan_scalar_expr(qs, pe, ctx, ctes, group_map)
                 part_syms.append(qs.add_projection(p_ir, "wpart", self))
             orderings = []
+            ctx_types = []
             for item in w.order_by:
                 o_ir = self._plan_scalar_expr(qs, item.expression, ctx,
                                               ctes, group_map)
                 sym = qs.add_projection(o_ir, "worder", self)
                 orderings.append(N.Ordering(sym, item.ascending,
                                             item.nulls_first))
+                ctx_types.append(o_ir.dtype)
             frame = None
             rows_frame = None
+            range_frame = None
+            groups_frame = None
             if not w.order_by:
                 if frame_ast is not None:
                     raise SemanticError(
                         "window frame requires ORDER BY")
                 frame = "full_partition"
             elif frame_ast is not None:
-                frame, rows_frame = self._plan_frame(frame_ast)
+                key_type = (ctx_types[0] if len(orderings) == 1
+                            else None)
+                frame, rows_frame, range_frame, groups_frame = \
+                    self._plan_frame(frame_ast, key_type)
             functions: dict[str, N.WindowCall] = {}
             for call in group:
                 fn = call.name
@@ -2404,7 +2480,8 @@ class LogicalPlanner:
                             "integer")
                 sym = self.symbols.fresh(fn)
                 functions[sym] = N.WindowCall(fn, args, dtype, frame,
-                                              rows_frame)
+                                              rows_frame, range_frame,
+                                              groups_frame)
                 ctx.subquery_syms[call] = ir.ColumnRef(dtype, sym)
             qs.node = N.Window(qs.node, part_syms, orderings, functions)
             qs.scope = Scope(qs.scope.fields + [

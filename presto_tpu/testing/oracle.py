@@ -76,6 +76,10 @@ class SqliteOracle:
                     decoded = [str(s) for s in decoded]
                 else:
                     decoded = decoded.tolist()
+                if col.valid is not None:
+                    valid = np.asarray(col.valid)
+                    decoded = [d if ok else None
+                               for d, ok in zip(decoded, valid)]
                 arrays.append(decoded)
             rows = list(zip(*arrays)) if arrays else []
             ph = ", ".join("?" for _ in schema)

@@ -83,3 +83,142 @@ def test_varchar_window_functions(engine, oracle):
                                  order by n_name) as lg,
                max(n_name) over (partition by n_regionkey) as mx
         from nation order by n_name""")
+
+
+# ---- value-based RANGE frames (reference window/RangeFraming.java) ----
+
+
+def test_range_offset_frame_sum(engine, oracle):
+    assert_query(engine, oracle, """
+        select o_orderkey,
+               sum(o_totalprice) over (partition by o_custkey
+                 order by o_orderkey
+                 range between 5 preceding and 5 following) as s
+        from orders where o_custkey < 40
+        order by o_orderkey""")
+
+
+def test_range_offset_preceding_only(engine, oracle):
+    assert_query(engine, oracle, """
+        select o_orderkey,
+               count(*) over (order by o_orderkey
+                 range 1000 preceding) as c
+        from orders where o_custkey < 60
+        order by o_orderkey""")
+
+
+def test_range_offset_min_max(engine, oracle):
+    assert_query(engine, oracle, """
+        select o_orderkey,
+               max(o_totalprice) over (order by o_orderkey
+                 range between 500 preceding and 500 following) as mx,
+               min(o_totalprice) over (order by o_orderkey
+                 range between 500 preceding and 500 following) as mn
+        from orders where o_custkey < 60
+        order by o_orderkey""")
+
+
+def test_range_offset_desc(engine, oracle):
+    assert_query(engine, oracle, """
+        select o_orderkey,
+               sum(o_totalprice) over (order by o_orderkey desc
+                 range between 700 preceding and 300 following) as s
+        from orders where o_custkey < 50
+        order by o_orderkey""")
+
+
+def test_range_unbounded_to_offset(engine, oracle):
+    assert_query(engine, oracle, """
+        select o_orderkey,
+               sum(o_totalprice) over (order by o_orderkey
+                 range between unbounded preceding
+                 and 100 following) as s,
+               count(*) over (order by o_orderkey
+                 range between 100 preceding
+                 and unbounded following) as c
+        from orders where o_custkey < 50
+        order by o_orderkey""")
+
+
+def test_range_frame_with_peers(engine, oracle):
+    # duplicate key values: the frame is value-based, peers share it
+    assert_query(engine, oracle, """
+        select n_nationkey,
+               sum(n_nationkey) over (order by n_regionkey
+                 range between 1 preceding and 1 following) as s
+        from nation order by n_nationkey""")
+
+
+def test_range_first_last_value(engine, oracle):
+    assert_query(engine, oracle, """
+        select o_orderkey,
+               first_value(o_orderkey) over (order by o_orderkey
+                 range between 300 preceding and 300 following) as fv,
+               last_value(o_orderkey) over (order by o_orderkey
+                 range between 300 preceding and 300 following) as lv
+        from orders where o_custkey < 40
+        order by o_orderkey""")
+
+
+# ---- GROUPS frames (reference window/GroupsFraming.java) --------------
+
+
+def test_groups_frame_sum(engine, oracle):
+    assert_query(engine, oracle, """
+        select n_nationkey,
+               sum(n_nationkey) over (order by n_regionkey
+                 groups between 1 preceding and 1 following) as s
+        from nation order by n_nationkey""")
+
+
+def test_groups_frame_current_row(engine, oracle):
+    # GROUPS CURRENT ROW spans the whole peer group, both directions
+    assert_query(engine, oracle, """
+        select n_nationkey,
+               count(*) over (order by n_regionkey
+                 groups between current row and current row) as c
+        from nation order by n_nationkey""")
+
+
+def test_groups_frame_min_max_partitioned(engine, oracle):
+    assert_query(engine, oracle, """
+        select o_orderkey,
+               max(o_totalprice) over (partition by o_orderstatus
+                 order by o_custkey
+                 groups between 2 preceding and 2 following) as mx
+        from orders where o_custkey < 50
+        order by o_orderkey""")
+
+
+def test_groups_frame_unbounded_side(engine, oracle):
+    assert_query(engine, oracle, """
+        select n_nationkey,
+               sum(n_nationkey) over (order by n_regionkey
+                 groups between unbounded preceding
+                 and 1 following) as s
+        from nation order by n_nationkey""")
+
+
+def test_range_frame_null_keys(engine, oracle):
+    # NULL sort keys: offset frames cover the null peer group only;
+    # explicit NULLS LAST keeps the engine and sqlite layouts aligned
+    import numpy as np
+    from presto_tpu import types as T
+    from presto_tpu.connectors.memory import MemoryConnector
+    mem = MemoryConnector()
+    vals = np.asarray([10, 20, 20, 35, 0, 0, 50], dtype=np.int64)
+    valid = np.asarray([1, 1, 1, 1, 0, 0, 1], dtype=bool)
+    mem.create_table(
+        "t", {"id": T.BIGINT, "v": T.BIGINT},
+        {"id": np.arange(7), "v": vals},
+        {"id": None, "v": valid})
+    engine.register_catalog("mem", mem)
+    oracle.load_connector(mem)
+    from presto_tpu.testing.oracle import assert_query
+    assert_query(engine, oracle, """
+        select id,
+               sum(id) over (order by v asc nulls last
+                 range between 10 preceding and 10 following) as s,
+               count(*) over (order by v desc nulls first
+                 range between 15 preceding and 5 following) as c
+        from mem.t order by id""")
