@@ -178,6 +178,19 @@ def _decode_column(dtype: T.DataType, data: np.ndarray, dictionary):
         out = np.where((data < 0) | (data >= len(dictionary)), "", out)
         return out
     if isinstance(dtype, T.DecimalType):
+        if getattr(data, "ndim", 1) == 2:
+            # LONG decimal limbs [n, 2] -> exact Python Decimals
+            import decimal
+            lo = data[:, 0].astype(np.uint64)
+            hi = data[:, 1].astype(np.int64)
+            out = np.empty(len(data), object)
+            with decimal.localcontext() as ctx:
+                ctx.prec = 50  # 38 digits + headroom for quantize
+                q = decimal.Decimal(10) ** -dtype.scale
+                for i in range(len(data)):
+                    raw = int(hi[i]) * (1 << 64) + int(lo[i])
+                    out[i] = (decimal.Decimal(raw) * q).quantize(q)
+            return out
         return data.astype(np.float64) / dtype.unscale_factor
     if isinstance(dtype, T.DateType):
         epoch = np.datetime64("1970-01-01")

@@ -562,9 +562,13 @@ AGG_SPLIT_MIN_ROWS = 1 << 21
 
 
 def _subtree_scan_rows(node: N.PlanNode, engine) -> int:
-    """Largest base-scan row estimate in a subtree (carrier scans count
-    their materialized width)."""
+    """Largest base-scan row estimate in a subtree. Segment carrier
+    scans count as LARGE: a carrier only exists because an earlier
+    split materialized a big intermediate, and its static width is the
+    width the aggregate would otherwise churn through."""
     if isinstance(node, N.TableScan):
+        if node.catalog == "__segment__":
+            return 1 << 62
         conn = engine.catalogs.get(node.catalog)
         if conn is None:
             return 0
@@ -689,11 +693,16 @@ def run_plan_device(engine, plan: N.PlanNode,
     return arrays, dicts, types, n
 
 
-def _segment_carriers(engine, plan: N.PlanNode, pool_tag: str):
+def _segment_carriers(engine, plan: N.PlanNode, pool_tag: str,
+                      observer=None):
     """Materialize many-join subtrees as device-resident carrier scans
     until the remaining plan fits one program. Returns the rewritten
     plan + carrier inputs. Carrier bytes are reserved under
-    ``pool_tag`` (freed by the caller when the pipeline finishes)."""
+    ``pool_tag`` (freed by the caller when the pipeline finishes).
+    ``observer(seg, mat, arrays, n, wall_s)`` fires after each segment
+    materializes — EXPLAIN ANALYZE's per-segment attribution hooks in
+    here so profiling always follows the real execution's split/prune
+    sequence."""
     from presto_tpu.exec.streaming import _replace_node
 
     pool = getattr(engine, "memory_pool", None)
@@ -708,10 +717,14 @@ def _segment_carriers(engine, plan: N.PlanNode, pool_tag: str):
         if needed is not None and needed < set(sub.output_symbols):
             mat = _prune_subtree(sub, needed)
         scans = _collect_with_carriers(mat, engine, carriers)
+        _t0 = time.perf_counter()
         arrays, dicts, types, n = run_plan_device(engine, mat, scans)
         if pool is not None:
             pool.reserve(pool_tag, sum(
                 int(a.nbytes) for a in arrays.values()))
+        if observer is not None:
+            observer(seg, mat, arrays, n,
+                     time.perf_counter() - _t0)
         cnode = N.TableScan("__segment__", f"s{seg}",
                             {s: s for s in types}, types)
         seg += 1

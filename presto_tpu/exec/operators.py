@@ -79,6 +79,13 @@ def apply_project(dt: DTable, assignments: dict[str, ir.Expr]) -> DTable:
             if valid is not None and getattr(valid, "ndim", 1) == 0:
                 valid = jnp.broadcast_to(valid, (dt.n,))
             v = Val(v.dtype, data, valid, v.dictionary)
+        elif (isinstance(v.dtype, T.DecimalType) and v.dtype.is_long
+              and data.ndim == 1):  # scalar LONG decimal: [2] limbs
+            data = jnp.broadcast_to(data, (dt.n, 2))
+            valid = v.valid
+            if valid is not None and getattr(valid, "ndim", 1) == 0:
+                valid = jnp.broadcast_to(valid, (dt.n,))
+            v = Val(v.dtype, data, valid, v.dictionary)
         out[sym] = v
     return DTable(out, dt.live, dt.n)
 
@@ -89,6 +96,11 @@ def _row_hash(dt: DTable, keys: list[str]):
         v = dt.cols[k]
         if v.is_string:
             hs.append(H.hash_string_column(v.data, v.dictionary, v.valid))
+        elif getattr(v.data, "ndim", 1) == 2:
+            # LONG decimal: both int64 limbs feed the row key (exactness
+            # still comes from the limb secondary sort keys downstream)
+            hs.append(H.hash_int_column(v.data[:, 0], v.valid))
+            hs.append(H.hash_int_column(v.data[:, 1], v.valid))
         else:
             hs.append(H.hash_int_column(v.data, v.valid))
     return H.combine_hashes(hs)
@@ -96,6 +108,25 @@ def _row_hash(dt: DTable, keys: list[str]):
 
 # Max code-product capacity for the direct dictionary-code group-by path.
 _DIRECT_GROUP_MAX = 1 << 16
+
+
+def _long_key_operands(v: Val):
+    """LONG decimal grouping identity as two u64 sort operands
+    (order-preserving: sign-flipped high limb, then the low limb);
+    NULL rows collapse to zeros (validity rides separately)."""
+    from presto_tpu.ops import int128 as I
+    khi, klo = I.sort_keys(v.data)
+    if v.valid is not None:
+        khi = jnp.where(v.valid, khi, jnp.uint64(0))
+        klo = jnp.where(v.valid, klo, jnp.uint64(0))
+    return khi, klo
+
+
+def _unpack_long_key(khi, klo):
+    """Inverse of _long_key_operands (modulo NULL collapsing): [n, 2]
+    limbs."""
+    from presto_tpu.ops import int128 as I
+    return I.pack(klo, (khi ^ jnp.uint64(1 << 63)).astype(jnp.int64))
 
 
 def _group_key_operand(v: Val):
@@ -161,6 +192,22 @@ def _agg_call_inputs(c: ExprCompiler, dt: DTable, call, live):
         else:
             weight = live if av.valid is None else (live & av.valid)
         data = A.prepare_arg(call.fn, av.data, av.dtype)
+        if A.is_long_decimal(av.dtype) and getattr(
+                data, "ndim", 1) == 1:
+            # scalar long-decimal literal: [2] limbs -> [n, 2]
+            data = jnp.broadcast_to(data, (dt.n, 2))
+        if A.is_long_decimal(av.dtype) and getattr(
+                data, "ndim", 1) == 2:
+            if call.fn in ("sum", "avg", "min", "max",
+                           "arbitrary", "count"):
+                # int128 [n, 2] -> separate low/high limb columns so the
+                # existing (data, data2) plumbing (sort payloads, state
+                # columns) stays 1D throughout
+                data, data2 = data[:, 0], data[:, 1]
+            else:
+                raise NotImplementedError(
+                    f"{call.fn} over long decimals (precision > 18) "
+                    "is not supported yet")
         if call.fn == "checksum" and av.valid is not None:
             data = jnp.where(av.valid, data,
                              jnp.uint64(0x2545F4914F6CDD1D))
@@ -221,6 +268,12 @@ def _apply_aggregate_sorted(dt: DTable, node: N.Aggregate, capacity: int,
         if k not in id_keys:
             plain_keys.append((k, v, None if v.valid is None else v.valid))
             continue
+        if getattr(v.data, "ndim", 1) == 2:  # LONG decimal key
+            khi, klo = _long_key_operands(v)
+            hi_idx, lo_idx = _add(khi), _add(klo)
+            valid_idx = None if v.valid is None else _add(v.valid)
+            key_refs.append((k, v, ("long", hi_idx, lo_idx), valid_idx))
+            continue
         norm_idx = _add(_group_key_operand(v))
         valid_idx = None if v.valid is None else _add(v.valid)
         if jnp.issubdtype(v.data.dtype, jnp.floating):
@@ -235,6 +288,11 @@ def _apply_aggregate_sorted(dt: DTable, node: N.Aggregate, capacity: int,
             valid_idx = valid_ref
         else:
             valid_idx = _add(valid_ref)
+        if getattr(v.data, "ndim", 1) == 2:  # LONG decimal payload
+            khi, klo = _long_key_operands(v)
+            key_refs.append((k, v, ("long", _add(khi), _add(klo)),
+                             valid_idx))
+            continue
         key_refs.append((k, v, _add(v.data), valid_idx))
 
     call_refs: dict[str, tuple] = {}
@@ -245,7 +303,7 @@ def _apply_aggregate_sorted(dt: DTable, node: N.Aggregate, capacity: int,
             arg_type = sum_state.dtype if sum_state is not None else None
             if scan:
                 idxs = {f: _add(dt.cols[f"{sym}${f}"].data)
-                        for f in A.state_fields(call.fn)}
+                        for f in A.state_fields(call)}
                 call_refs[sym] = ("merge", idxs, arg_type)
             else:
                 call_refs[sym] = ("seg", None, arg_type)
@@ -273,7 +331,9 @@ def _apply_aggregate_sorted(dt: DTable, node: N.Aggregate, capacity: int,
         compact_in.append(arr)
         return len(compact_in) - 1
 
-    key_out = [(sym, v, _adc(sp[di]),
+    key_out = [(sym, v,
+                ("long", _adc(sp[di[1]]), _adc(sp[di[2]]))
+                if isinstance(di, tuple) else _adc(sp[di]),
                 None if vi is None else _adc(sp[vi]))
                for sym, v, di, vi in key_refs]
 
@@ -300,7 +360,7 @@ def _apply_aggregate_sorted(dt: DTable, node: N.Aggregate, capacity: int,
             if slots is None:
                 slots = sg.slots()
             if is_final:
-                fields = A.state_fields(call.fn)
+                fields = A.state_fields(call)
                 seg_states[sym] = A.merge(
                     call.fn,
                     {f: dt.cols[f"{sym}${f}"].data for f in fields},
@@ -317,6 +377,10 @@ def _apply_aggregate_sorted(dt: DTable, node: N.Aggregate, capacity: int,
     out: dict[str, Val] = {}
     for sym, v, di, vi in key_out:
         valid = None if vi is None else compacted[vi]
+        if isinstance(di, tuple):  # LONG decimal limbs
+            data = _unpack_long_key(compacted[di[1]], compacted[di[2]])
+            out[sym] = Val(v.dtype, data, valid, v.dictionary)
+            continue
         out[sym] = Val(v.dtype, compacted[di], valid, v.dictionary)
 
     for sym, call in node.aggs.items():
@@ -386,7 +450,7 @@ def apply_aggregate(dt: DTable, node: N.Aggregate, capacity: int) -> tuple:
         out_dictionary = None
         if is_final:
             states = {f: dt.cols[f"{sym}${f}"].data
-                      for f in A.state_fields(call.fn)}
+                      for f in A.state_fields(call)}
             val_state = dt.cols.get(
                 f"{sym}$xval" if call.fn in A.BY_FNS else f"{sym}$val")
             if val_state is not None:
@@ -800,7 +864,8 @@ def apply_cross_scalar(left: DTable, right: DTable) -> DTable:
     any_live = jnp.any(rlive)
     out = dict(left.cols)
     for sym, v in right.cols.items():
-        data = jnp.broadcast_to(v.data[idx], (left.n,))
+        data = jnp.broadcast_to(v.data[idx],
+                                (left.n,) + v.data.shape[1:])
         rv = any_live if v.valid is None else (any_live & v.valid[idx])
         valid = jnp.broadcast_to(rv, (left.n,))
         out[sym] = Val(v.dtype, data, valid, v.dictionary)
@@ -837,9 +902,17 @@ def apply_union(parts: list[DTable], node: N.Union) -> DTable:
             vals.append(v if v.is_string else cast_val(v, dtype))
         if isinstance(dtype, T.VarcharType):
             vals = _unify_string_vals(vals)
-        data = jnp.concatenate([
-            jnp.broadcast_to(v.data, (p.n,))
-            for v, p in zip(vals, parts)])
+        long_dec = isinstance(dtype, T.DecimalType) and dtype.is_long
+
+        def part_data(v, p):
+            if long_dec:  # [n,2] / scalar [2] limbs -> [p.n, 2]
+                return jnp.broadcast_to(
+                    v.data if v.data.ndim == 2 else v.data[None, :],
+                    (p.n, 2))
+            return jnp.broadcast_to(v.data, (p.n,))
+
+        data = jnp.concatenate([part_data(v, p)
+                                for v, p in zip(vals, parts)])
         if any(v.valid is not None for v in vals):
             valid = jnp.concatenate([
                 v.valid if v.valid is not None
@@ -862,6 +935,23 @@ def _sort_keys(dt: DTable, orderings: list[N.Ordering]) -> list:
     for o in orderings:
         v = dt.cols[o.symbol]
         data = v.data
+        if getattr(data, "ndim", 1) == 2:
+            # LONG decimal: int128 limbs -> two u64 key levels
+            # (sign-flipped high word, then the unsigned low word);
+            # descending order complements both levels
+            from presto_tpu.ops import int128 as I
+            khi, klo = I.sort_keys(data)
+            if not o.ascending:
+                khi, klo = ~khi, ~klo
+            if v.valid is not None:
+                cls = jnp.where(v.valid, 0, 2 if _nulls_last(o) else -2
+                                ).astype(jnp.int32)
+                khi = jnp.where(v.valid, khi, jnp.uint64(0))
+                klo = jnp.where(v.valid, klo, jnp.uint64(0))
+                keys.append(cls)
+            keys.append(khi)
+            keys.append(klo)
+            continue
         if data.dtype == jnp.bool_:
             data = data.astype(jnp.int32)
         is_float = jnp.issubdtype(data.dtype, jnp.floating)
@@ -1010,8 +1100,11 @@ def _keys_equal_prev(vals: list[Val], sorted_perm) -> object:
     eq = jnp.ones((n,), dtype=bool)
     for v in vals:
         d = v.data[sorted_perm]
+        pair_eq = d[1:] == d[:-1]
+        if pair_eq.ndim == 2:  # LONG decimal limbs: equal iff both are
+            pair_eq = pair_eq.all(axis=-1)
         same = jnp.concatenate(
-            [jnp.zeros((1,), bool), d[1:] == d[:-1]])
+            [jnp.zeros((1,), bool), pair_eq])
         if v.valid is not None:
             vv = v.valid[sorted_perm]
             both_null = jnp.concatenate(
@@ -1159,6 +1252,10 @@ def _window_fn(call: N.WindowCall, c: ExprCompiler, idx, part_start,
     if fn in ("sum", "count", "avg", "min", "max"):
         if call.args:
             v = c.compile(call.args[0])
+            if getattr(v.data, "ndim", 1) == 2:
+                raise NotImplementedError(
+                    "window aggregates over long decimals "
+                    "(precision > 18) are not supported yet")
             w = slive if v.valid is None else (slive & v.valid)
             vals = v.data
         else:
@@ -1564,7 +1661,11 @@ def apply_mark_distinct(dt: DTable, node: N.MarkDistinct,
     key_ops = []
     for k in node.keys:
         v = dt.cols[k]
-        key_ops.append(_group_key_operand(v))
+        if getattr(v.data, "ndim", 1) == 2:  # LONG decimal key
+            khi, klo = _long_key_operands(v)
+            key_ops.extend([khi, klo])
+        else:
+            key_ops.append(_group_key_operand(v))
         if v.valid is not None:
             key_ops.append(v.valid)
     sg = H.SortedGroups(rh, live, key_ops, len(key_ops))
@@ -1592,6 +1693,16 @@ def apply_distinct(dt: DTable, capacity: int) -> tuple:
     float_cols = []
     for sym, v in dt.cols.items():
         di = len(payloads)
+        if getattr(v.data, "ndim", 1) == 2:  # LONG decimal key
+            khi, klo = _long_key_operands(v)
+            payloads.append(khi)
+            payloads.append(klo)
+            vi = None
+            if v.valid is not None:
+                vi = len(payloads)
+                payloads.append(v.valid)
+            refs.append((sym, v, ("long", di, di + 1), vi))
+            continue
         payloads.append(_group_key_operand(v))
         vi = None
         if v.valid is not None:
@@ -1611,5 +1722,9 @@ def apply_distinct(dt: DTable, capacity: int) -> tuple:
     out = {}
     for sym, v, di, vi in refs:
         valid = None if vi is None else compacted[vi]
+        if isinstance(di, tuple):  # LONG decimal limbs
+            data = _unpack_long_key(compacted[di[1]], compacted[di[2]])
+            out[sym] = Val(v.dtype, data, valid, v.dictionary)
+            continue
         out[sym] = Val(v.dtype, compacted[di], valid, v.dictionary)
     return DTable(out, occupied, capacity), ok

@@ -102,7 +102,11 @@ def output_type(fn: str, arg_type: T.DataType | None) -> T.DataType:
         return T.BOOLEAN
     if fn == "sum":
         if isinstance(arg_type, T.DecimalType):
-            return T.DecimalType(18, arg_type.scale)
+            # LONG input sums exactly in int128 limbs -> decimal(38, s)
+            # (reference DecimalSumAggregation); short inputs keep the
+            # int64 state (documented headroom: |sum| < 2^63)
+            return T.DecimalType(38 if arg_type.is_long else 18,
+                                 arg_type.scale)
         if isinstance(arg_type, T.DoubleType):
             return T.DOUBLE
         return T.BIGINT
@@ -113,7 +117,8 @@ def output_type(fn: str, arg_type: T.DataType | None) -> T.DataType:
             # tpch catalog serves decimal columns, so parity demands the
             # decimal behavior, not the DOUBLE the reference shows on
             # its own all-DOUBLE tpch catalog
-            return T.DecimalType(18, arg_type.scale)
+            return T.DecimalType(38 if arg_type.is_long else 18,
+                                 arg_type.scale)
         return T.DOUBLE
     if fn in ("min", "max", "arbitrary"):
         return arg_type
@@ -135,6 +140,10 @@ def state_type(call: "AggCall", field: str) -> T.DataType:
     aggregation states shipped through exchanges)."""
     if field == "count":
         return T.BIGINT
+    if field in ("a", "b", "hi"):
+        return T.BIGINT  # int128 limb sums (long-decimal sum/avg)
+    if field in ("vlo", "vhi"):
+        return T.BIGINT  # int128 extremum limbs (long-decimal min/max)
     if field == "sum":
         if call.fn == "checksum":
             return T.BIGINT  # wrapping uint64 hash sum, bitcast
@@ -165,7 +174,16 @@ def state_type(call: "AggCall", field: str) -> T.DataType:
 
 
 # state column suffixes per function (partial aggregation schema)
-def state_fields(fn: str) -> list[str]:
+def state_fields(fn) -> list[str]:
+    """``fn`` is a function name or an AggCall (needed to distinguish
+    the long-decimal sum/avg limb states from the int64 state)."""
+    if not isinstance(fn, str):
+        call = fn
+        if long_sum_call(call):
+            return ["a", "b", "hi", "count"]
+        if long_minmax_call(call):
+            return ["vlo", "vhi", "count"]
+        fn = call.fn
     if fn in ("count", "count_star", "count_if"):
         return ["count"]
     if fn == "sum":
@@ -217,6 +235,65 @@ def _value_hash(data):
     else:
         bits = data.astype(jnp.int64).astype(jnp.uint64)
     return _splitmix64(bits)
+
+
+def is_long_decimal(t) -> bool:
+    return isinstance(t, T.DecimalType) and t.is_long
+
+
+def long_minmax_call(call) -> bool:
+    """min/max/arbitrary over a LONG decimal argument: the state is the
+    extremum's two int64 limbs (vlo/vhi)."""
+    return (call.fn in ("min", "max", "arbitrary")
+            and call.arg is not None
+            and is_long_decimal(call.arg.dtype))
+
+
+def long_sum_call(call) -> bool:
+    """True for sum/avg over a LONG decimal argument: the state is the
+    exact int128 limb decomposition (fields a/b/hi/count) instead of an
+    int64 running sum (reference DecimalSumAggregation's
+    Int128State)."""
+    return (call.fn in ("sum", "avg") and call.arg is not None
+            and is_long_decimal(call.arg.dtype))
+
+
+_I64_MIN = -(1 << 63)
+_I64_MAX = (1 << 63) - 1
+
+
+def _lo_sortable(lo64):
+    """Low limb's bit pattern -> order-preserving SIGNED int64 (flip
+    the top bit: unsigned u64 order == signed order of the flip)."""
+    return (lo64.astype(jnp.uint64)
+            ^ jnp.uint64(1 << 63)).astype(jnp.int64)
+
+
+def _lo_unsortable(s64):
+    return (s64.astype(jnp.uint64)
+            ^ jnp.uint64(1 << 63)).astype(jnp.int64)
+
+
+def _limb32(lo64):
+    """Non-negative int64 halves of a low limb's bit pattern: each sums
+    exactly in int64 for up to 2^31 rows (values < 2^32, sums < 2^63).
+    The high 64-bit limb sums separately, wrapping mod 2^64 — the
+    recombination in finalize is exact mod 2^128."""
+    u = lo64.astype(jnp.uint64)
+    a = (u & jnp.uint64(0xFFFFFFFF)).astype(jnp.int64)
+    b = (u >> jnp.uint64(32)).astype(jnp.int64)
+    return a, b
+
+
+def _recombine128(a, b, hi64):
+    """Per-slot limb sums -> int128 [n, 2] (see _limb32)."""
+    from presto_tpu.ops import int128 as I
+    ua = a.astype(jnp.uint64)
+    ub = b.astype(jnp.uint64)
+    lo = ua + (ub << jnp.uint64(32))
+    carry = (lo < ua).astype(jnp.uint64)
+    hi = hi64.astype(jnp.uint64) + (ub >> jnp.uint64(32)) + carry
+    return I.pack(lo, hi)
 
 
 def prepare_arg(fn: str, data, arg_type: T.DataType | None):
@@ -371,15 +448,43 @@ def fold(fn: str, data, weight, slots, capacity: int, *,
         return {"count": segred.segment_sum(
             w.astype(jnp.int64), slots, num_segments=capacity)}
     if fn in ("sum", "avg"):
+        c = segred.segment_sum(
+            w.astype(jnp.int64), slots, num_segments=capacity)
+        if data2 is not None:
+            # LONG decimal: data/data2 are the int128 value's low/high
+            # int64 limbs (see _limb32); three exact int64 segment sums
+            z = jnp.zeros((), jnp.int64)
+            a, b = _limb32(jnp.where(w, data, z))
+            return {"a": segred.segment_sum(a, slots,
+                                            num_segments=capacity),
+                    "b": segred.segment_sum(b, slots,
+                                            num_segments=capacity),
+                    "hi": segred.segment_sum(jnp.where(w, data2, z),
+                                             slots,
+                                             num_segments=capacity),
+                    "count": c}
         if jnp.issubdtype(data.dtype, jnp.integer):
             data = data.astype(jnp.int64)  # int32 args must not wrap
         zero = jnp.zeros((), dtype=data.dtype)
         s = segred.segment_sum(
             jnp.where(w, data, zero), slots, num_segments=capacity)
-        c = segred.segment_sum(
-            w.astype(jnp.int64), slots, num_segments=capacity)
         return {"sum": s, "count": c}
     if fn in ("min", "max", "arbitrary"):
+        c = segred.segment_sum(w.astype(jnp.int64), slots,
+                                num_segments=capacity)
+        if data2 is not None:
+            # LONG decimal extremum, two passes: signed high-limb
+            # extremum, then the low limb (order-preserving signed
+            # view) among high-limb winners
+            maxi = fn in ("max", "arbitrary")
+            ext = segred.segment_max if maxi else segred.segment_min
+            hs = jnp.where(w, data2, _I64_MIN if maxi else _I64_MAX)
+            bh = ext(hs, slots, num_segments=capacity)
+            winner = w & (data2 == bh[slots])
+            ls = jnp.where(winner, _lo_sortable(data),
+                           _I64_MIN if maxi else _I64_MAX)
+            bl = ext(ls, slots, num_segments=capacity)
+            return {"vlo": _lo_unsortable(bl), "vhi": bh, "count": c}
         if fn == "max" or fn == "arbitrary":
             sentinel = _min_sentinel(data.dtype)
             v = segred.segment_max(jnp.where(w, data, sentinel), slots,
@@ -388,8 +493,6 @@ def fold(fn: str, data, weight, slots, capacity: int, *,
             sentinel = _max_sentinel(data.dtype)
             v = segred.segment_min(jnp.where(w, data, sentinel), slots,
                                     num_segments=capacity)
-        c = segred.segment_sum(w.astype(jnp.int64), slots,
-                                num_segments=capacity)
         return {"val": v, "count": c}
     if fn == "count_if":
         return {"count": segred.segment_sum(
@@ -468,19 +571,38 @@ def scan_fold(fn: str, data, weight, sg, *, data2=None, data_valid=None,
         return {"count": S.seg_sum(
             (w & data.astype(bool)).astype(jnp.int64), sg)}
     if fn in ("sum", "avg"):
+        c = S.seg_sum(w.astype(jnp.int64), sg)
+        if data2 is not None:
+            # LONG decimal limbs (see fold)
+            z = jnp.zeros((), jnp.int64)
+            a, b = _limb32(jnp.where(w, data, z))
+            return {"a": S.seg_sum(a, sg), "b": S.seg_sum(b, sg),
+                    "hi": S.seg_sum(jnp.where(w, data2, z), sg),
+                    "count": c}
         if jnp.issubdtype(data.dtype, jnp.integer):
             data = data.astype(jnp.int64)
         s = S.seg_sum(jnp.where(w, data, jnp.zeros((), data.dtype)), sg)
-        c = S.seg_sum(w.astype(jnp.int64), sg)
         return {"sum": s, "count": c}
     if fn in ("min", "max", "arbitrary"):
+        c = S.seg_sum(w.astype(jnp.int64), sg)
+        if data2 is not None:
+            maxi = fn != "min"
+            ext = S.seg_max if maxi else S.seg_min
+            hs = jnp.where(w, data2, _I64_MIN if maxi else _I64_MAX)
+            bh = ext(hs, sg)
+            tot_bh = S.broadcast_last(bh, sg)
+            winner = w & (data2 == tot_bh)
+            ls = jnp.where(winner, _lo_sortable(data),
+                           _I64_MIN if maxi else _I64_MAX)
+            bl = ext(ls, sg)
+            return {"vlo": _lo_unsortable(bl), "vhi": bh, "count": c}
         if fn == "min":
             v = S.seg_min(jnp.where(w, data, _max_sentinel(data.dtype)),
                           sg)
         else:
             v = S.seg_max(jnp.where(w, data, _min_sentinel(data.dtype)),
                           sg)
-        return {"val": v, "count": S.seg_sum(w.astype(jnp.int64), sg)}
+        return {"val": v, "count": c}
     if fn in BOOL_FNS:
         b = data.astype(jnp.int32)
         c = S.seg_sum(w.astype(jnp.int64), sg)
@@ -547,9 +669,27 @@ def scan_merge(fn: str, states: dict, live, sg):
     if fn in ("count", "count_star", "count_if"):
         return {"count": S.seg_sum(jnp.where(w, states["count"], 0), sg)}
     if fn in ("sum", "avg"):
+        if "a" in states:  # LONG decimal limb states
+            return {f: S.seg_sum(jnp.where(w, states[f], 0), sg)
+                    for f in ("a", "b", "hi", "count")}
         zero = jnp.zeros((), states["sum"].dtype)
         return {"sum": S.seg_sum(jnp.where(w, states["sum"], zero), sg),
                 "count": S.seg_sum(jnp.where(w, states["count"], 0), sg)}
+    if fn in ("min", "max", "arbitrary") and "vlo" in states:
+        from presto_tpu.ops import segscan as SS
+        maxi = fn in ("max", "arbitrary")
+        ext = SS.seg_max if maxi else SS.seg_min
+        present = w & (states["count"] > 0)
+        hs = jnp.where(present, states["vhi"],
+                       _I64_MIN if maxi else _I64_MAX)
+        bh = ext(hs, sg)
+        winner = present & (states["vhi"] == SS.broadcast_last(bh, sg))
+        ls = jnp.where(winner, _lo_sortable(states["vlo"]),
+                       _I64_MIN if maxi else _I64_MAX)
+        bl = ext(ls, sg)
+        return {"vlo": _lo_unsortable(bl), "vhi": bh,
+                "count": SS.seg_sum(jnp.where(w, states["count"], 0),
+                                    sg)}
     if fn in ("min", "max", "arbitrary") or fn in BOOL_FNS:
         val = states["val"]
         if fn in ("max", "arbitrary", "bool_or"):
@@ -710,6 +850,11 @@ def merge(fn: str, states: dict, slots, capacity: int, live):
         return {"count": segred.segment_sum(
             jnp.where(w, states["count"], 0), slots, num_segments=capacity)}
     if fn in ("sum", "avg"):
+        if "a" in states:  # LONG decimal limb states
+            return {f: segred.segment_sum(
+                jnp.where(w, states[f], 0), slots,
+                num_segments=capacity)
+                for f in ("a", "b", "hi", "count")}
         zero = jnp.zeros((), dtype=states["sum"].dtype)
         return {
             "sum": segred.segment_sum(
@@ -719,6 +864,21 @@ def merge(fn: str, states: dict, slots, capacity: int, live):
                 jnp.where(w, states["count"], 0), slots,
                 num_segments=capacity),
         }
+    if fn in ("min", "max", "arbitrary") and "vlo" in states:
+        maxi = fn in ("max", "arbitrary")
+        ext = segred.segment_max if maxi else segred.segment_min
+        present = w & (states["count"] > 0)
+        hs = jnp.where(present, states["vhi"],
+                       _I64_MIN if maxi else _I64_MAX)
+        bh = ext(hs, slots, num_segments=capacity)
+        winner = present & (states["vhi"] == bh[slots])
+        ls = jnp.where(winner, _lo_sortable(states["vlo"]),
+                       _I64_MIN if maxi else _I64_MAX)
+        bl = ext(ls, slots, num_segments=capacity)
+        return {"vlo": _lo_unsortable(bl), "vhi": bh,
+                "count": segred.segment_sum(
+                    jnp.where(w, states["count"], 0), slots,
+                    num_segments=capacity)}
     if fn in ("min", "max", "arbitrary") or fn in BOOL_FNS:
         seg_max = fn in ("max", "arbitrary", "bool_or")
         if seg_max:
@@ -846,6 +1006,20 @@ def finalize(fn: str, states: dict, out_type: T.DataType,
                                  T.IntegerType, T.DateType)):
             out = jnp.round(out).astype(jnp.int64)
         return out, cnt > 0
+    if fn in ("min", "max", "arbitrary") and "vlo" in states:
+        from presto_tpu.ops import int128 as I
+        return (I.pack(states["vlo"], states["vhi"]),
+                states["count"] > 0)
+    if fn == "sum" and "a" in states:
+        return (_recombine128(states["a"], states["b"], states["hi"]),
+                states["count"] > 0)
+    if fn == "avg" and "a" in states:
+        from presto_tpu.ops import int128 as I
+        total = _recombine128(states["a"], states["b"], states["hi"])
+        c = states["count"]
+        q = I.div_round_half_up(total,
+                                I.from_i64(jnp.maximum(c, 1)))
+        return q, c > 0
     if fn == "sum":
         return states["sum"], states["count"] > 0
     if fn == "avg":

@@ -72,6 +72,59 @@ class Val:
         return m
 
 
+def is_long_dec(t) -> bool:
+    """LONG decimal (precision 19..38): int128 as [n, 2] int64 limbs
+    (reference spi/type/Decimals.java:45 long decimals; limb kernels in
+    ops/int128.py)."""
+    return isinstance(t, T.DecimalType) and t.is_long
+
+
+def _lit128_np(value: int) -> np.ndarray:
+    """Python int -> [2] int64 limb constant (low word bit pattern,
+    signed high word)."""
+    m = value & ((1 << 128) - 1)
+    lov, hiv = m & ((1 << 64) - 1), (m >> 64) & ((1 << 64) - 1)
+    tos = lambda x: x - (1 << 64) if x >= (1 << 63) else x  # noqa: E731
+    return np.asarray([tos(lov), tos(hiv)], np.int64)
+
+
+def as128(v: Val, scale: int):
+    """A decimal/integer Val's data as int128 limbs at ``scale``
+    (rescaling up only — callers align to the wider scale)."""
+    from presto_tpu.ops import int128 as I
+    if is_long_dec(v.dtype):
+        d = v.data
+        ds = v.dtype.scale
+    elif isinstance(v.dtype, T.DecimalType):
+        d = I.from_i64(v.data.astype(jnp.int64))
+        ds = v.dtype.scale
+    else:
+        d = I.from_i64(v.data.astype(jnp.int64))
+        ds = 0
+    if scale > ds:
+        d = I.rescale_up(d, scale - ds)
+    return d
+
+
+def where_data(cond, x, y, long: bool = False):
+    """jnp.where that broadcasts a scalar/[n] condition over [n, 2]
+    limb data. ``long`` marks LONG-decimal branches explicitly: two
+    scalar limb values are [2]-shaped, indistinguishable from a 2-row
+    column by shape alone."""
+    if long or max(getattr(x, "ndim", 1), getattr(y, "ndim", 1)) == 2:
+        if long:
+            if getattr(x, "ndim", 1) == 1:
+                x = x[None, :]
+            if getattr(y, "ndim", 1) == 1:
+                y = y[None, :]
+        cond = jnp.asarray(cond)
+        if cond.ndim == 0:
+            cond = cond[None, None]
+        elif cond.ndim == 1:
+            cond = cond[:, None]
+    return jnp.where(cond, x, y)
+
+
 def and_valid(*vs):
     """AND of validity masks, None = all-valid."""
     masks = [v for v in vs if v is not None]
@@ -176,7 +229,8 @@ class ExprCompiler:
 
     def _c_literal(self, e: ir.Literal) -> Val:
         if e.value is None:
-            zero = np.zeros((), dtype=e.dtype.physical_dtype)
+            zero = np.zeros((2,) if is_long_dec(e.dtype) else (),
+                            dtype=e.dtype.physical_dtype)
             dictionary = (np.array([""], dtype=object)
                           if isinstance(e.dtype, T.VarcharType) else None)
             return Val(e.dtype, jnp.asarray(zero), jnp.asarray(False),
@@ -184,6 +238,8 @@ class ExprCompiler:
         if isinstance(e.dtype, T.VarcharType):
             return Val(e.dtype, jnp.asarray(np.int32(0)), None,
                        np.array([e.value], dtype=object))
+        if is_long_dec(e.dtype):
+            return Val(e.dtype, jnp.asarray(_lit128_np(int(e.value))))
         return Val(e.dtype, jnp.asarray(
             np.asarray(e.value, dtype=e.dtype.physical_dtype)))
 
@@ -221,7 +277,8 @@ class ExprCompiler:
             take = c.data if c.valid is None else (c.data & c.valid)
             if r.is_string or result.is_string:
                 r, result = _merge_dicts(r, result)
-            data = jnp.where(take, r.data, result.data)
+            data = where_data(take, r.data, result.data,
+                              long=is_long_dec(e.dtype))
             rv = jnp.ones_like(take) if r.valid is None else r.valid
             dv = jnp.ones_like(take) if result.valid is None else result.valid
             valid = jnp.where(take, rv, dv)
@@ -281,11 +338,12 @@ def _parse_numeric_dictionary(v: Val, to: T.DataType) -> Val:
                 pass
     elif isinstance(to, T.DecimalType):
         from decimal import Decimal, InvalidOperation
-        vals = np.zeros(k, np.int64)
+        vals = np.zeros((k, 2) if to.is_long else k, np.int64)
         for i, s in enumerate(v.dictionary):
             try:
-                vals[i] = int(Decimal(str(s).strip())
-                              .scaleb(to.scale).to_integral_value())
+                raw = int(Decimal(str(s).strip())
+                          .scaleb(to.scale).to_integral_value())
+                vals[i] = _lit128_np(raw) if to.is_long else raw
                 ok[i] = True
             except (InvalidOperation, ValueError, OverflowError):
                 pass
@@ -312,6 +370,49 @@ def _parse_numeric_dictionary(v: Val, to: T.DataType) -> Val:
     return Val(to, data, and_valid(v.valid, okrow))
 
 
+def _rescale128(d, from_scale: int, to_scale: int):
+    """int128 limbs rescaled between decimal scales (HALF_UP down)."""
+    from presto_tpu.ops import int128 as I
+    if to_scale >= from_scale:
+        return I.rescale_up(d, to_scale - from_scale)
+    k = from_scale - to_scale
+    f = I.from_i64(jnp.int64(10 ** min(k, 18)))
+    if k > 18:
+        f = I.rescale_up(f, k - 18)
+    return I.div_round_half_up(d, jnp.broadcast_to(f, d.shape))
+
+
+def _cast_long_decimal(v: Val, to: T.DecimalType) -> Val:
+    """Casts where the source or target is a LONG decimal."""
+    from presto_tpu.ops import int128 as I
+    if isinstance(v.dtype, T.UnknownType):  # typed NULL
+        shape = ((v.data.shape[0], 2)
+                 if getattr(v.data, "ndim", 0) >= 1 else (2,))
+        return Val(to, jnp.zeros(shape, jnp.int64),
+                   jnp.zeros(shape[:-1], bool) if len(shape) > 1
+                   else jnp.asarray(False))
+    if isinstance(v.dtype, T.DecimalType):
+        src_scale = v.dtype.scale
+        d = v.data if is_long_dec(v.dtype) \
+            else I.from_i64(v.data.astype(jnp.int64))
+    elif isinstance(v.dtype, (T.BigintType, T.IntegerType)):
+        src_scale = 0
+        d = I.from_i64(v.data.astype(jnp.int64))
+    elif isinstance(v.dtype, T.DoubleType):
+        x = v.data * (10.0 ** to.scale)
+        hi = jnp.floor(x / jnp.float64(2.0 ** 64))
+        lo = x - hi * jnp.float64(2.0 ** 64)
+        d = I.pack(lo.astype(jnp.uint64), hi.astype(jnp.int64))
+        src_scale = to.scale
+    else:
+        raise NotImplementedError(
+            f"cast {v.dtype} -> {to}")
+    d = _rescale128(d, src_scale, to.scale)
+    if not to.is_long:
+        return Val(to, I.to_i64(d), v.valid)
+    return Val(to, d, v.valid)
+
+
 def cast_val(v: Val, to: T.DataType) -> Val:
     if v.dtype == to:
         return v
@@ -321,11 +422,17 @@ def cast_val(v: Val, to: T.DataType) -> Val:
         return _parse_numeric_dictionary(v, to)
     d = v.data
     if isinstance(to, T.DoubleType):
+        if is_long_dec(v.dtype):
+            from presto_tpu.ops import int128 as I
+            return Val(to, I.to_f64(d) / v.dtype.unscale_factor,
+                       v.valid)
         if isinstance(v.dtype, T.DecimalType):
             return Val(to, d.astype(jnp.float64) / v.dtype.unscale_factor,
                        v.valid)
         return Val(to, d.astype(jnp.float64), v.valid)
     if isinstance(to, T.DecimalType):
+        if to.is_long or is_long_dec(v.dtype):
+            return _cast_long_decimal(v, to)
         if isinstance(v.dtype, T.DecimalType):
             ds, ts = v.dtype.scale, to.scale
             if ts >= ds:
@@ -339,6 +446,10 @@ def cast_val(v: Val, to: T.DataType) -> Val:
             return Val(to, jnp.round(d * to.unscale_factor).astype(jnp.int64),
                        v.valid)
     if isinstance(to, T.BigintType):
+        if is_long_dec(v.dtype):
+            from presto_tpu.ops import int128 as I
+            scaled = _rescale128(d, v.dtype.scale, 0)
+            return Val(to, I.to_i64(scaled), v.valid)
         if isinstance(v.dtype, T.DecimalType):
             return Val(to, _div_round(d, v.dtype.unscale_factor), v.valid)
         return Val(to, d.astype(jnp.int64), v.valid)
@@ -433,16 +544,40 @@ def _decimal_align(a: Val, b: Val) -> tuple[Val, Val, int]:
 
 
 def _arith(e: ir.Call, args: list[Val], op) -> Val:
+    from presto_tpu.ops import int128 as I
     a, b = args
     valid = and_valid(a.valid, b.valid)
     if isinstance(e.dtype, T.DoubleType):
         a, b = cast_val(a, T.DOUBLE), cast_val(b, T.DOUBLE)
         return Val(e.dtype, op(a.data, b.data), valid)
     if isinstance(e.dtype, T.DecimalType):
+        long_any = (e.dtype.is_long or is_long_dec(a.dtype)
+                    or is_long_dec(b.dtype))
         if e.fn in ("add", "subtract"):
+            if long_any:
+                s = e.dtype.scale
+                x, y = as128(a, s), as128(b, s)
+                d = I.add(x, y) if e.fn == "add" else I.sub(x, y)
+                if not e.dtype.is_long:
+                    d = I.to_i64(d)
+                return Val(e.dtype, d, valid)
             a2, b2, _ = _decimal_align(a, b)
             return Val(e.dtype, op(a2.data, b2.data), valid)
         if e.fn == "multiply":
+            if long_any:
+                if not (is_long_dec(a.dtype) or is_long_dec(b.dtype)):
+                    # short x short -> exact int128 product
+                    d = I.mul_i64(a.data.astype(jnp.int64),
+                                  b.data.astype(jnp.int64))
+                else:
+                    sa = (a.dtype.scale if isinstance(
+                        a.dtype, T.DecimalType) else 0)
+                    sb = (b.dtype.scale if isinstance(
+                        b.dtype, T.DecimalType) else 0)
+                    d = I.mul(as128(a, sa), as128(b, sb))
+                if not e.dtype.is_long:
+                    d = I.to_i64(d)
+                return Val(e.dtype, d, valid)
             return Val(e.dtype, a.data * b.data, valid)
     return Val(e.dtype, op(a.data, b.data), valid)
 
@@ -487,6 +622,18 @@ def _div(e, args):
         sa = a.dtype.scale if isinstance(a.dtype, T.DecimalType) else 0
         sb = b.dtype.scale if isinstance(b.dtype, T.DecimalType) else 0
         s = e.dtype.scale
+        if (e.dtype.is_long or is_long_dec(a.dtype)
+                or is_long_dec(b.dtype)
+                or s + sb - sa + (a.dtype.precision if isinstance(
+                    a.dtype, T.DecimalType) else 19) > 18):
+            from presto_tpu.ops import int128 as I
+            num = I.rescale_up(as128(a, sa), s + sb - sa)
+            den = as128(b, sb)
+            bz = I.eq(den, jnp.zeros_like(den))
+            q = I.div_round_half_up(num, den)
+            if not e.dtype.is_long:
+                q = I.to_i64(q)
+            return Val(e.dtype, q, and_valid(valid, ~bz))
         num = a.data * (10 ** (s + sb - sa))
         den = jnp.where(b.data == 0, 1, b.data)
         q = jnp.where(
@@ -525,6 +672,9 @@ def _mod(e, args):
 @scalar("negate")
 def _neg(e, args):
     (a,) = args
+    if is_long_dec(e.dtype):
+        from presto_tpu.ops import int128 as I
+        return Val(e.dtype, I.neg(a.data), a.valid)
     return Val(e.dtype, -a.data, a.valid)
 
 
@@ -554,6 +704,17 @@ def _compare(e: ir.Call, args: list[Val], op, eq_only_op) -> Val:
         if isinstance(a.dtype, T.DoubleType) or isinstance(b.dtype, T.DoubleType):
             da = cast_val(a, T.DOUBLE).data
             db = cast_val(b, T.DOUBLE).data
+        elif is_long_dec(a.dtype) or is_long_dec(b.dtype):
+            from presto_tpu.ops import int128 as I
+            sc = max(a.dtype.scale if isinstance(a.dtype, T.DecimalType)
+                     else 0,
+                     b.dtype.scale if isinstance(b.dtype, T.DecimalType)
+                     else 0)
+            x, y = as128(a, sc), as128(b, sc)
+            res = {"eq": I.eq(x, y), "neq": ~I.eq(x, y),
+                   "lt": I.lt(x, y), "lte": I.le(x, y),
+                   "gt": I.lt(y, x), "gte": I.le(y, x)}[e.fn]
+            return _bool(res, valid)
         else:
             a2, b2, _ = _decimal_align(a, b)
             da, db = a2.data, b2.data
@@ -1204,10 +1365,12 @@ def _coalesce(e, args):
         args = [cast_val(a, e.dtype) for a in args]
     out = args[-1]
     for v in args[:-1][::-1]:
-        take = jnp.ones_like(v.data, dtype=bool) if v.valid is None else v.valid
+        take = (jnp.ones(v.data.shape[:1] or (), dtype=bool)
+                if v.valid is None else v.valid)
         if v.is_string or out.is_string:
             v, out = _merge_dicts(v, out)
-        data = jnp.where(take, v.data, out.data)
+        data = where_data(take, v.data, out.data,
+                          long=is_long_dec(e.dtype))
         ov = (jnp.ones_like(take) if out.valid is None else out.valid)
         valid = jnp.where(take, True, ov)
         out = Val(e.dtype, data, valid, out.dictionary)
@@ -1235,6 +1398,9 @@ def _row_index(e, args):
 @scalar("abs")
 def _abs(e, args):
     (a,) = args
+    if is_long_dec(e.dtype):
+        from presto_tpu.ops import int128 as I
+        return Val(e.dtype, I.abs_(a.data), a.valid)
     return Val(e.dtype, jnp.abs(a.data), a.valid)
 
 
@@ -1299,7 +1465,14 @@ def _greatest_least(e, args):
     valid = out.valid
     for v in args[1:]:
         v = cast_val(v, e.dtype)
-        out = Val(e.dtype, op(out.data, v.data), None)
+        if is_long_dec(e.dtype):
+            from presto_tpu.ops import int128 as I
+            sel = I.lt(out.data, v.data)
+            pick_v = sel if e.fn == "greatest" else ~sel
+            d = where_data(pick_v, v.data, out.data, long=True)
+            out = Val(e.dtype, d, None)
+        else:
+            out = Val(e.dtype, op(out.data, v.data), None)
         valid = and_valid(valid, v.valid)
     return Val(e.dtype, out.data, valid)
 

@@ -28,8 +28,12 @@ class MemoryLimitExceeded(RuntimeError):
 
 
 def _row_bytes(types: dict[str, T.DataType]) -> int:
-    # +1 byte per column approximates the validity sibling array
-    return sum(t.physical_dtype.itemsize + 1 for t in types.values())
+    # +1 byte per column approximates the validity sibling array;
+    # LONG decimals are two int64 limbs per value
+    return sum(
+        t.physical_dtype.itemsize
+        * (2 if isinstance(t, T.DecimalType) and t.is_long else 1) + 1
+        for t in types.values())
 
 
 @dataclasses.dataclass

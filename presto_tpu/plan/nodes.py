@@ -132,7 +132,7 @@ class Aggregate(PlanNode):
         out = list(self.group_keys)
         if self.step == AggStep.PARTIAL:
             for s, call in self.aggs.items():
-                out += [f"{s}${f}" for f in A.state_fields(call.fn)]
+                out += [f"{s}${f}" for f in A.state_fields(call)]
         else:
             out += list(self.aggs)
         return out
@@ -143,7 +143,7 @@ class Aggregate(PlanNode):
         out = {k: src[k] for k in self.group_keys}
         for s, call in self.aggs.items():
             if self.step == AggStep.PARTIAL:
-                for f in A.state_fields(call.fn):
+                for f in A.state_fields(call):
                     out[f"{s}${f}"] = A.state_type(call, f)
             else:
                 out[s] = call.dtype

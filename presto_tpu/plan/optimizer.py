@@ -103,7 +103,7 @@ def prune_columns(node: N.PlanNode,
         if node.step == N.AggStep.FINAL:
             from presto_tpu.expr import aggregates as AGG
             for s, c in aggs.items():
-                child |= {f"{s}${f}" for f in AGG.state_fields(c.fn)}
+                child |= {f"{s}${f}" for f in AGG.state_fields(c)}
         src = prune_columns(node.source, child)
         return dataclasses.replace(node, source=src, aggs=aggs)
 

@@ -145,7 +145,7 @@ def plan_literal_number(text: str) -> ir.Literal:
         scale = len(frac)
         digits = (intpart.lstrip("0") or "") + frac
         precision = max(len(digits), scale + 1)
-        if precision > 18:
+        if precision > 38:
             return ir.Literal(T.DOUBLE, float(text))
         return ir.Literal(T.DecimalType(precision, scale),
                           int(intpart or "0") * 10 ** scale
@@ -277,17 +277,14 @@ def parse_type_name(name: str) -> T.DataType:
         params = [int(p) for p in rest.rstrip(")").split(",")]
         base = base.strip()
         if base == "decimal":
-            # long decimals (p > 18) clamp to the widest short decimal:
-            # the physical store is int64 either way, so a wider
-            # nominal precision only removes an overflow guard the
-            # engine does not enforce yet (documented int128 gap). A
-            # scale past 18 has no int64 representation at all.
             scale = params[1] if len(params) > 1 else 0
-            if scale > 18:
+            if params[0] > 38:
                 raise SemanticError(
-                    f"decimal scale {scale} exceeds the int64 short-"
-                    "decimal store")
-            return T.DecimalType(min(params[0], 18), scale)
+                    f"decimal precision {params[0]} exceeds 38")
+            if scale > params[0]:
+                raise SemanticError(
+                    f"decimal scale {scale} exceeds precision")
+            return T.DecimalType(params[0], scale)
         if base in ("varchar", "char"):
             return T.VarcharType(params[0])
         raise SemanticError(f"unknown type {name}")
@@ -306,6 +303,14 @@ def _decimal_scale(t: T.DataType) -> int:
     return t.scale if isinstance(t, T.DecimalType) else 0
 
 
+def _decimal_prec_scale(t: T.DataType) -> tuple[int, int]:
+    """(precision, scale) with integer types as decimal(19,0)
+    (reference TypeCoercion BIGINT->decimal(19,0))."""
+    if isinstance(t, T.DecimalType):
+        return t.precision, t.scale
+    return 19, 0
+
+
 def arith_result_type(op: str, a: T.DataType, b: T.DataType) -> T.DataType:
     if op == "||":
         if isinstance(a, T.ArrayType) and isinstance(b, T.ArrayType):
@@ -318,19 +323,25 @@ def arith_result_type(op: str, a: T.DataType, b: T.DataType) -> T.DataType:
     if isinstance(a, T.DoubleType) or isinstance(b, T.DoubleType):
         return T.DOUBLE
     if isinstance(a, T.DecimalType) or isinstance(b, T.DecimalType):
-        sa, sb = _decimal_scale(a), _decimal_scale(b)
-        if op in ("+", "-", "%"):
-            return T.DecimalType(18, max(sa, sb))
+        # reference derivation rules, DecimalOperators.java:84,261,339
+        pa, sa = _decimal_prec_scale(a)
+        pb, sb = _decimal_prec_scale(b)
+        if op in ("+", "-"):
+            return T.DecimalType(
+                min(38, max(pa - sa, pb - sb) + max(sa, sb) + 1),
+                max(sa, sb))
+        if op == "%":
+            # DecimalOperators.java:503
+            return T.DecimalType(
+                max(1, min(38, min(pa - sa, pb - sb) + max(sa, sb))),
+                max(sa, sb))
         if op == "*":
-            if sa + sb > 18:
+            if sa + sb > 38:
                 return T.DOUBLE
-            return T.DecimalType(18, sa + sb)
+            return T.DecimalType(min(38, pa + pb), sa + sb)
         if op == "/":
-            # quotient scale floors at 6 (the reference's decimal
-            # division scale rule is max(6, ...),
-            # DecimalOperators/OperatorValidator): ratio orderings
-            # (q36's gross_margin rank) need the precision
-            return T.DecimalType(18, max(sa, sb, 6))
+            return T.DecimalType(
+                min(38, pa + sb + max(sb - sa, 0)), max(sa, sb))
     return T.BIGINT
 
 
@@ -2322,6 +2333,10 @@ class LogicalPlanner:
                         "for an integer sort key")
                 return int(text)
             if isinstance(key_type, T.DecimalType):
+                if key_type.is_long:
+                    raise SemanticError(
+                        "RANGE offsets over long decimal (precision "
+                        "> 18) sort keys are not supported")
                 from decimal import Decimal
                 d = Decimal(text).scaleb(key_type.scale)
                 if d != d.to_integral_value():

@@ -146,24 +146,26 @@ class IntervalYearMonthType(DataType):
 
 @dataclasses.dataclass(frozen=True)
 class DecimalType(DataType):
-    """Short decimal: int64 scaled by 10**scale.
-
-    Matches reference semantics for precision <= 18
-    (spi/type/DecimalType.java); long decimals (>18) are not supported yet.
-    """
+    """Decimal as scaled integers (reference spi/type/DecimalType.java,
+    Decimals.java:45): SHORT (precision <= 18) is one int64 per value;
+    LONG (19..38) is int128 as TWO int64 limbs on a trailing axis
+    ([n, 2]: low word's bit pattern, then the signed high word — see
+    ops/int128.py for the vectorized limb arithmetic)."""
 
     precision: int = 38
     scale: int = 0
 
     def __init__(self, precision: int, scale: int) -> None:
-        if precision > 18:
+        if precision > 38:
             raise ValueError(
-                f"decimal({precision},{scale}): only short decimals "
-                "(precision <= 18) are supported"
-            )
+                f"decimal({precision},{scale}): precision > 38")
         object.__setattr__(self, "precision", precision)
         object.__setattr__(self, "scale", scale)
         super().__init__(f"decimal({precision},{scale})")
+
+    @property
+    def is_long(self) -> bool:
+        return self.precision > 18
 
     @property
     def physical_dtype(self) -> np.dtype:

@@ -5176,6 +5176,28 @@ def ds_oracle(ds_engine):
     return o
 
 
+def _sqlite_override(qname: str) -> str | None:
+    """Oracle-side rewrites where sqlite REAL semantics diverge from
+    the engine's reference-parity decimal typing.
+
+    q36: gross_margin = sum(decimal(38,2)) / sum(decimal(38,2)) has
+    result scale 2 under the reference's division derivation
+    (DecimalOperators.java:340, r_scale = max(s1, s2)); sqlite computes
+    the ratio in full REAL precision, so its rank() sees distinctions
+    the scale-2 quotient legitimately collapses. Rounding the oracle's
+    ratio to the derived scale makes both sides rank the same values
+    (the query's ORDER BY carries deterministic tie-breakers)."""
+    if qname != "q36":
+        return None
+    from presto_tpu.sql.parser import parse_statement
+    from presto_tpu.sql.sqlite_dialect import to_sqlite
+    sq = to_sqlite(parse_statement(QUERIES[qname]))
+    div = "(sum(ss_net_profit) / sum(ss_ext_sales_price))"
+    assert div in sq
+    return sq.replace(div, f"ROUND({div}, 2)")
+
+
 @pytest.mark.parametrize("qname", sorted(QUERIES))
 def test_tpcds_query(qname, ds_engine, ds_oracle):
-    assert_query(ds_engine, ds_oracle, QUERIES[qname])
+    assert_query(ds_engine, ds_oracle, QUERIES[qname],
+                 sqlite_sql=_sqlite_override(qname))
