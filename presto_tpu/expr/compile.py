@@ -1365,8 +1365,12 @@ def _coalesce(e, args):
         args = [cast_val(a, e.dtype) for a in args]
     out = args[-1]
     for v in args[:-1][::-1]:
-        take = (jnp.ones(v.data.shape[:1] or (), dtype=bool)
-                if v.valid is None else v.valid)
+        if v.valid is not None:
+            take = v.valid
+        elif is_long_dec(e.dtype) and getattr(v.data, "ndim", 1) == 1:
+            take = jnp.asarray(True)  # scalar limb pair [2]
+        else:
+            take = jnp.ones(v.data.shape[:1] or (), dtype=bool)
         if v.is_string or out.is_string:
             v, out = _merge_dicts(v, out)
         data = where_data(take, v.data, out.data,

@@ -182,20 +182,44 @@ class ClusterCoordinator:
         from presto_tpu.exec.streaming import (_find_streamable,
                                                _replace_node)
 
-        plan, _ = self.engine.plan_sql(sql)
+        # plan with late materialization off: its rewritten shape
+        # (dimension re-join above the aggregate) is a single-chip
+        # width optimization the fragmenter cannot stage
+        sess = self.engine.session
+        saved_lm = sess.get("enable_late_materialization")
+        sess.set("enable_late_materialization", False)
+        try:
+            plan, _ = self.engine.plan_sql(sql)
+        finally:
+            sess.set("enable_late_materialization", saved_lm)
         workers = self.live_workers()
         require = bool(self.engine.session.get("require_distribution"))
+        allow_fb = bool(self.engine.session.get("allow_local_fallback"))
 
         def run_local() -> list[tuple]:
             self.last_distribution = None
             from presto_tpu.exec.executor import execute_plan
             return execute_plan(self.engine, plan).to_pylist()
 
+        def _scans_tables(node) -> bool:
+            from presto_tpu.plan import nodes as NN
+            if isinstance(node, NN.TableScan) and node.catalog not in (
+                    "information_schema", "system"):
+                return True
+            return any(_scans_tables(sub) for sub in node.sources())
+
         def local(reason: str) -> list[tuple]:
             if require:
                 raise NoWorkersError(
                     f"require_distribution is set but the query "
                     f"cannot be distributed: {reason}")
+            # metadata / constant queries are coordinator-only by
+            # nature (the reference also runs them there); data-scan
+            # queries fail loudly unless the fallback is opted into
+            if workers and not allow_fb and _scans_tables(plan):
+                raise NoWorkersError(
+                    f"query cannot be distributed ({reason}) and "
+                    "allow_local_fallback is not set")
             return run_local()
 
         if workers:
@@ -204,25 +228,36 @@ class ClusterCoordinator:
             general = fragment_plan_general(
                 plan, mode=str(self.engine.session.get(
                     "join_distribution_type") or "automatic").lower())
-            if general is not None:
+            def _with_failover(run):
+                """Node loss mid-stage loses that query's buffers; the
+                whole stage DAG retries ONCE on the surviving workers
+                (stage-level failover — the analog of the split-level
+                retry in _dispatch_splits). If no workers survive or
+                the retry fails too, the query FAILS like the
+                reference's REMOTE_TASK_ERROR unless local fallback
+                was opted into."""
                 try:
-                    return self._execute_general(plan, general, workers)
+                    return run(workers)
                 except (NoWorkersError, TaskError):
-                    # node loss mid-stage: buffers are gone, restart
-                    # the whole query locally (the reference fails the
-                    # query outright here, SURVEY §5)
-                    if require:
+                    survivors = [w for w in workers if w.ping()]
+                    if survivors and len(survivors) < len(workers):
+                        try:
+                            return run(survivors)
+                        except (NoWorkersError, TaskError):
+                            pass
+                    if require or not allow_fb:
                         raise
                     return run_local()
+
+            if general is not None:
+                return _with_failover(
+                    lambda ws: self._execute_general(plan, general,
+                                                     ws))
             fragged = fragment_join_plan(plan)
             if fragged is not None:
-                try:
-                    return self._execute_fragmented(plan, fragged,
-                                                    workers)
-                except (NoWorkersError, TaskError):
-                    if require:
-                        raise
-                    return run_local()
+                return _with_failover(
+                    lambda ws: self._execute_fragmented(plan, fragged,
+                                                        ws))
         found = _find_streamable(plan)
         if found is None or not workers:
             # single-node fallback: run the plan we already built (the
@@ -360,6 +395,16 @@ class ClusterCoordinator:
         qid = uuid.uuid4().hex[:8]
         W = len(workers)
         nparts_of: dict[str, int] = {}
+        # how many downstream tasks read EACH partition of a producer's
+        # buffer: 1 in "part" mode (consumer i owns partition i), W in
+        # "all" (broadcast) mode — the buffer frees a page only when
+        # every reader acked past it
+        readers_of: dict[str, int] = {}
+        for st in g.stages:
+            for _tname, (producer, mode) in st.sources.items():
+                readers_of[producer] = max(
+                    readers_of.get(producer, 1),
+                    W if mode == "all" else 1)
 
         try:
             inline: list | None = None
@@ -372,12 +417,13 @@ class ClusterCoordinator:
                     for tname, (producer, mode) in st.sources.items():
                         tid = f"{qid}.{producer}"
                         if mode == "part":
+                            # consumer i alone reads partition i
                             refs = [{"uri": w.uri, "task_id": tid,
                                      "part": i} for w in workers]
                         else:  # "all": broadcast read of every buffer
                             np_ = nparts_of[producer]
                             refs = [{"uri": w.uri, "task_id": tid,
-                                     "part": p}
+                                     "part": p, "reader": i}
                                     for w in workers
                                     for p in range(np_)]
                         sources[tname] = refs
@@ -391,6 +437,17 @@ class ClusterCoordinator:
                                           "keys": st.partition_keys}
                     elif not last:
                         p["store"] = True
+                    if readers_of.get(st.name, 1) > 1:
+                        p["readers"] = readers_of[st.name]
+                    if not last:
+                        # intermediate stages run ASYNC: the POST
+                        # returns immediately and downstream consumers
+                        # long-poll the paged buffers, so the whole
+                        # stage DAG pipelines through the bounded data
+                        # plane (reference all-at-once
+                        # SqlQueryScheduler policy + paged
+                        # TaskResource results)
+                        p["async"] = True
                     # the LAST stage returns its partials inline: no
                     # coordinator pull phase, so a worker death after
                     # the final stage cannot strand the query
@@ -407,8 +464,10 @@ class ClusterCoordinator:
                  "stages": len(g.stages)})
         finally:
             for w in workers:
-                if w.alive:
+                try:
                     w.delete_task(qid)
+                except Exception:  # noqa: BLE001 - best-effort cleanup
+                    pass
 
     def _execute_fragmented(self, plan, fragged,
                             workers: list[RemoteWorker]) -> list[tuple]:
@@ -444,6 +503,7 @@ class ClusterCoordinator:
                     "shard": i, "nshards": W,
                     "partition": {"nparts": W,
                                   "keys": st.partition_keys},
+                    "async": True,
                 } for i in range(W)])
 
             # -- join stages -------------------------------------------
@@ -480,6 +540,7 @@ class ClusterCoordinator:
                     if js.out_partition_keys is not None:
                         p["partition"] = {
                             "nparts": W, "keys": js.out_partition_keys}
+                        p["async"] = True
                     payloads.append(p)
                 outs = run_stage(payloads)
                 if js.out_partition_keys is None:
@@ -494,8 +555,10 @@ class ClusterCoordinator:
                  + len(fragged.join_stages)})
         finally:
             for w in workers:
-                if w.alive:
+                try:
                     w.delete_task(qid)
+                except Exception:  # noqa: BLE001 - best-effort cleanup
+                    pass
 
     def _dispatch_splits(self, payloads: list[dict],
                          workers: list[RemoteWorker]) -> list[dict]:

@@ -34,6 +34,20 @@ def _splitmix64_np(x: np.ndarray) -> np.ndarray:
 
 def _hash_column(col: Column) -> np.ndarray:
     data = np.asarray(col.data)
+    if data.ndim == 2:
+        # LONG decimal limb pairs [n, 2]: combine both limbs into one
+        # row hash (mirrors the device-side _row_hash limb handling)
+        lo_ = _splitmix64_np(data[:, 0].astype(np.int64)
+                             .view(np.uint64))
+        hi_ = _splitmix64_np(data[:, 1].astype(np.int64)
+                             .view(np.uint64))
+        with np.errstate(over="ignore"):
+            h = _splitmix64_np(
+                (lo_ * np.uint64(0x100000001B3)) & _MASK ^ hi_)
+        if col.valid is not None:
+            h = np.where(np.asarray(col.valid), h,
+                         np.uint64(0x9E3779B97F4A7C15))
+        return h
     if col.dictionary is not None:
         lut = np.empty(max(len(col.dictionary), 1), dtype=np.uint64)
         lut[0] = 0

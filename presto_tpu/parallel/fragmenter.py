@@ -122,10 +122,15 @@ def fragment_plan_general(plan: N.PlanNode, mode: str = "automatic"
 
 def _fragment_general(plan: N.PlanNode,
                       mode: str = "automatic") -> GeneralFragmentedPlan:
-    # walk the coordinator-side root chain down to the top Aggregate
+    # walk the coordinator-side root chain down to the top Aggregate /
+    # window chain
     node = plan
     agg: N.Aggregate | None = None
     upper: list[N.PlanNode] = []  # between agg (exclusive) and spine
+    wchain: list[N.PlanNode] = []  # window chain (+ proj/filter), top
+    #                                window first
+    windows: list[N.Window] = []
+    distinct_agg = False
     while True:
         if isinstance(node, (N.Join, N.SemiJoin, N.CrossJoin,
                              N.TableScan)):
@@ -133,26 +138,76 @@ def _fragment_general(plan: N.PlanNode,
         if isinstance(node, N.Aggregate):
             if agg is not None or node.step != N.AggStep.SINGLE:
                 raise NotDistributable()
-            if any(c.distinct for c in node.aggs.values()):
-                raise NotDistributable()
+            distinct_agg = (distinct_agg or any(
+                c.distinct for c in node.aggs.values()))
             agg = node
             upper = []
             node = node.source
             continue
+        if isinstance(node, N.Window):
+            # windows distribute by FIXED_HASH on their partition keys
+            # (reference AddExchanges window partitioning): every
+            # window in one distributed tail must share them so a
+            # single repartition serves the whole chain
+            if agg is not None or not node.partition_by:
+                raise NotDistributable()
+            if windows and set(node.partition_by) != set(
+                    windows[0].partition_by):
+                raise NotDistributable()
+            windows.append(node)
+            wchain.append(node)
+            node = node.sources()[0]
+            continue
+        if isinstance(node, N.Distinct) and agg is not None:
+            # a single DISTINCT aggregate lowers to Aggregate over
+            # Distinct: the dedup must see each group's complete row
+            # set, so keyed-single mode repartitions first (the
+            # Distinct rides the post-exchange tail)
+            distinct_agg = True
+            upper.append(node)
+            node = node.sources()[0]
+            continue
         if isinstance(node, (N.Output, N.Sort, N.TopN, N.Limit,
                              N.Distinct)):
-            if agg is not None:
+            if agg is not None or windows:
                 raise NotDistributable()
             node = node.sources()[0]
             continue
         if isinstance(node, (N.Project, N.Filter)):
             if agg is not None:
                 upper.append(node)
+            elif windows:
+                wchain.append(node)
+            node = node.source
+            continue
+        if isinstance(node, N.MarkDistinct):
+            # DISTINCT aggregates lower to MarkDistinct + masked
+            # aggregation: the mark must see a group's WHOLE distinct
+            # set, so the plan enters keyed-single mode (rows
+            # repartition by the group keys)
+            if agg is None:
+                raise NotDistributable()
+            distinct_agg = True
+            upper.append(node)
             node = node.source
             continue
         raise NotDistributable()
-    if agg is None:
+    if agg is None and not windows:
         raise NotDistributable()  # raw-row gather: partial path covers
+    # keyed-single mode: DISTINCT aggregates / window tails need whole
+    # groups / whole window partitions on one worker, so rows
+    # repartition by the keys and the tail runs as a complete SINGLE
+    # computation per worker (no partial/final split)
+    keyed_single = distinct_agg or bool(windows)
+    if windows:
+        part_keys = list(windows[0].partition_by)
+        if agg is not None and not set(part_keys) <= set(
+                agg.group_keys):
+            raise NotDistributable()
+    elif distinct_agg:
+        if not agg.group_keys:
+            raise NotDistributable()  # global DISTINCT: one group
+        part_keys = list(agg.group_keys)
     spine_root = node
 
     stages: list[GStage] = []
@@ -207,10 +262,15 @@ def _fragment_general(plan: N.PlanNode,
             return dataclasses.replace(node, source=src,
                                        filter_source=scan), dist
         if isinstance(node, N.Join):
-            if node.join_type == N.JoinType.FULL:
+            full = node.join_type == N.JoinType.FULL
+            if full and (not node.criteria or not allow_cut):
+                # a broadcast FULL join would emit every unmatched
+                # build row once PER WORKER; both sides must
+                # co-partition (reference AddExchanges: FULL requires
+                # PARTITIONED distribution)
                 raise NotDistributable()
             left, dist = lower(node.left, sources, allow_cut)
-            if node.distribution == "partitioned" \
+            if full or node.distribution == "partitioned" \
                     or mode == "partitioned":
                 small = False
             elif node.distribution == "broadcast" \
@@ -246,10 +306,43 @@ def _fragment_general(plan: N.PlanNode,
     final_sources: dict[str, tuple[str, str]] = {}
     spine, _dist = lower(spine_root, final_sources, True)
 
-    # last worker stage: spine + upper chain + PARTIAL aggregate
     root: N.PlanNode = spine
     for up in reversed(upper):
         root = dataclasses.replace(up, source=root)
+
+    if keyed_single:
+        # repartition RAW spine rows by the keys, then run the whole
+        # tail (upper chain + SINGLE aggregate and/or window chain)
+        # per worker AFTER the exchange — MarkDistinct in particular
+        # must see each group's complete row set, not one worker's
+        # pre-shuffle slice. The coordinator just gathers finished
+        # rows (reference AddExchanges FIXED_HASH + single-step
+        # mark-distinct / window partitioning)
+        if not set(part_keys) <= set(spine.output_types()):
+            # keys computed by a projection above the spine can't
+            # partition raw rows
+            raise NotDistributable()
+        pname = fresh("rows")
+        stages.append(GStage(pname, spine, final_sources, part_keys))
+        xscan = N.TableScan("__exchange__", fresh("x"),
+                            {sym: sym for sym in
+                             spine.output_types()},
+                            dict(spine.output_types()))
+        tail: N.PlanNode = xscan
+        for up in reversed(upper):
+            tail = dataclasses.replace(up, source=tail)
+        if agg is not None:
+            tail = dataclasses.replace(agg, source=tail)
+        for wnode in reversed(wchain):
+            tail = dataclasses.replace(wnode, source=tail)
+        last = fresh("tail")
+        stages.append(GStage(last, tail,
+                             {xscan.table: (pname, "part")}, None))
+        boundary = wchain[0] if wchain else agg
+        return GeneralFragmentedPlan(stages, plan, boundary, None,
+                                     last)
+
+    # last worker stage: spine + upper chain + PARTIAL aggregate
     partial = dataclasses.replace(agg, source=root,
                                   step=N.AggStep.PARTIAL)
     last = fresh("agg")

@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import dataclasses
 import threading
+import os
 import urllib.request
 
 import numpy as np
@@ -115,24 +116,65 @@ class BufferConnector:
         return TableStats(row_count=self._tables[name][1])
 
 
-def _fetch_buffer(ref: dict, timeout: float = 120.0,
-                  secret: str | None = None) -> bytes:
+def _auth_headers(secret: str | None) -> dict:
     from presto_tpu.parallel import auth as _auth
-    url = f"{ref['uri']}/v1/task/{ref['task_id']}/results/{ref['part']}"
-    headers = {}
     if secret is None:
         secret = _auth.default_secret()
-    if secret is not None:
-        headers[_auth.HEADER] = _auth.make_token(secret)
-    req = urllib.request.Request(url, headers=headers)
-    with urllib.request.urlopen(req, timeout=timeout) as resp:
-        return resp.read()
+    if secret is None:
+        return {}
+    return {_auth.HEADER: _auth.make_token(secret)}
+
+
+def _fetch_pages(ref: dict, timeout: float = 240.0,
+                 secret: str | None = None) -> list[bytes]:
+    """Pull one partition's pages with continuation tokens until the
+    producer reports completion; requesting token T acknowledges every
+    page below T on the producer, releasing its buffer bytes (reference
+    operator/HttpPageBufferClient.java:321-411). Long-polls through
+    not-yet-produced pages, so a consumer scheduled before its producer
+    finishes simply waits on the data plane."""
+    import time as _time
+
+    headers = _auth_headers(secret)
+    reader = int(ref.get("reader", 0))
+    base = (f"{ref['uri']}/v1/task/{ref['task_id']}/results/"
+            f"{ref['part']}")
+    token = 0
+    pages: list[bytes] = []
+    deadline = _time.monotonic() + timeout
+    while True:
+        req = urllib.request.Request(f"{base}/{token}/{reader}",
+                                     headers=headers)
+        with urllib.request.urlopen(req, timeout=60.0) as resp:
+            blob = resp.read()
+            nxt = int(resp.headers.get("X-PrestoTpu-Next-Token", token))
+            complete = (resp.headers.get("X-PrestoTpu-Complete", "0")
+                        == "1")
+        if blob:
+            pages.append(blob)
+        if nxt == token and complete:
+            return pages
+        token = nxt
+        if _time.monotonic() > deadline:
+            raise TimeoutError(
+                f"exchange fetch of {ref['task_id']}/{ref['part']} "
+                "timed out")
 
 
 def execute_fragment_task(engine, req: dict, store: dict,
-                          secret: str | None = None) -> object:
+                          secret: str | None = None,
+                          engine_lock=None) -> object:
     """Run one fragment task. Returns a dict (JSON response, buffered
-    output) or bytes (inline binary result)."""
+    output) or bytes (inline binary result).
+
+    ``engine_lock`` guards ONLY the engine-using section (the cached
+    engine's __exchange__ catalog is per-worker state). Source fetching
+    (long-polls upstream producers) and page emission (blocks on the
+    bounded buffer) run OUTSIDE it — holding the lock there would
+    deadlock a producer and its same-worker consumer against each
+    other."""
+    import contextlib
+
     from presto_tpu.exec.executor import collect_scans, run_plan
     from presto_tpu.parallel.exchange_host import (partition_ids,
                                                    slice_columns)
@@ -143,39 +185,81 @@ def execute_fragment_task(engine, req: dict, store: dict,
 
     plan = fragment_from_dict(req["fragment"])
     sources = req.get("sources") or {}
+    conn = None
     if sources:
         conn = BufferConnector()
         for tname, refs in sources.items():
-            parts = [bytes_to_columns(_fetch_buffer(r, secret=secret))
-                     for r in refs]
-            cols = concat_columns([p[0] for p in parts])
+            parts = []
+            for r in refs:
+                for blob in _fetch_pages(r, secret=secret):
+                    parts.append(bytes_to_columns(blob))
+            cols = concat_columns([p[0] for p in parts]) \
+                if parts else {}
             nrows = sum(p[1] for p in parts)
             conn.add(tname, cols, nrows)
-        engine.catalogs["__exchange__"] = conn
 
-    table = run_plan(engine, plan, collect_scans(plan, engine))
+    with (engine_lock if engine_lock is not None
+          else contextlib.nullcontext()):
+        if conn is not None:
+            engine.catalogs["__exchange__"] = conn
+        table = run_plan(engine, plan, collect_scans(plan, engine))
     live = (np.ones(table.nrows, bool) if table.mask is None
             else np.asarray(table.mask))
     cols = slice_columns(table.columns, live)
 
     part = req.get("partition")
-    if part is None:
-        if req.get("store"):
-            # unpartitioned buffered output (broadcast build sides /
-            # gather stages): one buffer at partition index 0
-            store[req["task_id"]] = [columns_to_bytes(cols)]
-            return {"rows": [int(live.sum())]}
+    if part is None and not req.get("store"):
         return columns_to_bytes(cols)
-    nparts = int(part["nparts"])
-    ids = partition_ids(cols, part["keys"], nparts)
-    bufs = []
-    rows = []
-    for p in range(nparts):
-        sel = ids == p
-        bufs.append(columns_to_bytes(slice_columns(cols, sel)))
-        rows.append(int(sel.sum()))
-    store[req["task_id"]] = bufs
-    return {"rows": rows}
+
+    # buffered output: pages of ~PAGE_BYTES each stream into the
+    # task's bounded OutputBuffer. add() BLOCKS when unacked bytes
+    # exceed the buffer capacity — the producer waits for the consumer
+    # stage to drain (backpressure; see parallel/buffer.py)
+    buf = store[req["task_id"]]
+    if part is None:
+        _emit_pages(buf, 0, cols, int(live.sum()))
+    else:
+        nparts = int(part["nparts"])
+        ids = partition_ids(cols, part["keys"], nparts)
+        for p in range(nparts):
+            sel = ids == p
+            _emit_pages(buf, p, slice_columns(cols, sel),
+                        int(sel.sum()))
+    buf.set_complete()
+    return {"rows": buf.rows()}
+
+
+PAGE_BYTES = int(os.environ.get(
+    "PRESTO_TPU_EXCHANGE_PAGE_BYTES", 4 << 20))
+BUFFER_BYTES = int(os.environ.get(
+    "PRESTO_TPU_EXCHANGE_BUFFER_BYTES", 64 << 20))
+
+
+def _emit_pages(buf, partition: int, cols: dict, nrows: int) -> None:
+    """Slice one partition's columns into ~PAGE_BYTES pages and stream
+    them into the bounded buffer."""
+    from presto_tpu.parallel.exchange_host import slice_columns
+    from presto_tpu.parallel.wire import columns_to_bytes
+
+    if nrows == 0:
+        buf.add(partition, columns_to_bytes(cols), 0)
+        return
+    row_bytes = max(1, sum(
+        np.asarray(c.data).dtype.itemsize
+        + (1 if c.valid is not None else 0)
+        for c in cols.values()))
+    rows_per_page = max(1, PAGE_BYTES // row_bytes)
+    start = 0
+    while start < nrows:
+        stop = min(start + rows_per_page, nrows)
+        if start == 0 and stop == nrows:
+            page_cols = cols
+        else:
+            mask = np.zeros(nrows, bool)
+            mask[start:stop] = True
+            page_cols = slice_columns(cols, mask)
+        buf.add(partition, columns_to_bytes(page_cols), stop - start)
+        start = stop
 
 
 class WorkerServer(HttpService):
@@ -193,7 +277,8 @@ class WorkerServer(HttpService):
         self.shared_secret = (shared_secret
                               if shared_secret is not None
                               else _auth.default_secret())
-        self.buffers: dict[str, list[bytes]] = {}
+        self.buffers: dict[str, object] = {}  # task -> OutputBuffer
+        self.task_state: dict[str, dict] = {}
         self._engines: dict[tuple, object] = {}
         self._lock = threading.Lock()
         # fragment tasks mutate the cached engine's __exchange__
@@ -249,14 +334,36 @@ class WorkerServer(HttpService):
                             "peakBytes": sum(
                                 p["peakBytes"] for p in pools)}})
                     return
-                if (len(parts) == 5 and parts[:2] == ["v1", "task"]
+                if (len(parts) in (6, 7)
+                        and parts[:2] == ["v1", "task"]
                         and parts[3] == "results"):
-                    bufs = outer.buffers.get(parts[2])
-                    p = int(parts[4])
-                    if bufs is None or p >= len(bufs):
+                    # paged: /v1/task/{tid}/results/{part}/{token}
+                    # [/{reader}] — token T acknowledges the reader's
+                    # pages < T (reference TaskResource.java:261-336)
+                    buf = outer.buffers.get(parts[2])
+                    if buf is None:
                         self._send_json({"error": "no such buffer"}, 404)
                         return
-                    self._send_bytes(bufs[p])
+                    from presto_tpu.parallel.buffer import TaskFailed
+                    try:
+                        blob, nxt, complete = buf.page(
+                            int(parts[4]), int(parts[5]),
+                            int(parts[6]) if len(parts) == 7 else 0)
+                    except TaskFailed as tf:
+                        self._send_json({"error": str(tf)}, 500)
+                        return
+                    self._send_bytes(blob or b"", extra_headers={
+                        "X-PrestoTpu-Next-Token": str(nxt),
+                        "X-PrestoTpu-Complete":
+                            "1" if complete else "0"})
+                    return
+                if (len(parts) == 4 and parts[:2] == ["v1", "task"]
+                        and parts[3] == "status"):
+                    st = outer.task_state.get(parts[2])
+                    if st is None:
+                        self._send_json({"error": "no such task"}, 404)
+                        return
+                    self._send_json(st)
                     return
                 self._send_json({"error": "not found"}, 404)
 
@@ -271,7 +378,14 @@ class WorkerServer(HttpService):
                     prefix = parts[2]
                     for tid in list(outer.buffers):
                         if tid.startswith(prefix):
-                            outer.buffers.pop(tid, None)
+                            buf = outer.buffers.pop(tid, None)
+                            if buf is not None and not buf.complete:
+                                # unblock a producer still waiting on
+                                # a consumer that will never come
+                                buf.fail("task deleted")
+                    for tid in list(outer.task_state):
+                        if tid.startswith(prefix):
+                            outer.task_state.pop(tid, None)
                     self._send_json({})
                     return
                 self._send_json({"error": "not found"}, 404)
@@ -288,10 +402,54 @@ class WorkerServer(HttpService):
                         engine = engine_factory(
                             int(req.get("shard", 0)),
                             int(req.get("nshards", 1)))
-                        with outer._task_lock:
-                            out = execute_fragment_task(
-                                engine, req, outer.buffers,
-                                secret=outer.shared_secret)
+                        tid = req.get("task_id")
+                        buffered = bool(req.get("partition")
+                                        or req.get("store"))
+                        if buffered:
+                            from presto_tpu.parallel.buffer import (
+                                OutputBuffer)
+                            nparts = int(
+                                (req.get("partition") or {}).get(
+                                    "nparts", 1))
+                            # async tasks get the BOUNDED buffer
+                            # (consumers drain concurrently); a sync
+                            # task must finish its POST before any
+                            # consumer exists, so its cap is unbounded
+                            cap = (BUFFER_BYTES if req.get("async")
+                                   else 1 << 62)
+                            outer.buffers[tid] = OutputBuffer(
+                                nparts, cap,
+                                readers=int(req.get("readers", 1)))
+                        if req.get("async"):
+                            outer.task_state[tid] = {
+                                "state": "running"}
+
+                            def run_async(engine=engine, req=req,
+                                          tid=tid):
+                                try:
+                                    out = execute_fragment_task(
+                                        engine, req, outer.buffers,
+                                        secret=outer.shared_secret,
+                                        engine_lock=outer._task_lock)
+                                    outer.task_state[tid] = {
+                                        "state": "finished", **out}
+                                except Exception as exc:  # noqa: BLE001
+                                    buf = outer.buffers.get(tid)
+                                    if buf is not None:
+                                        buf.fail(repr(exc))
+                                    outer.task_state[tid] = {
+                                        "state": "failed",
+                                        "error": repr(exc)[:500]}
+
+                            threading.Thread(target=run_async,
+                                             daemon=True).start()
+                            self._send_json({"taskId": tid,
+                                             "state": "running"})
+                            return
+                        out = execute_fragment_task(
+                            engine, req, outer.buffers,
+                            secret=outer.shared_secret,
+                            engine_lock=outer._task_lock)
                         if isinstance(out, bytes):
                             self._send_bytes(out)
                         else:

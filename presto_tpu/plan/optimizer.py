@@ -33,7 +33,11 @@ def optimize(plan: N.PlanNode, engine) -> N.PlanNode:
     # narrowed aggregate source drops dependent columns) and
     # re-annotates (its new re-join gets a dense hint)
     plan = annotate_dense(plan, engine)
-    lm = late_materialize(plan, engine)
+    enabled = True
+    session = getattr(engine, "session", None)
+    if session is not None:
+        enabled = bool(session.get("enable_late_materialization"))
+    lm = late_materialize(plan, engine) if enabled else plan
     if lm is not plan:
         plan = prune_columns(lm)
         plan = inline_trivial_projects(plan)

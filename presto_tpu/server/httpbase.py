@@ -25,10 +25,13 @@ class JsonHandler(BaseHTTPRequestHandler):
         length = int(self.headers.get("Content-Length", 0))
         return json.loads(self.rfile.read(length))
 
-    def _send_bytes(self, body: bytes, status: int = 200) -> None:
+    def _send_bytes(self, body: bytes, status: int = 200,
+                    extra_headers: dict | None = None) -> None:
         self.send_response(status)
         self.send_header("Content-Type", "application/octet-stream")
         self.send_header("Content-Length", str(len(body)))
+        for k, v in (extra_headers or {}).items():
+            self.send_header(k, v)
         self.end_headers()
         self.wfile.write(body)
 

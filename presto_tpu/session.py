@@ -57,6 +57,21 @@ SYSTEM_SESSION_PROPERTIES: dict[str, tuple[Any, type, str]] = {
                                    "skip the FIXED_HASH exchange "
                                    "(reference ConnectorNodePartitioning"
                                    "Provider)"),
+    "allow_local_fallback": (False, bool,
+                             "rerun a distributed query locally when "
+                             "its shape cannot distribute or a worker "
+                             "fails mid-query; off by default, so "
+                             "failures surface as REMOTE_TASK-style "
+                             "errors (reference fails loudly — "
+                             "SURVEY §5)"),
+    "enable_late_materialization": (True, bool,
+                                    "re-join FD-dependent group keys "
+                                    "from their base table after "
+                                    "aggregation (plan/latemat.py); "
+                                    "the coordinator disables it when "
+                                    "planning for distribution — the "
+                                    "fragmenter expects aggregate-"
+                                    "rooted shapes"),
     "enable_dynamic_filtering": (True, bool,
                                  "prune probe scans with build-side "
                                  "join-key min/max ranges (reference "

@@ -245,3 +245,106 @@ def test_require_distribution_fails_loudly(cluster):
                 "o_orderkey) from orders limit 5")
     finally:
         local.session.set("require_distribution", False)
+
+
+def test_window_distributes(cluster):
+    """Window functions over non-empty PARTITION BY distribute: rows
+    repartition FIXED_HASH on the partition keys and each worker runs
+    the whole window tail (VERDICT r04 item 6; reference AddExchanges
+    window partitioning)."""
+    coord, _workers, local = cluster
+    sql = ("select o_custkey, o_orderkey, "
+           "sum(o_totalprice) over (partition by o_custkey "
+           "order by o_orderkey) as running, "
+           "rank() over (partition by o_custkey "
+           "order by o_totalprice desc) as rk "
+           "from orders where o_custkey < 200 "
+           "order by o_custkey, o_orderkey")
+    local.session.set("require_distribution", True)
+    try:
+        got = coord.execute(sql)
+    finally:
+        local.session.set("require_distribution", False)
+    want = local.execute(sql)
+    assert got == want
+    assert coord.last_distribution["mode"] == "fragments"
+
+
+def test_distinct_aggregate_distributes(cluster):
+    """DISTINCT aggregates repartition rows by the group keys so each
+    group's distinct set lives on one worker (VERDICT r04 item 6;
+    reference MarkDistinct + FIXED_HASH exchange)."""
+    coord, _workers, local = cluster
+    sql = ("select o_custkey, count(distinct o_orderpriority) as c, "
+           "sum(o_totalprice) as s from orders "
+           "where o_custkey < 300 group by o_custkey "
+           "order by o_custkey")
+    local.session.set("require_distribution", True)
+    try:
+        got = coord.execute(sql)
+    finally:
+        local.session.set("require_distribution", False)
+    want = local.execute(sql)
+    assert got == want
+
+
+def test_full_join_distributes(cluster):
+    """FULL OUTER joins distribute with both sides FIXED_HASH
+    repartitioned (broadcast would duplicate unmatched build rows)."""
+    coord, _workers, local = cluster
+    sql = ("select count(*) as n, count(c_custkey) as nc, "
+           "count(o_orderkey) as no from customer "
+           "full join orders on c_custkey = o_custkey")
+    local.session.set("require_distribution", True)
+    local.session.set("join_distribution_type", "partitioned")
+    try:
+        got = coord.execute(sql)
+    finally:
+        local.session.set("require_distribution", False)
+        local.session.set("join_distribution_type", "automatic")
+    want = local.execute(sql)
+    assert got == want
+
+
+def test_worker_death_failover_and_loud_failure(tpch_tiny):
+    """A worker killed mid-query triggers ONE stage-DAG retry on the
+    survivors (stage-level failover); with every worker dead the query
+    FAILS REMOTE_TASK-style instead of silently running locally, and
+    allow_local_fallback opts back into the local rerun (VERDICT r04
+    item 6)."""
+    from presto_tpu import Engine
+    from presto_tpu.parallel.coordinator import (ClusterCoordinator,
+                                                 NoWorkersError,
+                                                 TaskError)
+    from presto_tpu.parallel.worker import WorkerServer
+
+    cats = {"tpch": tpch_tiny}
+    workers = [WorkerServer(cats).start() for _ in range(3)]
+    local = Engine()
+    local.register_catalog("tpch", tpch_tiny)
+    local.session.catalog = "tpch"
+    local.session.set("join_distribution_type", "partitioned")
+    coord = ClusterCoordinator(local)
+    for w in workers:
+        coord.add_worker(w.uri)
+    coord.start()
+    sql = ("select c_mktsegment, count(*) from customer, orders "
+           "where c_custkey = o_custkey group by c_mktsegment "
+           "order by c_mktsegment")
+    try:
+        want = local.execute(sql)
+        assert coord.execute(sql) == want  # healthy first
+        workers[2].stop()  # die without telling the coordinator
+        # failover: the stage DAG reruns on the two survivors
+        assert coord.execute(sql) == want
+        # kill everything: the query fails loudly by default
+        for w in workers[:2]:
+            w.stop()
+        with pytest.raises((NoWorkersError, TaskError, OSError)):
+            coord.execute(sql)
+        # opt-in fallback recovers the query locally
+        local.session.set("allow_local_fallback", True)
+        assert coord.execute(sql) == want
+    finally:
+        local.session.set("allow_local_fallback", False)
+        coord.stop()
