@@ -12,6 +12,8 @@ import json
 import time
 import urllib.request
 
+from presto_tpu.server.httpbase import urlopen as _urlopen
+
 
 class QueryFailed(Exception):
     pass
@@ -23,6 +25,7 @@ class Client:
         self.base_url = base_url.rstrip("/")
         self.user = user
         self.password = password
+        self.warnings: list = []
         # session properties accumulated from SET SESSION statements,
         # replayed on every request via X-Trino-Session (the reference
         # client's session accumulation, StatementClientV1)
@@ -44,15 +47,18 @@ class Client:
             cred = base64.b64encode(
                 f"{self.user}:{self.password}".encode()).decode()
             req.add_header("Authorization", f"Basic {cred}")
-        with urllib.request.urlopen(req, timeout=300) as resp:
+        with _urlopen(req, timeout=300) as resp:
             return json.loads(resp.read() or b"{}")
 
     def execute(self, sql: str, poll_interval: float = 0.02):
-        """Run SQL; returns (columns, rows). Blocks until FINISHED."""
+        """Run SQL; returns (columns, rows). Blocks until FINISHED.
+        Server-side diagnostics accumulate in ``self.warnings``
+        (reference StatementClientV1 currentStatusInfo().getWarnings)."""
         out = self._request("POST", f"{self.base_url}/v1/statement",
                             sql.encode())
         columns = None
         rows: list[list] = []
+        self.warnings = []
         while True:
             if "error" in out and out["error"]:
                 raise QueryFailed(out["error"].get("message", "failed"))
@@ -60,6 +66,8 @@ class Client:
                 columns = out["columns"]
             if out.get("setSession"):
                 self.session_properties.update(out["setSession"])
+            if out.get("warnings"):
+                self.warnings = out["warnings"]
             rows.extend(out.get("data", []))
             next_uri = out.get("nextUri")
             if next_uri is None:

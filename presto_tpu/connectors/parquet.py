@@ -1,0 +1,92 @@
+"""Parquet catalog: tables backed by .parquet files on disk — the
+engine's first non-synthetic data source (reference lib/trino-parquet
+feeding the hive connector's page source; here the from-scratch reader
+in formats/parquet.py feeds device columns through the standard
+connector SPI).
+
+Layout: a directory where each table is either ``<name>.parquet`` or a
+subdirectory ``<name>/`` of part files (concatenated in sorted order —
+the multi-file table layout hive-style writers produce).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Mapping
+
+import numpy as np
+
+from presto_tpu import types as T
+from presto_tpu.block import Column, Table, column_from_numpy
+from presto_tpu.connectors.base import Connector, TableStats
+from presto_tpu.formats.parquet import ParquetFile
+
+
+class ParquetConnector(Connector):
+    name = "parquet"
+
+    def __init__(self, directory: str):
+        self.directory = directory
+        self._tables: dict[str, Table] = {}
+        self._files: dict[str, list[str]] = {}
+        for entry in sorted(os.listdir(directory)):
+            full = os.path.join(directory, entry)
+            if entry.endswith(".parquet") and os.path.isfile(full):
+                self._files[entry[:-len(".parquet")]] = [full]
+            elif os.path.isdir(full):
+                parts = sorted(
+                    os.path.join(full, f) for f in os.listdir(full)
+                    if f.endswith(".parquet"))
+                if parts:
+                    self._files[entry] = parts
+
+    def table_names(self) -> list[str]:
+        return sorted(self._files)
+
+    def _meta(self, name: str) -> list[ParquetFile]:
+        if name not in self._files:
+            raise KeyError(f"no parquet table {name}")
+        return [ParquetFile(p) for p in self._files[name]]
+
+    def table_schema(self, name: str) -> Mapping[str, T.DataType]:
+        return self._meta(name)[0].schema()
+
+    def row_count_estimate(self, name: str) -> int:
+        # footers only — no data pages decode
+        return max(1, sum(f.num_rows for f in self._meta(name)))
+
+    def stats(self, name: str) -> TableStats:
+        return TableStats(row_count=self.row_count_estimate(name))
+
+    def table(self, name: str) -> Table:
+        cached = self._tables.get(name)
+        if cached is not None:
+            return cached
+        files = self._meta(name)
+        schema = files[0].schema()
+        cols: dict[str, Column] = {}
+        for cname, dtype in schema.items():
+            vals_parts = []
+            valid_parts = []
+            any_null = False
+            for f in files:
+                v, ok = f.read_column(cname)
+                vals_parts.append(v)
+                valid_parts.append(
+                    ok if ok is not None else np.ones(len(v), bool))
+                any_null = any_null or ok is not None
+            if len(vals_parts) == 1:
+                vals = vals_parts[0]
+            elif vals_parts and vals_parts[0].ndim == 2:
+                vals = np.vstack(vals_parts)
+            else:
+                vals = np.concatenate(vals_parts)
+            valid = (np.concatenate(valid_parts) if any_null else None)
+            if isinstance(dtype, T.DecimalType) and dtype.is_long:
+                cols[cname] = Column(dtype, vals, valid)
+            else:
+                cols[cname] = column_from_numpy(dtype, vals, valid)
+        nrows = len(next(iter(cols.values())).data) if cols else 0
+        tbl = Table(cols, nrows)
+        self._tables[name] = tbl
+        return tbl

@@ -57,6 +57,11 @@ class Engine:
         # populated by the spill driver when a query exceeds the memory
         # budget and runs host-partitioned (exec/spill.py)
         self.last_spill: dict | None = None
+        # per-THREAD warning handoff: concurrent queries on one engine
+        # (the server's worker pool) must not read each other's
+        # diagnostics
+        import threading as _threading
+        self._warn_tl = _threading.local()
         # query lifecycle events + history (events.py)
         self.events = EventListenerManager()
         # engine-owned virtual catalogs (reference information_schema +
@@ -67,6 +72,11 @@ class Engine:
 
     def register_catalog(self, name: str, connector: Connector) -> None:
         self.catalogs[name] = connector
+
+    @property
+    def last_warnings(self) -> list:
+        """Warnings of the CALLING THREAD's most recent query."""
+        return getattr(self._warn_tl, "value", [])
 
     def device_array(self, a):
         """Device copy of a host scan array, cached so repeat
@@ -105,15 +115,23 @@ class Engine:
 
         from presto_tpu.sql.rewrite import rewrite_statement
 
-        stmt = rewrite_statement(parse_statement(sql), self)
-        with self._cancel_scope(cancel_token):
-            if isinstance(stmt, A.QueryStatement):
+        from presto_tpu import warnings as W
+
+        W.push(WC := W.WarningCollector())
+        try:
+            stmt = rewrite_statement(parse_statement(sql), self)
+            with self._cancel_scope(cancel_token):
+                if isinstance(stmt, A.QueryStatement):
+                    return monitored(
+                        self, sql,
+                        lambda: self._execute_query(stmt.query,
+                                                    mesh).to_pylist())
                 return monitored(
                     self, sql,
-                    lambda: self._execute_query(stmt.query,
-                                                mesh).to_pylist())
-            return monitored(
-                self, sql, lambda: self._execute_statement(stmt, mesh))
+                    lambda: self._execute_statement(stmt, mesh))
+        finally:
+            self._warn_tl.value = WC.list()
+            W.pop()
 
     def execute_table(self, sql: str, mesh=None, cancel_token=None
                       ) -> Table:
@@ -123,12 +141,20 @@ class Engine:
 
         from presto_tpu.sql.rewrite import rewrite_statement
 
-        stmt = rewrite_statement(parse_statement(sql), self)
-        if not isinstance(stmt, A.QueryStatement):
-            raise ValueError("execute_table expects a SELECT query")
-        with self._cancel_scope(cancel_token):
-            return monitored(
-                self, sql, lambda: self._execute_query(stmt.query, mesh))
+        from presto_tpu import warnings as W
+
+        W.push(WC := W.WarningCollector())
+        try:
+            stmt = rewrite_statement(parse_statement(sql), self)
+            if not isinstance(stmt, A.QueryStatement):
+                raise ValueError("execute_table expects a SELECT query")
+            with self._cancel_scope(cancel_token):
+                return monitored(
+                    self, sql,
+                    lambda: self._execute_query(stmt.query, mesh))
+        finally:
+            self._warn_tl.value = WC.list()
+            W.pop()
 
     def _cancel_scope(self, token):
         """Install the cancellation token (plus the session's
@@ -154,7 +180,7 @@ class Engine:
 
         return scope()
 
-    def plan_sql(self, sql: str):
+    def plan_sql(self, sql: str, enable_latemat: bool | None = None):
         from presto_tpu.sql.parser import parse_statement
         from presto_tpu.sql.analyzer import Analyzer
         from presto_tpu.plan.planner import LogicalPlanner
@@ -163,7 +189,7 @@ class Engine:
         stmt = parse_statement(sql)
         analysis = Analyzer(self).analyze(stmt)
         plan = LogicalPlanner(self, analysis).plan(stmt)
-        plan = optimize(plan, self)
+        plan = optimize(plan, self, enable_latemat=enable_latemat)
         return plan, analysis
 
     def explain(self, sql: str) -> str:

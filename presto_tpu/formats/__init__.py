@@ -1,0 +1,2 @@
+"""File-format readers (the engine's analog of the reference's
+lib/trino-parquet and lib/trino-orc readers)."""

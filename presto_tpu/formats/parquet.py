@@ -1,0 +1,530 @@
+"""From-scratch Parquet reader: the engine's first contact with
+non-synthetic data (reference lib/trino-parquet — metadata reader
+ParquetMetadataReader.java, page codec ParquetCompressionUtils.java,
+RLE/bit-packed hybrid RunLengthBitPackingHybridDecoder.java).
+
+Scope (the format's core, covering what pyarrow and most writers emit
+for flat tables):
+- Thrift COMPACT protocol metadata decoding (no thrift dependency —
+  the protocol is a few varint rules, implemented in _CompactReader)
+- flat schemas: required/optional primitive columns (nested lists/maps
+  are rejected with a clear error)
+- data page V1 and V2, PLAIN and RLE_DICTIONARY/PLAIN_DICTIONARY
+  encodings, RLE/bit-packed hybrid definition levels
+- physical types BOOLEAN/INT32/INT64/FLOAT/DOUBLE/BYTE_ARRAY/
+  FIXED_LEN_BYTE_ARRAY with DATE/DECIMAL/UTF8 logical interpretation
+- UNCOMPRESSED and SNAPPY column chunks (own snappy decoder — the
+  raw-format LZ77 with 4 tag kinds)
+
+Values decode into numpy columns ready for the engine's Block layer;
+level/index unpacking is vectorized (np.unpackbits reshapes) rather
+than per-value loops.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import struct
+
+import numpy as np
+
+from presto_tpu import types as T
+
+MAGIC = b"PAR1"
+
+# parquet physical types
+BOOLEAN, INT32, INT64, INT96, FLOAT, DOUBLE, BYTE_ARRAY, FIXED = range(8)
+# page types
+DATA_PAGE, INDEX_PAGE, DICTIONARY_PAGE, DATA_PAGE_V2 = range(4)
+# encodings
+ENC_PLAIN = 0
+ENC_PLAIN_DICTIONARY = 2
+ENC_RLE = 3
+ENC_RLE_DICTIONARY = 8
+# codecs
+CODEC_UNCOMPRESSED = 0
+CODEC_SNAPPY = 1
+
+
+class ParquetError(RuntimeError):
+    pass
+
+
+# --------------------------------------------------------------------------
+# Thrift compact protocol
+
+
+class _CompactReader:
+    """Minimal Thrift compact-protocol struct reader: produces
+    {field_id: python value} dicts with nested structs/lists."""
+
+    def __init__(self, buf: bytes, pos: int = 0):
+        self.buf = buf
+        self.pos = pos
+
+    def _byte(self) -> int:
+        b = self.buf[self.pos]
+        self.pos += 1
+        return b
+
+    def _varint(self) -> int:
+        out = 0
+        shift = 0
+        while True:
+            b = self._byte()
+            out |= (b & 0x7F) << shift
+            if not b & 0x80:
+                return out
+            shift += 7
+
+    def _zigzag(self) -> int:
+        v = self._varint()
+        return (v >> 1) ^ -(v & 1)
+
+    def _binary(self) -> bytes:
+        n = self._varint()
+        out = self.buf[self.pos:self.pos + n]
+        self.pos += n
+        return out
+
+    def _value(self, ftype: int):
+        if ftype == 1:
+            return True
+        if ftype == 2:
+            return False
+        if ftype == 3:
+            return self._zigzag()
+        if ftype in (4, 5, 6):
+            return self._zigzag()
+        if ftype == 7:
+            v = struct.unpack_from("<d", self.buf, self.pos)[0]
+            self.pos += 8
+            return v
+        if ftype == 8:
+            return self._binary()
+        if ftype in (9, 10):
+            return self._list()
+        if ftype == 12:
+            return self.struct()
+        raise ParquetError(f"thrift compact type {ftype}")
+
+    def _list(self):
+        head = self._byte()
+        size = head >> 4
+        etype = head & 0x0F
+        if size == 15:
+            size = self._varint()
+        if etype in (1, 2):  # bool elements carry values in-band
+            return [self._byte() == 1 for _ in range(size)]
+        return [self._value(etype) for _ in range(size)]
+
+    def struct(self) -> dict:
+        out: dict[int, object] = {}
+        fid = 0
+        while True:
+            head = self._byte()
+            if head == 0:
+                return out
+            delta = head >> 4
+            ftype = head & 0x0F
+            fid = fid + delta if delta else self._zigzag()
+            out[fid] = self._value(ftype)
+
+
+# --------------------------------------------------------------------------
+# Snappy (raw format)
+
+
+def snappy_decompress(src: bytes) -> bytes:
+    """Raw-snappy decoder: varint uncompressed length, then literal /
+    copy tags (the format has exactly four element kinds)."""
+    pos = 0
+    out_len = 0
+    shift = 0
+    while True:
+        b = src[pos]
+        pos += 1
+        out_len |= (b & 0x7F) << shift
+        if not b & 0x80:
+            break
+        shift += 7
+    out = bytearray(out_len)
+    opos = 0
+    n = len(src)
+    while pos < n:
+        tag = src[pos]
+        pos += 1
+        kind = tag & 3
+        if kind == 0:  # literal
+            ln = (tag >> 2) + 1
+            if ln > 60:
+                extra = ln - 60
+                ln = int.from_bytes(src[pos:pos + extra], "little") + 1
+                pos += extra
+            out[opos:opos + ln] = src[pos:pos + ln]
+            pos += ln
+            opos += ln
+            continue
+        if kind == 1:
+            ln = ((tag >> 2) & 0x7) + 4
+            off = ((tag >> 5) << 8) | src[pos]
+            pos += 1
+        elif kind == 2:
+            ln = (tag >> 2) + 1
+            off = int.from_bytes(src[pos:pos + 2], "little")
+            pos += 2
+        else:
+            ln = (tag >> 2) + 1
+            off = int.from_bytes(src[pos:pos + 4], "little")
+            pos += 4
+        start = opos - off
+        if off >= ln:  # non-overlapping: one slice copy
+            out[opos:opos + ln] = out[start:start + ln]
+            opos += ln
+        else:  # overlapping run: byte-by-byte semantics
+            for _ in range(ln):
+                out[opos] = out[opos - off]
+                opos += 1
+    return bytes(out)
+
+
+def _decompress(codec: int, data: bytes, uncompressed_size: int) -> bytes:
+    if codec == CODEC_UNCOMPRESSED:
+        return data
+    if codec == CODEC_SNAPPY:
+        return snappy_decompress(data)
+    raise ParquetError(f"unsupported compression codec {codec}")
+
+
+# --------------------------------------------------------------------------
+# RLE / bit-packed hybrid
+
+
+def rle_bp_decode(buf: bytes, bit_width: int, count: int) -> np.ndarray:
+    """Decode ``count`` values from an RLE/bit-packed hybrid run
+    (reference RunLengthBitPackingHybridDecoder.java). Bit-packed
+    groups unpack vectorized via np.unpackbits."""
+    if bit_width == 0:
+        return np.zeros(count, np.int64)
+    out = np.empty(count, np.int64)
+    filled = 0
+    pos = 0
+    byte_w = (bit_width + 7) // 8
+    while filled < count:
+        header = 0
+        shift = 0
+        while True:
+            b = buf[pos]
+            pos += 1
+            header |= (b & 0x7F) << shift
+            if not b & 0x80:
+                break
+            shift += 7
+        if header & 1:  # bit-packed: (header>>1) groups of 8
+            groups = header >> 1
+            nvals = groups * 8
+            nbytes = groups * bit_width
+            raw = np.frombuffer(buf, np.uint8, nbytes, pos)
+            pos += nbytes
+            bits = np.unpackbits(raw, bitorder="little")
+            vals = bits.reshape(nvals, bit_width)
+            weights = (1 << np.arange(bit_width, dtype=np.int64))
+            decoded = vals.astype(np.int64) @ weights
+            take = min(nvals, count - filled)
+            out[filled:filled + take] = decoded[:take]
+            filled += take
+        else:  # RLE run
+            run = header >> 1
+            v = int.from_bytes(buf[pos:pos + byte_w], "little")
+            pos += byte_w
+            take = min(run, count - filled)
+            out[filled:filled + take] = v
+            filled += take
+    return out
+
+
+# --------------------------------------------------------------------------
+# value decoding
+
+
+def _plain_values(ptype: int, buf: bytes, n: int, type_length: int):
+    if ptype == INT32:
+        return np.frombuffer(buf, "<i4", n)
+    if ptype == INT64:
+        return np.frombuffer(buf, "<i8", n)
+    if ptype == FLOAT:
+        return np.frombuffer(buf, "<f4", n)
+    if ptype == DOUBLE:
+        return np.frombuffer(buf, "<f8", n)
+    if ptype == BOOLEAN:
+        bits = np.unpackbits(np.frombuffer(buf, np.uint8),
+                             bitorder="little")
+        return bits[:n].astype(bool)
+    if ptype == BYTE_ARRAY:
+        out = np.empty(n, object)
+        pos = 0
+        for i in range(n):
+            ln = int.from_bytes(buf[pos:pos + 4], "little")
+            pos += 4
+            out[i] = buf[pos:pos + ln]
+            pos += ln
+        return out
+    if ptype == FIXED:
+        out = np.empty(n, object)
+        for i in range(n):
+            out[i] = buf[i * type_length:(i + 1) * type_length]
+        return out
+    raise ParquetError(f"unsupported physical type {ptype}")
+
+
+@dataclasses.dataclass
+class _SchemaCol:
+    name: str
+    ptype: int
+    optional: bool
+    type_length: int
+    converted: int | None
+    scale: int
+    precision: int
+    logical: dict | None
+
+
+def _engine_type(col: _SchemaCol) -> T.DataType:
+    # LogicalType union field ids (parquet.thrift): 1 STRING, 5
+    # DECIMAL, 6 DATE, 8 TIMESTAMP; ConvertedType enum: 0 UTF8,
+    # 5 DECIMAL, 6 DATE, 9/10 TIMESTAMP_(MILLIS|MICROS)
+    lt = col.logical or {}
+    if 6 in lt or col.converted == 6:
+        return T.DATE
+    if 1 in lt or col.converted == 0:
+        return T.VARCHAR
+    if 5 in lt or col.converted == 5:
+        return T.DecimalType(col.precision or 38, col.scale or 0)
+    if (8 in lt or col.converted in (9, 10)) and col.ptype == INT64:
+        return T.TIMESTAMP
+    return {
+        BOOLEAN: T.BOOLEAN, INT32: T.INTEGER, INT64: T.BIGINT,
+        FLOAT: T.DOUBLE, DOUBLE: T.DOUBLE, BYTE_ARRAY: T.VARCHAR,
+        FIXED: T.VARCHAR,
+    }.get(col.ptype, T.VARCHAR)
+
+
+class ParquetFile:
+    """One Parquet file's metadata + column readers."""
+
+    def __init__(self, path: str):
+        self.path = path
+        with open(path, "rb") as f:
+            data = f.read()
+        if data[:4] != MAGIC or data[-4:] != MAGIC:
+            raise ParquetError(f"{path}: not a parquet file")
+        footer_len = int.from_bytes(data[-8:-4], "little")
+        meta = _CompactReader(data[len(data) - 8 - footer_len:]).struct()
+        self._data = data
+        self.num_rows = int(meta.get(3, 0))
+        self.columns: list[_SchemaCol] = []
+        schema = meta.get(2, [])
+        root = schema[0] if schema else {}
+        if int(root.get(5, 0)) != len(schema) - 1:
+            raise ParquetError(
+                f"{path}: nested schemas are not supported (flat "
+                "primitive columns only)")
+        for el in schema[1:]:
+            if 5 in el and el[5]:
+                raise ParquetError(
+                    f"{path}: nested column "
+                    f"{el.get(4, b'?').decode()} unsupported")
+            self.columns.append(_SchemaCol(
+                name=el[4].decode(),
+                ptype=int(el[1]),
+                optional=int(el.get(3, 0)) == 1,
+                type_length=int(el.get(2, 0)),
+                converted=(int(el[6]) if 6 in el else None),
+                scale=int(el.get(7, 0)),
+                precision=int(el.get(8, 0)),
+                logical=el.get(10)))
+        self.row_groups = meta.get(4, [])
+
+    def schema(self) -> dict[str, T.DataType]:
+        return {c.name: _engine_type(c) for c in self.columns}
+
+    def read_column(self, name: str):
+        """(values np.ndarray, valid bool[n] | None) across all row
+        groups."""
+        idx = next((i for i, c in enumerate(self.columns)
+                    if c.name == name), None)
+        if idx is None:
+            raise ParquetError(f"{self.path}: no column {name}")
+        col = self.columns[idx]
+        vals_parts = []
+        valid_parts = []
+        any_null = False
+        for rg in self.row_groups:
+            chunk = rg[1][idx]
+            cmeta = chunk[3]
+            vals, valid = self._read_chunk(col, cmeta)
+            vals_parts.append(vals)
+            if valid is None:
+                valid_parts.append(np.ones(len(vals), bool))
+            else:
+                any_null = True
+                valid_parts.append(valid)
+        values = (np.concatenate(vals_parts) if vals_parts
+                  else np.empty(0))
+        valid = np.concatenate(valid_parts) if valid_parts else None
+        return values, (valid if any_null else None)
+
+    def _read_chunk(self, col: _SchemaCol, cmeta: dict):
+        codec = int(cmeta.get(4, 0))
+        num_values = int(cmeta.get(5, 0))
+        start = int(cmeta.get(11, cmeta.get(9, 0)) or cmeta.get(9, 0))
+        pos = start
+        dictionary = None
+        values = []
+        valids = []
+        got = 0
+        while got < num_values:
+            rd = _CompactReader(self._data, pos)
+            header = rd.struct()
+            body_start = rd.pos
+            ptype = int(header.get(1, 0))
+            comp_size = int(header.get(3, 0))
+            uncomp_size = int(header.get(2, 0))
+            body = self._data[body_start:body_start + comp_size]
+            pos = body_start + comp_size
+            if ptype == DICTIONARY_PAGE:
+                dh = header.get(7, {})
+                n = int(dh.get(1, 0))
+                raw = _decompress(codec, body, uncomp_size)
+                dictionary = _plain_values(col.ptype, raw, n,
+                                           col.type_length)
+                continue
+            if ptype == DATA_PAGE:
+                dh = header.get(5, {})
+                n = int(dh.get(1, 0))
+                enc = int(dh.get(2, 0))
+                raw = _decompress(codec, body, uncomp_size)
+                vpos = 0
+                valid = None
+                if col.optional:
+                    ln = int.from_bytes(raw[:4], "little")
+                    levels = rle_bp_decode(raw[4:4 + ln], 1, n)
+                    valid = levels.astype(bool)
+                    vpos = 4 + ln
+                npresent = int(valid.sum()) if valid is not None else n
+                vals = self._decode_values(
+                    col, enc, raw[vpos:], npresent, dictionary)
+                values.append((vals, valid, n))
+                got += n
+                continue
+            if ptype == DATA_PAGE_V2:
+                dh = header.get(8, {})
+                n = int(dh.get(1, 0))
+                nnull = int(dh.get(2, 0))
+                enc = int(dh.get(4, 0))
+                dl_len = int(dh.get(5, 0))
+                rl_len = int(dh.get(6, 0))
+                compressed = bool(dh.get(7, True))
+                levels = self._data[body_start:body_start + rl_len
+                                    + dl_len]
+                vbody = body[rl_len + dl_len:]
+                raw = (_decompress(codec, vbody,
+                                   uncomp_size - rl_len - dl_len)
+                       if compressed else vbody)
+                valid = None
+                if col.optional:
+                    lv = rle_bp_decode(
+                        levels[rl_len:rl_len + dl_len], 1, n)
+                    valid = lv.astype(bool)
+                npresent = n - nnull
+                vals = self._decode_values(col, enc, raw, npresent,
+                                           dictionary)
+                values.append((vals, valid, n))
+                got += n
+                continue
+            raise ParquetError(f"unsupported page type {ptype}")
+        return self._assemble(col, values)
+
+    def _decode_values(self, col: _SchemaCol, enc: int, raw: bytes,
+                       n: int, dictionary):
+        if enc == ENC_PLAIN:
+            return _plain_values(col.ptype, raw, n, col.type_length)
+        if enc in (ENC_PLAIN_DICTIONARY, ENC_RLE_DICTIONARY):
+            if dictionary is None:
+                raise ParquetError("dictionary page missing")
+            width = raw[0]
+            idxs = rle_bp_decode(raw[1:], width, n)
+            return dictionary[idxs]
+        if enc == ENC_RLE and col.ptype == BOOLEAN:
+            # boolean values as an RLE/bit-packed run, 4-byte length
+            # prefixed (format spec: RLE data encoding)
+            ln = int.from_bytes(raw[:4], "little")
+            return rle_bp_decode(raw[4:4 + ln], 1, n).astype(bool)
+        raise ParquetError(f"unsupported encoding {enc}")
+
+    def _assemble(self, col: _SchemaCol, pages):
+        """Scatter present values to row positions + convert to the
+        engine's physical representation."""
+        total = sum(n for _, _, n in pages)
+        valid_all = None
+        if col.optional and any(v is not None for _, v, _ in pages):
+            valid_all = np.concatenate([
+                v if v is not None else np.ones(n, bool)
+                for _, v, n in pages])
+        present = (np.concatenate([np.asarray(v) for v, _, _ in pages])
+                   if pages else np.empty(0))
+        etype = _engine_type(col)
+        vals = _convert(col, etype, present)
+        if valid_all is None or valid_all.all():
+            return vals, None
+        # scatter present values into the full row vector
+        if vals.dtype == object:
+            full = np.empty(total, object)
+            full[:] = b"" if isinstance(
+                vals[0] if len(vals) else b"", bytes) else None
+        else:
+            full = np.zeros(
+                total,
+                vals.dtype if vals.ndim == 1 else vals.dtype)
+            if vals.ndim == 2:
+                full = np.zeros((total, vals.shape[1]), vals.dtype)
+        full[valid_all] = vals
+        return full, valid_all
+
+
+def _convert(col: _SchemaCol, etype: T.DataType, present: np.ndarray):
+    if isinstance(etype, T.DecimalType):
+        if col.ptype in (INT32, INT64):
+            scaled = present.astype(np.int64)
+        else:  # FIXED / BYTE_ARRAY: big-endian two's complement
+            scaled = np.array(
+                [int.from_bytes(b, "big", signed=True)
+                 for b in present], object)
+        if etype.is_long:
+            out = np.empty((len(scaled), 2), np.int64)
+            for i, v in enumerate(scaled):
+                m = int(v) & ((1 << 128) - 1)
+                lo = m & ((1 << 64) - 1)
+                hi = (m >> 64) & ((1 << 64) - 1)
+                out[i, 0] = lo - (1 << 64) if lo >= 1 << 63 else lo
+                out[i, 1] = hi - (1 << 64) if hi >= 1 << 63 else hi
+            return out
+        return np.asarray([int(v) for v in scaled], np.int64)
+    if isinstance(etype, T.DateType):
+        return present.astype(np.int32)
+    if isinstance(etype, T.TimestampType):
+        x = present.astype(np.int64)
+        unit = ((col.logical or {}).get(8) or {}).get(2, {})
+        if col.converted == 9 or 1 in unit:  # millis -> micros
+            return x * 1000
+        if 3 in unit:  # nanos -> micros
+            return x // 1000
+        return x  # micros
+    if isinstance(etype, T.VarcharType):
+        return np.array([b.decode("utf-8", "replace")
+                         for b in present], object)
+    if isinstance(etype, T.DoubleType):
+        return present.astype(np.float64)
+    if isinstance(etype, T.BooleanType):
+        return present.astype(bool)
+    return present.astype(np.int64)

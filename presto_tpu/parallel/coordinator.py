@@ -23,6 +23,8 @@ import json
 import threading
 import urllib.error
 import urllib.request
+
+from presto_tpu.server.httpbase import urlopen as _urlopen
 from concurrent.futures import ThreadPoolExecutor
 
 import numpy as np
@@ -84,7 +86,7 @@ class RemoteWorker:
             headers={"Content-Type": "application/json",
                      **self._auth_headers()})
         try:
-            with urllib.request.urlopen(req, timeout=timeout) as resp:
+            with _urlopen(req, timeout=timeout) as resp:
                 body = resp.read()
                 if resp.headers.get("Content-Type", "").startswith(
                         "application/octet-stream"):
@@ -106,15 +108,15 @@ class RemoteWorker:
             f"{self.uri}/v1/task/{prefix}", method="DELETE",
             headers=self._auth_headers())
         try:
-            with urllib.request.urlopen(req, timeout=timeout):
+            with _urlopen(req, timeout=timeout):
                 pass
         except Exception:  # noqa: BLE001 - cleanup is best-effort
             pass
 
     def ping(self, timeout: float = 2.0) -> bool:
         try:
-            with urllib.request.urlopen(
-                    f"{self.uri}/v1/status", timeout=timeout) as resp:
+            with _urlopen(urllib.request.Request(
+                    f"{self.uri}/v1/status"), timeout=timeout) as resp:
                 return json.loads(resp.read()).get("state") == "active"
         except Exception:  # noqa: BLE001 - any failure counts
             return False
@@ -185,13 +187,7 @@ class ClusterCoordinator:
         # plan with late materialization off: its rewritten shape
         # (dimension re-join above the aggregate) is a single-chip
         # width optimization the fragmenter cannot stage
-        sess = self.engine.session
-        saved_lm = sess.get("enable_late_materialization")
-        sess.set("enable_late_materialization", False)
-        try:
-            plan, _ = self.engine.plan_sql(sql)
-        finally:
-            sess.set("enable_late_materialization", saved_lm)
+        plan, _ = self.engine.plan_sql(sql, enable_latemat=False)
         workers = self.live_workers()
         require = bool(self.engine.session.get("require_distribution"))
         allow_fb = bool(self.engine.session.get("allow_local_fallback"))

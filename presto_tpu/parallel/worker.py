@@ -26,7 +26,8 @@ import urllib.request
 
 import numpy as np
 
-from presto_tpu.server.httpbase import HttpService, JsonHandler
+from presto_tpu.server.httpbase import (HttpService, JsonHandler,
+                                        urlopen as _urlopen)
 
 
 def execute_partial_task(engine_factory, sql: str, shard: int,
@@ -145,7 +146,7 @@ def _fetch_pages(ref: dict, timeout: float = 240.0,
     while True:
         req = urllib.request.Request(f"{base}/{token}/{reader}",
                                      headers=headers)
-        with urllib.request.urlopen(req, timeout=60.0) as resp:
+        with _urlopen(req, timeout=60.0) as resp:
             blob = resp.read()
             nxt = int(resp.headers.get("X-PrestoTpu-Next-Token", token))
             complete = (resp.headers.get("X-PrestoTpu-Complete", "0")
@@ -244,9 +245,13 @@ def _emit_pages(buf, partition: int, cols: dict, nrows: int) -> None:
     if nrows == 0:
         buf.add(partition, columns_to_bytes(cols), 0)
         return
+    # size estimate includes amortized dictionary bytes so wide string
+    # columns don't produce pages far beyond PAGE_BYTES
     row_bytes = max(1, sum(
         np.asarray(c.data).dtype.itemsize
         + (1 if c.valid is not None else 0)
+        + (sum(len(str(x)) for x in c.dictionary) * 4 // max(nrows, 1)
+           if c.dictionary is not None else 0)
         for c in cols.values()))
     rows_per_page = max(1, PAGE_BYTES // row_bytes)
     start = 0
@@ -257,9 +262,34 @@ def _emit_pages(buf, partition: int, cols: dict, nrows: int) -> None:
         else:
             mask = np.zeros(nrows, bool)
             mask[start:stop] = True
-            page_cols = slice_columns(cols, mask)
+            page_cols = _compact_dictionaries(
+                slice_columns(cols, mask))
         buf.add(partition, columns_to_bytes(page_cols), stop - start)
         start = stop
+
+
+def _compact_dictionaries(cols: dict) -> dict:
+    """Narrow each string column's dictionary to the entries its page
+    actually references — slice_columns keeps the full dictionary, and
+    serializing it whole into EVERY page would multiply the transfer by
+    the page count."""
+    from presto_tpu.block import Column
+
+    out = {}
+    for name, c in cols.items():
+        if c.dictionary is None or len(c.dictionary) <= 16:
+            out[name] = c
+            continue
+        codes = np.asarray(c.data)
+        used = np.unique(np.clip(codes, 0, len(c.dictionary) - 1))
+        if len(used) >= len(c.dictionary):
+            out[name] = c
+            continue
+        remap = np.searchsorted(used, np.clip(codes, 0,
+                                              len(c.dictionary) - 1))
+        out[name] = Column(c.dtype, remap.astype(codes.dtype),
+                           c.valid, c.dictionary[used])
+    return out
 
 
 class WorkerServer(HttpService):
@@ -270,7 +300,8 @@ class WorkerServer(HttpService):
 
     def __init__(self, catalogs: dict, host: str = "127.0.0.1",
                  port: int = 0, node_id: str = "worker",
-                 shared_secret: str | None = None):
+                 shared_secret: str | None = None,
+                 tls: tuple[str, str] | None = None):
         from presto_tpu.parallel import auth as _auth
         self.catalogs = catalogs
         self.node_id = node_id
@@ -463,4 +494,4 @@ class WorkerServer(HttpService):
                     self._send_json(
                         {"error": f"{type(e).__name__}: {e}"}, 500)
 
-        super().__init__(Handler, host, port)
+        super().__init__(Handler, host, port, tls=tls)

@@ -21,7 +21,8 @@ from presto_tpu.expr import ir
 from presto_tpu.plan import nodes as N
 
 
-def optimize(plan: N.PlanNode, engine) -> N.PlanNode:
+def optimize(plan: N.PlanNode, engine,
+             enable_latemat: bool | None = None) -> N.PlanNode:
     from presto_tpu.plan.dense import annotate_dense
     from presto_tpu.plan.latemat import late_materialize
     from presto_tpu.plan.rules import apply_rules
@@ -33,10 +34,11 @@ def optimize(plan: N.PlanNode, engine) -> N.PlanNode:
     # narrowed aggregate source drops dependent columns) and
     # re-annotates (its new re-join gets a dense hint)
     plan = annotate_dense(plan, engine)
-    enabled = True
-    session = getattr(engine, "session", None)
-    if session is not None:
-        enabled = bool(session.get("enable_late_materialization"))
+    enabled = enable_latemat
+    if enabled is None:
+        session = getattr(engine, "session", None)
+        enabled = (bool(session.get("enable_late_materialization"))
+                   if session is not None else True)
     lm = late_materialize(plan, engine) if enabled else plan
     if lm is not plan:
         plan = prune_columns(lm)

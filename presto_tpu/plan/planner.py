@@ -1906,6 +1906,10 @@ class LogicalPlanner:
                             "cross join product estimated at "
                             f"{est * legs[j].est} rows exceeds the "
                             "nested-loop limit (add a join predicate)")
+                    from presto_tpu import warnings as W
+                    W.warn(W.PERFORMANCE_WARNING,
+                           "query contains a cross join without a "
+                           "join predicate (nested-loop product)")
                     node = N.CrossJoin(node, legs[j].node, scalar=False,
                                        left_rows=est,
                                        right_rows=legs[j].est)

@@ -36,14 +36,71 @@ class JsonHandler(BaseHTTPRequestHandler):
         self.wfile.write(body)
 
 
+# process-wide client-side TLS context: set by enable_client_tls() so
+# every internal HTTP client (coordinator -> worker RPC, worker ->
+# worker exchange fetch, protocol client) verifies the cluster's
+# certificate (reference InternalCommunicationConfig https setup /
+# server/security/ServerSecurityModule.java)
+_CLIENT_SSL_CONTEXT = None
+
+
+def enable_client_tls(cafile: str,
+                      check_hostname: bool = True) -> None:
+    import ssl
+    global _CLIENT_SSL_CONTEXT
+    _CLIENT_SSL_CONTEXT = ssl.create_default_context(cafile=cafile)
+    _CLIENT_SSL_CONTEXT.check_hostname = check_hostname
+
+
+def disable_client_tls() -> None:
+    global _CLIENT_SSL_CONTEXT
+    _CLIENT_SSL_CONTEXT = None
+
+
+def client_ssl_context():
+    return _CLIENT_SSL_CONTEXT
+
+
+def urlopen(req, timeout: float = 60.0):
+    """urllib.request.urlopen with the cluster TLS context applied."""
+    import urllib.request
+    return urllib.request.urlopen(req, timeout=timeout,
+                                  context=_CLIENT_SSL_CONTEXT)
+
+
 class HttpService:
-    """Owns a ThreadingHTTPServer + daemon serve thread lifecycle."""
+    """Owns a ThreadingHTTPServer + daemon serve thread lifecycle.
+    ``tls`` = (certfile, keyfile) serves HTTPS (reference
+    HttpServerConfig https enable)."""
 
     def __init__(self, handler_cls, host: str = "127.0.0.1",
-                 port: int = 0):
-        self.httpd = ThreadingHTTPServer((host, port), handler_cls)
+                 port: int = 0, tls: tuple[str, str] | None = None):
+        scheme = "http"
+        if tls is not None:
+            import ssl
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ctx.load_cert_chain(certfile=tls[0], keyfile=tls[1])
+
+            class _TLSServer(ThreadingHTTPServer):
+                # handshake runs in the PER-CONNECTION handler thread:
+                # wrapping the listening socket instead would perform
+                # every handshake inside the single accept loop, where
+                # one slow client stalls the whole server (exchange
+                # long-polls + pings + task POSTs connect concurrently)
+                def finish_request(self, request, client_address):
+                    try:
+                        request = ctx.wrap_socket(request,
+                                                  server_side=True)
+                    except (OSError, ssl.SSLError):
+                        return  # failed handshake: drop connection
+                    super().finish_request(request, client_address)
+
+            self.httpd = _TLSServer((host, port), handler_cls)
+            scheme = "https"
+        else:
+            self.httpd = ThreadingHTTPServer((host, port), handler_cls)
         self.port = self.httpd.server_address[1]
-        self.uri = f"http://{host}:{self.port}"
+        self.uri = f"{scheme}://{host}:{self.port}"
         self._thread: threading.Thread | None = None
 
     def start(self):

@@ -47,6 +47,8 @@ class QueryInfo:
     finished: float | None = None
     rows_sent: int = 0
     cancel_token: object = None  # exec/cancel.CancelToken
+    # accumulated EngineWarning dicts (reference QueryResults.warnings)
+    warnings: list = dataclasses.field(default_factory=list)
     # per-query property overrides from the X-Trino-Session header
     session_properties: dict = dataclasses.field(default_factory=dict)
     # SET SESSION result handed back to the client, which carries it on
@@ -210,6 +212,8 @@ class QueryManager:
             with self.engine.session.as_user(q.user, overrides):
                 rows = self.engine.execute(q.sql,
                                            cancel_token=q.cancel_token)
+            q.warnings = [w.to_dict() for w in
+                          getattr(self.engine, "last_warnings", [])]
             width = len(rows[0]) if rows else 1
             q.columns = [{"name": f"_col{i}", "type": "varchar"}
                          for i in range(width)]
@@ -219,6 +223,8 @@ class QueryManager:
         with self.engine.session.as_user(q.user, overrides):
             table = self.engine.execute_table(q.sql,
                                               cancel_token=q.cancel_token)
+        q.warnings = [w.to_dict() for w in
+                      getattr(self.engine, "last_warnings", [])]
         q.columns = [{"name": n, "type": str(c.dtype)}
                      for n, c in table.columns.items()]
         dtypes = [c.dtype for c in table.columns.values()]
@@ -288,9 +294,13 @@ class _Handler(JsonHandler):
 
     # -- helpers ------------------------------------------------------------
 
+    # set to "https" by CoordinatorServer when TLS is enabled so
+    # nextUri/infoUri send clients back over the same scheme
+    uri_scheme = "http"
+
     def _base_uri(self) -> str:
         host = self.headers.get("Host", "localhost")
-        return f"http://{host}"
+        return f"{self.uri_scheme}://{host}"
 
     def _metrics_text(self) -> str:
         """Prometheus text exposition — the observability export the
@@ -352,6 +362,9 @@ class _Handler(JsonHandler):
         if q.state == "FINISHED":
             if q.set_session:
                 out["setSession"] = q.set_session
+            if getattr(q, "warnings", None):
+                # reference protocol/QueryResults warnings field
+                out["warnings"] = q.warnings
             out["columns"] = q.columns
             start = token * PAGE_ROWS
             chunk = (q.rows or [])[start:start + PAGE_ROWS]
@@ -570,9 +583,11 @@ class CoordinatorServer(HttpService):
     """Threaded HTTP coordinator over an Engine (Server.java:75 analog)."""
 
     def __init__(self, engine, host: str = "127.0.0.1", port: int = 0,
-                 resource_groups=None, authenticator=None):
+                 resource_groups=None, authenticator=None,
+                 tls: tuple[str, str] | None = None):
         handler = type("BoundHandler", (_Handler,), {
             "manager": QueryManager(engine,
                                     resource_groups=resource_groups),
-            "authenticator": authenticator})
-        super().__init__(handler, host, port)
+            "authenticator": authenticator,
+            "uri_scheme": "https" if tls is not None else "http"})
+        super().__init__(handler, host, port, tls=tls)

@@ -725,6 +725,9 @@ class Parser:
             if self.at_op("=", "<>", "!=", "<", "<=", ">", ">="):
                 op = self.advance().value
                 if op == "!=":
+                    from presto_tpu import warnings as W
+                    W.warn(W.DEPRECATED_SYNTAX,
+                           "'!=' is non-standard SQL; use '<>'")
                     op = "<>"
                 # quantified subquery: = (SELECT ...) handled by ScalarSubquery
                 right = self._additive()

@@ -1,0 +1,177 @@
+"""Parquet read path (VERDICT r04 item 8) — the from-scratch reader in
+formats/parquet.py (reference lib/trino-parquet) + the parquet catalog.
+pyarrow serves as the file WRITER and the correctness oracle; the
+reader under test shares no code with it."""
+
+import datetime
+import decimal
+import os
+
+import numpy as np
+import pytest
+
+pa = pytest.importorskip("pyarrow")
+import pyarrow.parquet as pq  # noqa: E402
+
+from presto_tpu import Engine, types as T  # noqa: E402
+from presto_tpu.connectors.parquet import ParquetConnector  # noqa: E402
+from presto_tpu.formats.parquet import (ParquetFile,  # noqa: E402
+                                        snappy_decompress)
+
+
+@pytest.fixture(scope="module")
+def pq_dir(tmp_path_factory):
+    d = tmp_path_factory.mktemp("pq")
+    rng = np.random.default_rng(0)
+    n = 5000
+    tbl = pa.table({
+        "id": pa.array(np.arange(n, dtype=np.int64)),
+        "grp": pa.array(rng.integers(0, 50, n).astype(np.int32)),
+        "price": pa.array(rng.uniform(0, 1000, n)),
+        "name": pa.array([f"item_{i % 97}" for i in range(n)]),
+        "flag": pa.array(rng.random(n) > 0.5),
+        "d": pa.array((np.arange(n) % 900).astype(np.int32),
+                      type=pa.date32()),
+        "maybe": pa.array([None if i % 7 == 0 else float(i)
+                           for i in range(n)]),
+        "dec": pa.array([None if i % 11 == 0 else i * 7
+                         for i in range(n)],
+                        type=pa.decimal128(25, 2)),
+    })
+    pq.write_table(tbl, os.path.join(d, "t.parquet"),
+                   compression="snappy")
+    pq.write_table(tbl, os.path.join(d, "t_plain.parquet"),
+                   compression="none", use_dictionary=False)
+    pq.write_table(tbl, os.path.join(d, "t_v2.parquet"),
+                   compression="snappy", data_page_version="2.0")
+    return str(d)
+
+
+@pytest.mark.parametrize("fname", ["t", "t_plain", "t_v2"])
+def test_reader_matches_pyarrow(pq_dir, fname):
+    path = os.path.join(pq_dir, fname + ".parquet")
+    f = ParquetFile(path)
+    ref = pq.read_table(path)
+    assert f.num_rows == ref.num_rows
+    for cname in ("id", "grp", "price", "name", "flag", "d", "maybe",
+                  "dec"):
+        vals, valid = f.read_column(cname)
+        want = ref.column(cname).to_pylist()
+        for i in range(0, len(want), 37):
+            w = want[i]
+            if w is None:
+                assert valid is not None and not valid[i]
+                continue
+            assert valid is None or valid[i]
+            g = vals[i]
+            if cname == "dec":
+                raw = ((int(g[1]) << 64)
+                       | (int(g[0]) & ((1 << 64) - 1)))
+                if int(g[1]) < 0:
+                    raw -= 1 << 128
+                g = decimal.Decimal(raw) / 100
+            elif cname == "d":
+                w = (w - datetime.date(1970, 1, 1)).days
+            if isinstance(w, float):
+                assert abs(float(g) - w) < 1e-9
+            else:
+                assert g == w or str(g) == str(w)
+
+
+def test_snappy_roundtrip_via_pyarrow_files(pq_dir):
+    # the snappy decoder is exercised by the compressed fixtures above;
+    # spot-check a synthetic stream with overlapping copies too
+    raw = b"abcabcabcabcabc" * 20 + os.urandom(64) + b"x" * 300
+    import pyarrow as _pa
+    comp = _pa.compress(raw, codec="snappy", asbytes=True)
+    assert snappy_decompress(comp) == raw
+
+
+def test_parquet_connector_schema_and_stats(pq_dir):
+    conn = ParquetConnector(pq_dir)
+    assert set(conn.table_names()) == {"t", "t_plain", "t_v2"}
+    schema = conn.table_schema("t")
+    assert schema["id"] == T.BIGINT
+    assert schema["price"] == T.DOUBLE
+    assert schema["name"] == T.VARCHAR
+    assert schema["d"] == T.DATE
+    assert isinstance(schema["dec"], T.DecimalType) \
+        and schema["dec"].precision == 25
+    assert conn.row_count_estimate("t") == 5000
+
+
+def test_sql_over_parquet(pq_dir):
+    e = Engine()
+    e.register_catalog("pq", ParquetConnector(pq_dir))
+    e.session.catalog = "pq"
+    rows = e.execute(
+        "select grp, count(*) as c, sum(price) as s, "
+        "count(maybe) as nm, min(name) as mn "
+        "from t group by grp order by grp limit 5")
+    ref = pq.read_table(os.path.join(pq_dir, "t.parquet"))
+    import collections
+    cnt = collections.Counter(ref.column("grp").to_pylist())
+    sums: dict = {}
+    nm: dict = {}
+    mn: dict = {}
+    for g, p, m, name in zip(ref.column("grp").to_pylist(),
+                             ref.column("price").to_pylist(),
+                             ref.column("maybe").to_pylist(),
+                             ref.column("name").to_pylist()):
+        sums[g] = sums.get(g, 0.0) + p
+        nm[g] = nm.get(g, 0) + (m is not None)
+        mn[g] = min(mn.get(g, name), name)
+    for g, c, s, m, n_ in rows:
+        assert c == cnt[int(g)]
+        assert abs(float(s) - sums[int(g)]) < 1e-6
+        assert m == nm[int(g)]
+        assert n_ == mn[int(g)]
+
+
+def test_tpch_query_from_parquet_files(tmp_path):
+    """A TPC-H query runs from Parquet files end to end: the synthetic
+    connector's tables round-trip through pyarrow-written parquet and
+    Q6 matches the in-memory answer."""
+    from presto_tpu.connectors import TpchConnector
+
+    tpch = TpchConnector(scale=0.01)
+    li = tpch.table("lineitem")
+    arrays = {}
+    for cname in ("l_quantity", "l_extendedprice", "l_discount",
+                  "l_shipdate"):
+        col = li.columns[cname]
+        data = np.asarray(col.data)
+        if isinstance(col.dtype, T.DecimalType):
+            arr = pa.array(
+                [decimal.Decimal(int(v)) / col.dtype.unscale_factor
+                 for v in data],
+                type=pa.decimal128(col.dtype.precision,
+                                   col.dtype.scale))
+        elif isinstance(col.dtype, T.DateType):
+            arr = pa.array(data.astype(np.int32), type=pa.date32())
+        else:
+            arr = pa.array(data)
+        arrays[cname] = arr
+    os.makedirs(tmp_path / "lineitem")
+    pq.write_table(pa.table(arrays),
+                   str(tmp_path / "lineitem" / "part-0.parquet"),
+                   compression="snappy")
+
+    e = Engine()
+    e.register_catalog("pq", ParquetConnector(str(tmp_path)))
+    e.session.catalog = "pq"
+    got = e.execute(
+        "select sum(l_extendedprice * l_discount) as revenue "
+        "from lineitem where l_shipdate >= date '1994-01-01' "
+        "and l_shipdate < date '1995-01-01' "
+        "and l_discount between 0.05 and 0.07 and l_quantity < 24")
+
+    e2 = Engine()
+    e2.register_catalog("tpch", tpch)
+    e2.session.catalog = "tpch"
+    want = e2.execute(
+        "select sum(l_extendedprice * l_discount) as revenue "
+        "from lineitem where l_shipdate >= date '1994-01-01' "
+        "and l_shipdate < date '1995-01-01' "
+        "and l_discount between 0.05 and 0.07 and l_quantity < 24")
+    assert got == want

@@ -163,3 +163,110 @@ def test_http_transactions_rejected(tpch_tiny):
             c.execute("start transaction")
     finally:
         srv.stop()
+
+
+# ---- warnings + TLS (VERDICT r04 item 10) -----------------------------
+
+
+def test_warning_reaches_protocol_client(tpch_tiny):
+    """A deprecated-syntax warning accumulates during parsing and rides
+    the QueryResults protocol to the client (reference
+    execution/warnings/WarningCollector.java:21 + QueryResults
+    warnings field)."""
+    from presto_tpu import Engine
+    from presto_tpu.client import Client
+    from presto_tpu.server.server import CoordinatorServer
+
+    e = Engine()
+    e.register_catalog("tpch", tpch_tiny)
+    e.session.catalog = "tpch"
+    srv = CoordinatorServer(e).start()
+    try:
+        c = Client(srv.uri)
+        _cols, rows = c.execute(
+            "select count(*) from nation where n_nationkey != 3")
+        assert rows == [[24]]
+        assert any("non-standard" in w["message"] for w in c.warnings)
+        assert c.warnings[0]["warningCode"]["name"] \
+            == "DEPRECATED_SYNTAX"
+        _cols, _rows = c.execute("select count(*) from nation")
+        assert c.warnings == []
+    finally:
+        srv.stop()
+
+
+def test_cross_join_performance_warning(tpch_tiny):
+    from presto_tpu import Engine
+
+    e = Engine()
+    e.register_catalog("tpch", tpch_tiny)
+    e.session.catalog = "tpch"
+    e.execute("select count(*) from nation, region")
+    assert any(w.name == "PERFORMANCE_WARNING"
+               for w in e.last_warnings)
+
+
+def _make_cert(tmp_path):
+    import subprocess
+    cert = str(tmp_path / "cert.pem")
+    key = str(tmp_path / "key.pem")
+    subprocess.run(
+        ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-keyout",
+         key, "-out", cert, "-days", "1", "-nodes", "-subj",
+         "/CN=127.0.0.1", "-addext",
+         "subjectAltName=IP:127.0.0.1"],
+        check=True, capture_output=True)
+    return cert, key
+
+
+def test_coordinator_and_workers_over_tls(tpch_tiny, tmp_path):
+    """The whole cluster — protocol client -> coordinator and
+    coordinator -> worker RPC + exchange fetches — runs over TLS
+    (reference server/security/ServerSecurityModule.java https,
+    InternalCommunicationConfig)."""
+    from presto_tpu import Engine
+    from presto_tpu.client import Client
+    from presto_tpu.parallel.coordinator import ClusterCoordinator
+    from presto_tpu.parallel.worker import WorkerServer
+    from presto_tpu.server import httpbase
+    from presto_tpu.server.server import CoordinatorServer
+
+    cert, key = _make_cert(tmp_path)
+    httpbase.enable_client_tls(cafile=cert)
+    workers = []
+    try:
+        cats = {"tpch": tpch_tiny}
+        workers = [WorkerServer(cats, tls=(cert, key)).start()
+                   for _ in range(2)]
+        assert all(w.uri.startswith("https://") for w in workers)
+        local = Engine()
+        local.register_catalog("tpch", tpch_tiny)
+        local.session.catalog = "tpch"
+        coord = ClusterCoordinator(local)
+        for w in workers:
+            coord.add_worker(w.uri)
+        coord.start()
+        try:
+            sql = ("select c_mktsegment, count(*) from customer, "
+                   "orders where c_custkey = o_custkey "
+                   "group by c_mktsegment order by c_mktsegment")
+            got = coord.execute(sql)
+            local2 = Engine()
+            local2.register_catalog("tpch", tpch_tiny)
+            local2.session.catalog = "tpch"
+            assert got == local2.execute(sql)
+        finally:
+            coord.stop()
+        # protocol surface over https too
+        srv = CoordinatorServer(local, tls=(cert, key)).start()
+        try:
+            assert srv.uri.startswith("https://")
+            c = Client(srv.uri)
+            _cols, rows = c.execute("select count(*) from nation")
+            assert rows == [[25]]
+        finally:
+            srv.stop()
+    finally:
+        httpbase.disable_client_tls()
+        for w in workers:
+            w.stop()
