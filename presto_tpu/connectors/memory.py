@@ -131,3 +131,4 @@ class MemoryConnector(Connector):
     def stats(self, name: str) -> TableStats:
         n = len(next(iter(self._data[name].values()))) if self._data[name] else 0
         return TableStats(row_count=n)
+

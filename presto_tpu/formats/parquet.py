@@ -313,9 +313,14 @@ class ParquetFile:
     """One Parquet file's metadata + column readers."""
 
     def __init__(self, path: str):
+        import mmap
+
         self.path = path
         with open(path, "rb") as f:
-            data = f.read()
+            # map instead of slurping: footer-only operations (schema,
+            # stats, row counts — every plan-time call) touch just the
+            # file tail, and the OS pages data in as chunks decode
+            data = mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_READ)
         if data[:4] != MAGIC or data[-4:] != MAGIC:
             raise ParquetError(f"{path}: not a parquet file")
         footer_len = int.from_bytes(data[-8:-4], "little")
@@ -348,9 +353,48 @@ class ParquetFile:
     def schema(self) -> dict[str, T.DataType]:
         return {c.name: _engine_type(c) for c in self.columns}
 
-    def read_column(self, name: str):
-        """(values np.ndarray, valid bool[n] | None) across all row
-        groups."""
+    def column_stats(self, name: str):
+        """Per-row-group (min, max) for integer-physical columns, or
+        None entries where statistics are absent (footer
+        ColumnMetaData.statistics, fields 5/6 min_value/max_value with
+        the deprecated 1/2 fallback) — the input to row-group pruning
+        (reference parquet predicate/TupleDomainParquetPredicate)."""
+        idx = next((i for i, c in enumerate(self.columns)
+                    if c.name == name), None)
+        if idx is None:
+            raise ParquetError(f"{self.path}: no column {name}")
+        col = self.columns[idx]
+        if col.ptype not in (INT32, INT64):
+            return [None] * len(self.row_groups)
+        # only UNIT-EXACT logical types: the engine compares stats
+        # against physical literals (epoch days, scaled ints), but
+        # TIMESTAMP stats stay in the file's millis/nanos unit while
+        # engine literals are micros — pruning on them would drop
+        # matching row groups
+        et = _engine_type(col)
+        if not isinstance(et, (T.BigintType, T.IntegerType,
+                               T.DateType)):
+            return [None] * len(self.row_groups)
+        width = 4 if col.ptype == INT32 else 8
+        out = []
+        for rg in self.row_groups:
+            st = rg[1][idx][3].get(12)
+            if not st:
+                out.append(None)
+                continue
+            mx = st.get(5, st.get(1))
+            mn = st.get(6, st.get(2))
+            if mn is None or mx is None or len(mn) != width \
+                    or len(mx) != width:
+                out.append(None)
+                continue
+            out.append((int.from_bytes(mn, "little", signed=True),
+                        int.from_bytes(mx, "little", signed=True)))
+        return out
+
+    def read_column(self, name: str, row_groups=None):
+        """(values np.ndarray, valid bool[n] | None) across the
+        selected row groups (None = all)."""
         idx = next((i for i, c in enumerate(self.columns)
                     if c.name == name), None)
         if idx is None:
@@ -359,7 +403,9 @@ class ParquetFile:
         vals_parts = []
         valid_parts = []
         any_null = False
-        for rg in self.row_groups:
+        groups = (self.row_groups if row_groups is None
+                  else [self.row_groups[i] for i in row_groups])
+        for rg in groups:
             chunk = rg[1][idx]
             cmeta = chunk[3]
             vals, valid = self._read_chunk(col, cmeta)

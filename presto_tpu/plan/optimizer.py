@@ -33,6 +33,7 @@ def optimize(plan: N.PlanNode, engine,
     # materialization needs its fd_keys annotations, then re-prunes (the
     # narrowed aggregate source drops dependent columns) and
     # re-annotates (its new re-join gets a dense hint)
+    plan = push_scan_filters(plan, engine)
     plan = annotate_dense(plan, engine)
     enabled = enable_latemat
     if enabled is None:
@@ -234,3 +235,36 @@ def inline_trivial_projects(node: N.PlanNode) -> N.PlanNode:
         if identity and list(rebuilt.assignments) == list(src_syms):
             return rebuilt.source
     return rebuilt
+
+
+def push_scan_filters(plan: N.PlanNode, engine) -> N.PlanNode:
+    """Offer each scan-adjacent filter's conjuncts to the connector
+    (reference PushPredicateIntoTableScan over
+    ConnectorMetadata.applyFilter): a connector that can prove data
+    irrelevant returns a decorated table name selecting the constrained
+    scan (parquet row-group pruning). The filter stays in the plan —
+    pushdown is a superset guarantee, not exact evaluation."""
+    from presto_tpu.connectors.expression import scan_conjuncts
+
+    def visit(node: N.PlanNode) -> N.PlanNode:
+        if not (isinstance(node, N.Filter)
+                and isinstance(node.source, N.TableScan)):
+            return node
+        scan = node.source
+        conn = engine.catalogs.get(scan.catalog)
+        if conn is None:
+            return node
+        conjuncts = scan_conjuncts(node.predicate, scan.assignments)
+        if not conjuncts:
+            return node
+        try:
+            token = conn.apply_filter(scan.table, conjuncts)
+        except Exception:
+            return node
+        if token is None or token == scan.table:
+            return node
+        return dataclasses.replace(
+            node, source=N.TableScan(scan.catalog, token,
+                                     scan.assignments, scan.types))
+
+    return N.rewrite_bottom_up(plan, visit)

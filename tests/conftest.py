@@ -15,12 +15,12 @@ import jax  # noqa: E402
 # this environment; the config API wins.
 jax.config.update("jax_platforms", "cpu")
 
-# The persistent XLA cache is disabled under pytest (the env gate is
-# read by presto_tpu/__init__, imported after this line): XLA's CPU
-# executable serializer segfaults deterministically after ~60
-# serializations in one long-lived process (observed at the 61st
-# compiled program of a full tpcds session; single-query processes and
-# the TPU backend are unaffected).
+# The persistent XLA cache stays DISABLED under pytest: round-5
+# experiments re-enabled it (zlib codec, then serialize-only->=0.5s
+# compiles) and the full suite crashed mid-run both times with a fatal
+# interpreter dump, while isolated 120-serialization probes pass —
+# the crash needs full-suite compile volume in one process. The -n 4
+# worker split in pytest.ini bounds per-process compiles instead.
 os.environ["PRESTO_TPU_XLA_CACHE"] = ""
 
 import pytest  # noqa: E402

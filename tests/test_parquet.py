@@ -175,3 +175,118 @@ def test_tpch_query_from_parquet_files(tmp_path):
         "and l_shipdate < date '1995-01-01' "
         "and l_discount between 0.05 and 0.07 and l_quantity < 24")
     assert got == want
+
+
+def test_row_group_pruning_pushdown(tmp_path):
+    """Filter conjuncts push into the parquet connector as a
+    ConnectorExpression offer; row groups whose min/max statistics
+    exclude the predicate are skipped (reference
+    ConnectorMetadata.applyFilter + TupleDomainParquetPredicate), and
+    the full filter above the scan keeps results exact."""
+    import pyarrow as _pa
+    import pyarrow.parquet as _pq
+
+    n = 100_000
+    tbl = _pa.table({
+        "k": _pa.array(np.arange(n, dtype=np.int64)),  # sorted: tight
+        "v": _pa.array(np.arange(n, dtype=np.int64) * 3),
+    })
+    _pq.write_table(tbl, str(tmp_path / "t.parquet"),
+                    compression="none", row_group_size=10_000)
+
+    conn = ParquetConnector(str(tmp_path))
+    from presto_tpu.connectors.expression import (ColumnExpr,
+                                                  ComparisonExpr,
+                                                  ConstantExpr)
+    token = conn.apply_filter("t", [
+        ComparisonExpr(">", ColumnExpr("k"), ConstantExpr(95_000))])
+    assert token is not None and "#rg:" in token
+    # 10 groups of 10k; only the last can contain k > 95000
+    assert conn.row_count_estimate(token) == 10_000
+
+    e = Engine()
+    e.register_catalog("pq", conn)
+    e.session.catalog = "pq"
+    rows = e.execute("select count(*), sum(v) from t where k > 95000")
+    want = sum(range(95_001, n))
+    assert rows[0][0] == n - 95_001
+    assert rows[0][1] == want * 3
+    # the optimizer actually pushed the constraint into the scan
+    plan, _ = e.plan_sql("select count(*) from t where k > 95000")
+    from presto_tpu.plan import nodes as N
+
+    def scans(node):
+        if isinstance(node, N.TableScan):
+            yield node
+        for s in node.sources():
+            yield from scans(s)
+    names = [s.table for s in scans(plan)]
+    assert any("#rg:" in t for t in names), names
+
+
+def test_page_sink_ctas_and_insert(tpch_tiny):
+    """CTAS/INSERT stream through the connector PageSink abstraction
+    (reference spi/connector/ConnectorPageSink.java:22): a NATIVE sink
+    receives real pages; atomic commit on finish."""
+    from presto_tpu import Engine
+    from presto_tpu import engine as E
+    from presto_tpu.connectors.base import PageSink
+    from presto_tpu.connectors.memory import MemoryConnector
+
+    pages_seen = []
+
+    class SinkingMemory(MemoryConnector):
+        def begin_write(self, name, schema=None):
+            conn = self
+
+            class CountingSink(PageSink):
+                def __init__(self):
+                    self.rows = 0
+                    self.data: list = []
+
+                def append_page(self, data, valid):
+                    pages_seen.append(
+                        len(next(iter(data.values()), [])))
+                    self.data.append((dict(data), dict(valid)))
+                    self.rows += pages_seen[-1]
+
+                def finish(self):
+                    cols = list(self.data[0][0])
+                    merged = {c: np.concatenate(
+                        [np.asarray(p[0][c]) for p in self.data])
+                        for c in cols}
+                    vall = {c: None for c in cols}
+                    if schema is not None:
+                        conn.create_table(name, schema, merged, vall)
+                    else:
+                        conn.insert(name, merged, vall)
+                    return self.rows
+
+            return CountingSink()
+
+    e = Engine()
+    e.register_catalog("tpch", tpch_tiny)
+    mem = SinkingMemory()
+    e.register_catalog("mem", mem)
+    e.session.catalog = "tpch"
+    saved = E.WRITE_PAGE_ROWS
+    E.WRITE_PAGE_ROWS = 1000  # force multiple pages
+    try:
+        out = e.execute("create table mem.li2 as "
+                        "select l_orderkey, l_quantity from lineitem")
+        nrows = out[0][0]
+        assert len(pages_seen) > 5 and sum(pages_seen) == nrows
+        got = e.execute("select count(*), sum(l_quantity) "
+                        "from mem.li2")
+        want = e.execute("select count(*), sum(l_quantity) "
+                         "from lineitem")
+        assert got == want
+        e.execute("insert into mem.li2 "
+                  "select l_orderkey, l_quantity from lineitem "
+                  "where l_orderkey < 100")
+        got2 = e.execute("select count(*) from mem.li2")
+        extra = e.execute("select count(*) from lineitem "
+                          "where l_orderkey < 100")
+        assert got2[0][0] == nrows + extra[0][0]
+    finally:
+        E.WRITE_PAGE_ROWS = saved
