@@ -31,6 +31,16 @@ from presto_tpu.ops.hash import next_pow2
 from presto_tpu.plan import nodes as N
 
 
+# dispatch-exhaustiveness opt-outs (lint/dispatch.py): node types the
+# PlanInterpreter deliberately has no _r_ handler for
+DISPATCH_EXEMPT = {
+    "MatchRecognize": "execute_plan splits the plan at the "
+    "MatchRecognize node before interpretation (host-side NFA, see "
+    "_execute_with_match_recognize); a node reaching the interpreter "
+    "fails loudly in run()",
+}
+
+
 @dataclasses.dataclass
 class ScanInput:
     """Host-side arrays + metadata for one TableScan."""

@@ -1,0 +1,31 @@
+"""Engine-specific static analysis (stdlib ``ast`` only).
+
+Three rule families guard the places where this engine's bugs ship
+silently (the reference defends the last with its PlanSanityChecker
+pipeline, sql/planner/sanity/PlanSanityChecker.java):
+
+- **tracer hygiene** (``lint/tracer.py``): inside ``@jax.jit``-reachable
+  functions, Python-level inspection of traced values either crashes at
+  trace time on a rarely-hit path or silently forces a retrace per call.
+- **lock discipline** (``lint/locks.py``): an attribute written under
+  ``with self._lock`` in one method and read bare in another is a latent
+  race that only fires under load.
+- **dispatch exhaustiveness** (``lint/dispatch.py``): a new ``PlanNode``
+  subclass that one of the visitors (serde, printer, sanity,
+  fingerprint, executor) forgets fails only on the query shape that
+  reaches it.
+
+Run ``python -m presto_tpu.lint presto_tpu/`` (exits nonzero on
+findings); suppress a single line with ``# lint: disable=rule-name``
+plus a comment saying why.
+"""
+
+from presto_tpu.lint.core import (Finding, Project, available_rules,
+                                  run_lint)
+
+# rule modules self-register on import
+from presto_tpu.lint import tracer as _tracer  # noqa: E402,F401
+from presto_tpu.lint import locks as _locks  # noqa: E402,F401
+from presto_tpu.lint import dispatch as _dispatch  # noqa: E402,F401
+
+__all__ = ["Finding", "Project", "available_rules", "run_lint"]

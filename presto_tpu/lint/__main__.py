@@ -1,0 +1,51 @@
+"""CLI: ``python -m presto_tpu.lint [paths...] [--json] [--rules ...]``.
+
+Exits 0 when clean, 1 when there are unsuppressed findings, 2 on usage
+errors — so the lint can gate CI the way the tier-1 tests do.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from presto_tpu.lint import available_rules, run_lint
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m presto_tpu.lint",
+        description="Engine-specific static analysis: tracer hygiene, "
+                    "lock discipline, plan-dispatch exhaustiveness.")
+    parser.add_argument("paths", nargs="*", default=["presto_tpu"],
+                        help="files or directories to analyze "
+                             "(default: presto_tpu)")
+    parser.add_argument("--rules", default=None,
+                        help="comma-separated rule subset "
+                             f"(available: {', '.join(available_rules())})")
+    parser.add_argument("--json", action="store_true", dest="as_json",
+                        help="machine-readable JSON findings on stdout")
+    args = parser.parse_args(argv)
+
+    rules = None
+    if args.rules:
+        rules = [r.strip() for r in args.rules.split(",") if r.strip()]
+    try:
+        findings = run_lint(args.paths, rules)
+    except ValueError as e:
+        print(str(e), file=sys.stderr)
+        return 2
+
+    if args.as_json:
+        print(json.dumps([f.to_dict() for f in findings], indent=2))
+    else:
+        for f in findings:
+            print(f.format())
+        if findings:
+            print(f"\n{len(findings)} finding(s)", file=sys.stderr)
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

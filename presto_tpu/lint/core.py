@@ -1,0 +1,239 @@
+"""Analyzer core: source loading, rule registry, suppressions.
+
+The unit of analysis is a :class:`Project` — every ``.py`` file under
+the paths given to the CLI, parsed once. Rules are functions from a
+Project to findings, registered by name; per-line suppressions
+(``# lint: disable=rule-name`` on the offending line) are honored
+centrally so every rule gets them for free.
+
+Paths are normalized to package-relative form (``presto_tpu/...``), so
+rule scopes (which directories a family applies to) match no matter
+where the analyzed tree lives — the test suite exercises rules on
+synthetic packages in temp directories this way.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+import tokenize
+from pathlib import Path
+from typing import Callable, Iterable
+
+PACKAGE = "presto_tpu"
+
+# ``# lint: disable=rule-a,rule-b`` or ``# lint: disable`` (every rule)
+_SUPPRESS_RE = re.compile(
+    r"#\s*lint:\s*disable(?:=([A-Za-z0-9_,\- ]+))?")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str  # package-relative, e.g. "presto_tpu/exec/executor.py"
+    line: int
+    col: int
+    message: str
+
+    def format(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: " \
+               f"[{self.rule}] {self.message}"
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class SourceModule:
+    """One parsed source file plus its suppression table."""
+
+    def __init__(self, path: Path, relpath: str, text: str):
+        self.path = path
+        self.relpath = relpath
+        self.text = text
+        self.tree = ast.parse(text, filename=str(path))
+        # line -> set of suppressed rule names, or None meaning all
+        self.suppressions: dict[int, set[str] | None] = {}
+        self._scan_suppressions(text)
+
+    @property
+    def modname(self) -> str:
+        return self.relpath[:-3].replace("/", ".")
+
+    def _scan_suppressions(self, text: str) -> None:
+        # tokenize (not line regex) so a '# lint: disable' inside a
+        # string literal is not treated as a suppression
+        import io
+        if "lint:" not in text:  # tokenizing every file is ~1/3 of
+            return                # total runtime; most have nothing
+        try:
+            tokens = tokenize.generate_tokens(io.StringIO(text).readline)
+            for tok in tokens:
+                if tok.type != tokenize.COMMENT:
+                    continue
+                m = _SUPPRESS_RE.search(tok.string)
+                if not m:
+                    continue
+                names = m.group(1)
+                if names is None:
+                    self.suppressions[tok.start[0]] = None
+                else:
+                    cur = self.suppressions.setdefault(tok.start[0],
+                                                       set())
+                    if cur is not None:
+                        cur.update(n.strip() for n in names.split(",")
+                                   if n.strip())
+        except tokenize.TokenError:
+            pass
+
+    def suppressed(self, line: int, rule: str) -> bool:
+        rules = self.suppressions.get(line, ...)
+        if rules is ...:
+            return False
+        return rules is None or rule in rules
+
+
+def _relpath(path: Path) -> str:
+    """Path from the last ``presto_tpu`` component down (how rule
+    scopes are expressed); falls back to the bare filename."""
+    parts = path.parts
+    for i in range(len(parts) - 1, -1, -1):
+        if parts[i] == PACKAGE:
+            return "/".join(parts[i:])
+    return path.name
+
+
+class Project:
+    """Parsed modules for one lint run."""
+
+    def __init__(self, modules: list[SourceModule]):
+        self.modules = modules
+        self.by_relpath = {m.relpath: m for m in modules}
+
+    @classmethod
+    def load(cls, paths: Iterable[str | Path]) -> "Project":
+        files: list[Path] = []
+        for p in paths:
+            p = Path(p)
+            if p.is_dir():
+                files.extend(sorted(p.rglob("*.py")))
+            elif p.suffix == ".py":
+                files.append(p)
+        modules = []
+        seen = set()
+        for f in files:
+            key = f.resolve()
+            if key in seen:
+                continue
+            seen.add(key)
+            try:
+                text = f.read_text(encoding="utf-8")
+                modules.append(SourceModule(f, _relpath(f), text))
+            except (SyntaxError, UnicodeDecodeError) as e:
+                # surface as a usage error (CLI exit 2), not a
+                # traceback a CI gate would misread as findings
+                raise ValueError(f"cannot parse {f}: {e}") from e
+        return cls(modules)
+
+    def in_scope(self, scopes: tuple[str, ...]) -> list[SourceModule]:
+        """Modules whose relpath starts with any of ``scopes`` (a
+        trailing '/' scopes a directory, otherwise an exact file)."""
+        out = []
+        for m in self.modules:
+            for s in scopes:
+                if (m.relpath.startswith(s) if s.endswith("/")
+                        else m.relpath == s):
+                    out.append(m)
+                    break
+        return out
+
+
+RuleFn = Callable[[Project], list[Finding]]
+_RULES: dict[str, RuleFn] = {}
+
+
+def rule(name: str) -> Callable[[RuleFn], RuleFn]:
+    def deco(fn: RuleFn) -> RuleFn:
+        _RULES[name] = fn
+        return fn
+    return deco
+
+
+def available_rules() -> list[str]:
+    return sorted(_RULES)
+
+
+def run_lint(paths: Iterable[str | Path],
+             rules: Iterable[str] | None = None) -> list[Finding]:
+    """Run the selected rules (default: all) over ``paths``; returns
+    unsuppressed findings sorted by location."""
+    import presto_tpu.lint  # noqa: F401 - ensure rules registered
+    paths = list(paths)
+    missing = [str(p) for p in paths if not Path(p).exists()]
+    if missing:
+        raise ValueError(f"paths do not exist: {missing}")
+    project = Project.load(paths)
+    if not project.modules:
+        # a typo'd path must not read as "lint clean"
+        raise ValueError(
+            f"no Python files found under {[str(p) for p in paths]}")
+    selected = list(rules) if rules is not None else available_rules()
+    unknown = [r for r in selected if r not in _RULES]
+    if unknown:
+        raise ValueError(f"unknown lint rules: {unknown} "
+                         f"(available: {available_rules()})")
+    findings: list[Finding] = []
+    for name in selected:
+        for f in _RULES[name](project):
+            mod = project.by_relpath.get(f.path)
+            if mod is not None and mod.suppressed(f.line, f.rule):
+                continue
+            findings.append(f)
+    return sorted(findings, key=lambda f: (f.path, f.line, f.col,
+                                           f.rule))
+
+
+# -- shared AST helpers used by the rule modules ---------------------------
+
+def qual_name(node: ast.AST) -> str | None:
+    """Dotted name of a Name/Attribute chain ('jax.lax.scan'), else
+    None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def walk_functions(tree: ast.AST):
+    """Yield (qualpath, FunctionDef) for every function in a module,
+    including methods and nested functions. ``qualpath`` is a tuple of
+    enclosing class/function names."""
+    def visit(node, path):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef,
+                                  ast.AsyncFunctionDef)):
+                yield path + (child.name,), child
+                yield from visit(child, path + (child.name,))
+            elif isinstance(child, ast.ClassDef):
+                yield from visit(child, path + (child.name,))
+            else:
+                yield from visit(child, path)
+    yield from visit(tree, ())
+
+
+def import_aliases(tree: ast.AST) -> dict[str, str]:
+    """Local name -> imported dotted module/object path."""
+    out: dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                out[a.asname or a.name.split(".")[0]] = (
+                    a.name if a.asname else a.name.split(".")[0])
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            for a in node.names:
+                out[a.asname or a.name] = f"{node.module}.{a.name}"
+    return out

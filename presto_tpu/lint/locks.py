@@ -1,0 +1,385 @@
+"""Lock-discipline rule: attributes guarded somewhere, bare elsewhere.
+
+For every class in the threaded subsystems (``parallel/``, ``server/``,
+``memory.py``), infer which instance attributes the class itself treats
+as lock-guarded — written at least once inside ``with <lock>:`` (any
+context manager whose name looks like a lock: ``self._lock``,
+``mgr.lock``, ``self._cv``, ...) outside ``__init__`` — then report
+every read or write of those attributes on a path that does not hold a
+lock. The analysis is interprocedural within a module: a private helper
+whose every observed call site holds the lock is treated as lock-held
+(the reference encodes the same contract as "(manager lock held)"
+comments on InternalResourceGroup helpers; here it is checked).
+
+Approximations, chosen so the rule stays enforceable at zero findings:
+
+- Any lock of the class counts; which lock guards which attribute is
+  not tracked (single-lock classes dominate this codebase).
+- ``x = self`` aliases (including the ``outer = self`` closure pattern
+  around nested handler classes) are followed; attributes reached
+  through other objects are not.
+- ``__init__`` straight-line code is construction (single-threaded) and
+  is exempt, but functions/classes *nested* inside it run on other
+  threads and are analyzed.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+
+from presto_tpu.lint.core import (Finding, Project, SourceModule, rule)
+
+LOCK_SCOPES = (
+    "presto_tpu/parallel/",
+    "presto_tpu/server/",
+    "presto_tpu/memory.py",
+)
+
+_LOCK_NAME_RE = re.compile(
+    r"(lock|mutex)$|^_?(cv|cond|condition)$", re.IGNORECASE)
+
+# method calls that mutate their receiver
+_MUTATORS = {"append", "extend", "insert", "remove", "pop", "popitem",
+             "clear", "update", "add", "discard", "setdefault",
+             "appendleft", "extendleft"}
+
+
+def _is_lock_expr(node: ast.AST) -> bool:
+    """Does a with-item context expression look like a lock?"""
+    for sub in ast.walk(node):
+        name = None
+        if isinstance(sub, ast.Attribute):
+            name = sub.attr
+        elif isinstance(sub, ast.Name):
+            name = sub.id
+        if name is not None and _LOCK_NAME_RE.search(name):
+            return True
+    return False
+
+
+@dataclasses.dataclass
+class _Access:
+    attr: str
+    is_write: bool
+    locked: bool  # lexically, at the access site
+    unit: "_Unit"
+    line: int
+    col: int
+
+
+@dataclasses.dataclass
+class _CallSite:
+    callee: str  # bare method name
+    locked: bool  # lexically
+    unit: "_Unit"
+
+
+class _Unit:
+    """One function body analyzed for a class: a method, or a
+    function/method nested inside a method (which runs later, possibly
+    on another thread)."""
+
+    def __init__(self, cls_name: str, name: str, node: ast.AST,
+                 self_names: set[str], is_init_body: bool,
+                 is_method: bool):
+        self.cls_name = cls_name
+        self.name = name
+        self.node = node
+        self.self_names = self_names
+        self.is_init_body = is_init_body  # construction: exempt
+        self.is_method = is_method  # direct methods can be "locked by
+        #                             caller"; nested thread bodies not
+        self.accesses: list[_Access] = []
+        self.call_sites: list[_CallSite] = []
+
+
+def _root_self_attr(node: ast.AST, self_names: set[str]) -> str | None:
+    """The attribute name when ``node`` bottoms out at
+    ``<self>.<attr>[...]...``; None otherwise."""
+    while isinstance(node, ast.Subscript):
+        node = node.value
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and \
+            node.value.id in self_names:
+        return node.attr
+    return None
+
+
+class _UnitVisitor(ast.NodeVisitor):
+    def __init__(self, unit: _Unit, collector: "_ClassAnalysis"):
+        self.unit = unit
+        self.collector = collector
+        self.lock_depth = 0
+        # attribute nodes already recorded as writes/mutations, so the
+        # generic visit_Attribute pass doesn't double-report them
+        self._claimed: set[int] = set()
+
+    @property
+    def locked(self) -> bool:
+        return self.lock_depth > 0
+
+    def _record(self, attr: str, is_write: bool, node: ast.AST) -> None:
+        self.unit.accesses.append(_Access(
+            attr, is_write, self.locked, self.unit,
+            node.lineno, node.col_offset))
+
+    # -- structure ---------------------------------------------------------
+
+    def visit_With(self, node: ast.With) -> None:
+        is_lock = any(_is_lock_expr(i.context_expr) for i in node.items)
+        for i in node.items:
+            self.visit(i.context_expr)
+        if is_lock:
+            self.lock_depth += 1
+        for stmt in node.body:
+            self.visit(stmt)
+        if is_lock:
+            self.lock_depth -= 1
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self.collector.add_nested(self.unit, node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        for stmt in node.body:
+            if isinstance(stmt, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef)):
+                self.collector.add_nested(self.unit, stmt)
+
+    # -- accesses ----------------------------------------------------------
+
+    def _claim_write_targets(self, target: ast.AST) -> None:
+        attr = _root_self_attr(target, self.unit.self_names)
+        if attr is not None:
+            self._record(attr, True, target)
+            for sub in ast.walk(target):
+                self._claimed.add(id(sub))
+        else:
+            # tuple targets etc.
+            for child in ast.iter_child_nodes(target):
+                if isinstance(child, (ast.Tuple, ast.List,
+                                      ast.Starred, ast.Attribute,
+                                      ast.Subscript)):
+                    self._claim_write_targets(child)
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        for t in node.targets:
+            self._claim_write_targets(t)
+            # ``alias = self`` inside a unit extends the alias set
+            if isinstance(t, ast.Name) and \
+                    isinstance(node.value, ast.Name) and \
+                    node.value.id in self.unit.self_names:
+                self.unit.self_names.add(t.id)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
+        self._claim_write_targets(node.target)
+        if isinstance(node.target, ast.Name) and \
+                isinstance(node.value, ast.Name) and \
+                node.value.id in self.unit.self_names:
+            self.unit.self_names.add(node.target.id)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        self._claim_write_targets(node.target)
+        self.generic_visit(node)
+
+    def visit_Delete(self, node: ast.Delete) -> None:
+        for t in node.targets:
+            self._claim_write_targets(t)
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if isinstance(node.func, ast.Attribute):
+            if node.func.attr in _MUTATORS:
+                attr = _root_self_attr(node.func.value,
+                                       self.unit.self_names)
+                if attr is not None:
+                    self._record(attr, True, node)
+                    for sub in ast.walk(node.func.value):
+                        self._claimed.add(id(sub))
+            self.unit.call_sites.append(_CallSite(
+                node.func.attr, self.locked, self.unit))
+        elif isinstance(node.func, ast.Name):
+            self.unit.call_sites.append(_CallSite(
+                node.func.id, self.locked, self.unit))
+        self.generic_visit(node)
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        if id(node) not in self._claimed and \
+                isinstance(node.value, ast.Name) and \
+                node.value.id in self.unit.self_names:
+            self._record(node.attr, False, node)
+        self.generic_visit(node)
+
+
+class _ClassAnalysis:
+    def __init__(self, mod: SourceModule, cls: ast.ClassDef):
+        self.mod = mod
+        self.cls = cls
+        self.units: list[_Unit] = []
+
+    def add_nested(self, parent: _Unit,
+                   node: ast.FunctionDef) -> None:
+        """Nested function (thread body, callback) or nested-class
+        method: inherits the parent's self/alias names minus any the
+        nested signature shadows — which is also what strips a nested
+        class's own ``self``, since that is NOT the outer instance."""
+        params = {a.arg for a in node.args.posonlyargs
+                  + node.args.args + node.args.kwonlyargs}
+        self_names = set(parent.self_names) - params
+        unit = _Unit(parent.cls_name, node.name, node, self_names,
+                     is_init_body=False, is_method=False)
+        self.units.append(unit)
+        self._visit_unit(unit)
+
+    def _visit_unit(self, unit: _Unit) -> None:
+        v = _UnitVisitor(unit, self)
+        for stmt in unit.node.body:
+            v.visit(stmt)
+
+    def run(self) -> None:
+        # class-wide alias names: any ``name = self`` in any method
+        aliases: set[str] = set()
+        for stmt in self.cls.body:
+            if isinstance(stmt, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef)):
+                args = stmt.args.posonlyargs + stmt.args.args
+                if not args:
+                    continue
+                selfname = args[0].arg
+                for sub in ast.walk(stmt):
+                    if isinstance(sub, ast.Assign) and \
+                            isinstance(sub.value, ast.Name) and \
+                            sub.value.id == selfname:
+                        for t in sub.targets:
+                            if isinstance(t, ast.Name):
+                                aliases.add(t.id)
+        for stmt in self.cls.body:
+            if not isinstance(stmt, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            args = stmt.args.posonlyargs + stmt.args.args
+            if not args:
+                continue
+            self_names = {args[0].arg} | aliases
+            unit = _Unit(self.cls.name, stmt.name, stmt, self_names,
+                         is_init_body=(stmt.name == "__init__"),
+                         is_method=True)
+            self.units.append(unit)
+            self._visit_unit(unit)
+
+
+def _locked_methods(all_units: list[_Unit]) -> set[tuple[str, str]]:
+    """Least-fixpoint set of (class, method) treated as lock-held:
+    a method joins only once every observed external call site (by
+    bare name, within the module) provably holds a lock — lexically or
+    by sitting in an already-lock-held method.
+
+    Only private methods (leading underscore) qualify — a public method
+    is an API entry point and must take its own lock — and a method
+    needs at least one call site outside its own body (pure
+    self-recursion must not vouch for itself).
+
+    Call sites match by bare name; to avoid pooling same-named methods
+    of unrelated classes, a site only counts toward (cls, name) when it
+    sits in a method of ``cls`` itself (covers self/peer-instance
+    receivers) or when exactly one class in the module defines ``name``
+    (unambiguous cross-class calls, e.g. a manager walking its node
+    tree under the shared lock)."""
+    sites_by_name: dict[str, list[_CallSite]] = {}
+    for u in all_units:
+        for cs in u.call_sites:
+            sites_by_name.setdefault(cs.callee, []).append(cs)
+    defined_in: dict[str, set[str]] = {}
+    for u in all_units:
+        if u.is_method:
+            defined_in.setdefault(u.name, set()).add(u.cls_name)
+
+    def relevant_sites(cls: str, name: str) -> list[_CallSite]:
+        unambiguous = len(defined_in.get(name, ())) == 1
+        return [cs for cs in sites_by_name.get(name, [])
+                if cs.unit.cls_name == cls or unambiguous]
+
+    method_unit = {(u.cls_name, u.name): u for u in all_units
+                   if u.is_method}
+    candidates = {key for key, u in method_unit.items()
+                  if u.name != "__init__" and u.name.startswith("_")
+                  and not u.name.startswith("__")
+                  and any(cs.unit is not u
+                          for cs in relevant_sites(*key))}
+    # LEAST fixpoint, seeded from lexically-locked call sites: a method
+    # joins only once every external call site provably holds the lock.
+    # (A greatest fixpoint would let mutually-recursive helpers — e.g.
+    # a thread body referenced via Thread(target=self._loop), so the
+    # only observed calls are inside the cycle — vouch for each other
+    # and silently suppress real races.) Call sites inside the method
+    # itself are ignored: self-recursion preserves whatever lock state
+    # the external entries established.
+    locked: set[tuple[str, str]] = set()
+    changed = True
+    while changed:
+        changed = False
+        for key in candidates - locked:
+            own = method_unit[key]
+            external = [cs for cs in relevant_sites(*key)
+                        if cs.unit is not own]
+            if external and all(
+                    cs.locked or (cs.unit.is_method
+                                  and (cs.unit.cls_name,
+                                       cs.unit.name) in locked)
+                    for cs in external):
+                locked.add(key)
+                changed = True
+    return locked
+
+
+@rule("lock-discipline")
+def lock_discipline(project: Project) -> list[Finding]:
+    findings: list[Finding] = []
+    for mod in project.in_scope(LOCK_SCOPES):
+        analyses: list[_ClassAnalysis] = []
+        for node in mod.tree.body:
+            if isinstance(node, ast.ClassDef):
+                a = _ClassAnalysis(mod, node)
+                a.run()
+                analyses.append(a)
+        all_units = [u for a in analyses for u in a.units]
+        locked = _locked_methods(all_units)
+
+        def unit_locked(u: _Unit) -> bool:
+            return u.is_method and (u.cls_name, u.name) in locked
+
+        for a in analyses:
+            guarded: dict[str, int] = {}  # attr -> a guarded-write line
+            for u in a.units:
+                if u.is_init_body:
+                    continue
+                for acc in u.accesses:
+                    if acc.is_write and \
+                            (acc.locked or unit_locked(u)) and \
+                            not _LOCK_NAME_RE.search(acc.attr):
+                        guarded.setdefault(acc.attr, acc.line)
+            if not guarded:
+                continue
+            for u in a.units:
+                if u.is_init_body:
+                    continue
+                if unit_locked(u):
+                    continue
+                for acc in u.accesses:
+                    if acc.locked or acc.attr not in guarded:
+                        continue
+                    kind = "written" if acc.is_write else "read"
+                    findings.append(Finding(
+                        "lock-discipline", mod.relpath, acc.line,
+                        acc.col,
+                        f"{a.cls.name}.{acc.attr} is {kind} without "
+                        f"the lock in `{u.name}` but written under it "
+                        f"elsewhere (e.g. line {guarded[acc.attr]}); "
+                        "either lock this path or document the "
+                        "invariant and suppress"))
+    return findings

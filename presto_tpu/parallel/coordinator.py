@@ -68,7 +68,10 @@ class RemoteWorker:
 
     @property
     def alive(self) -> bool:
-        return self.failure_ratio < self.THRESHOLD
+        # the heartbeat thread writes failure_ratio concurrently with
+        # scheduling reads; take the same lock record() publishes under
+        with self.lock:
+            return self.failure_ratio < self.THRESHOLD
 
     def post_task(self, payload: dict, timeout: float = 300.0) -> dict:
         out = self.post_task_any(payload, timeout)

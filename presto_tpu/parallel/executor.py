@@ -33,6 +33,19 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
+try:  # new jax: top-level API
+    _shard_map = jax.shard_map
+except AttributeError:  # 0.4.x: experimental namespace
+    from jax.experimental.shard_map import shard_map as _shard_map
+# the replication-check kwarg was renamed check_rep -> check_vma
+# independently of the namespace move; detect it from the signature
+import inspect as _inspect
+
+_SHARD_MAP_NOCHECK = (
+    {"check_vma": False}
+    if "check_vma" in _inspect.signature(_shard_map).parameters
+    else {"check_rep": False})
+
 from presto_tpu import types as T
 from presto_tpu.block import Column, Table
 from presto_tpu.exec import operators as OP
@@ -738,11 +751,11 @@ def execute_plan_distributed(engine, plan: N.PlanNode,
                     tuple(interp.ok_flags), counts)
 
         n_out = None  # resolved after trace
-        sharded = jax.shard_map(
+        sharded = _shard_map(
             traced_fn, mesh=mesh,
             in_specs=tuple(P(AXIS) for _ in flat_arrays),
             out_specs=(P(), P(), P(), P()),
-            check_vma=False)
+            **_SHARD_MAP_NOCHECK)
         t0 = _time.perf_counter()
         lowered = jax.jit(sharded).lower(*flat_arrays)
         compiled = lowered.compile()

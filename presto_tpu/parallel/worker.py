@@ -355,8 +355,12 @@ class WorkerServer(HttpService):
                     return
                 parts = self.path.strip("/").split("/")
                 if self.path == "/v1/status":
-                    pools = [e.memory_pool.info()
-                             for e in outer._engines.values()]
+                    # snapshot under the lock engine_factory inserts
+                    # under: a status poll racing a task POST must not
+                    # iterate a mutating dict
+                    with outer._lock:
+                        engines = list(outer._engines.values())
+                    pools = [e.memory_pool.info() for e in engines]
                     self._send_json({
                         "nodeId": outer.node_id, "state": "active",
                         "memory": {

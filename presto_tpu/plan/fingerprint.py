@@ -22,6 +22,13 @@ import hashlib
 
 import numpy as np
 
+# This dispatch site is total over plan-node types by construction:
+# _tok walks dataclasses.fields() generically, so a new PlanNode
+# subclass fingerprints without registration. The lint's
+# dispatch-exhaustiveness rule (lint/dispatch.py) verifies this claim
+# mechanically instead of asking for per-node cases.
+GENERIC_PLAN_DISPATCH = True
+
 
 def plan_fingerprint(plan) -> str:
     h = hashlib.blake2b(digest_size=16)

@@ -69,6 +69,17 @@ def _describe(node: N.PlanNode) -> str:
                 f"first({', '.join(node.keys)})]")
     if isinstance(node, N.Union):
         return f"Union[{len(node.inputs)} inputs] => {node.symbols}"
+    if isinstance(node, N.Unnest):
+        ords = (f", ordinality={node.ordinality_sym}"
+                if node.ordinality_sym else "")
+        pairs = ", ".join(f"{o} := {a}" for a, o in
+                          zip(node.array_syms, node.out_syms))
+        return f"Unnest[{pairs}{ords}]"
+    if isinstance(node, N.MatchRecognize):
+        meas = ", ".join(m[0] for m in node.measures)
+        return (f"MatchRecognize[partition={node.partition_by}, "
+                f"order={_orderings(node.orderings)}, "
+                f"defines={sorted(node.defines)}] => [{meas}]")
     if isinstance(node, N.Exchange):
         return f"Exchange[{node.kind.value}]({node.partition_keys})"
     if isinstance(node, N.Output):

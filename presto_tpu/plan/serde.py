@@ -17,6 +17,7 @@ from presto_tpu import types as T
 from presto_tpu.expr import ir
 from presto_tpu.expr.aggregates import AggCall
 from presto_tpu.plan import nodes as N
+from presto_tpu.sql import ast as A
 
 VERSION = 1
 
@@ -32,9 +33,12 @@ _register(
     # plan nodes
     N.TableScan, N.Values, N.Filter, N.Project, N.Aggregate, N.Join,
     N.SemiJoin, N.CrossJoin, N.Union, N.Unnest, N.Sort, N.TopN, N.Limit,
-    N.Distinct, N.MarkDistinct, N.Window, N.Exchange, N.Output,
+    N.Distinct, N.MarkDistinct, N.Window, N.MatchRecognize, N.Exchange,
+    N.Output,
     # plan helpers
     N.Ordering, N.WindowCall, AggCall,
+    # the parsed row-pattern AST a MatchRecognize node carries
+    A.PatVar, A.PatConcat, A.PatAlt, A.PatQuant,
     # expressions
     ir.ColumnRef, ir.Literal, ir.Call, ir.Cast, ir.CaseWhen, ir.InList,
     ir.IsNull,

@@ -73,7 +73,7 @@ class InternalResourceGroup:
 
     # -- tree helpers (manager lock held) -----------------------------------
 
-    def can_run(self) -> bool:
+    def _can_run(self) -> bool:
         g: InternalResourceGroup | None = self
         while g is not None:
             if g.running >= g.spec.hard_concurrency_limit:
@@ -129,12 +129,6 @@ class InternalResourceGroup:
             return (0, -item.priority, item.seq)
         return (0, 0, item.seq)  # fair: global FIFO age
 
-    def _remove_queued(self, item: _Queued) -> bool:
-        if item in self.queued:
-            self.queued.remove(item)
-            return True
-        return any(c._remove_queued(item) for c in self.children)
-
     def _owner_of(self, item: _Queued) -> "InternalResourceGroup | None":
         if item in self.queued:
             return self
@@ -145,6 +139,12 @@ class InternalResourceGroup:
         return None
 
     def info(self) -> dict:
+        """Public snapshot: takes the manager lock (the counters are
+        written by dispatcher threads under it)."""
+        with self._manager.lock:
+            return self._info()
+
+    def _info(self) -> dict:
         out = {
             "name": self.spec.name,
             "hardConcurrencyLimit": self.spec.hard_concurrency_limit,
@@ -156,7 +156,7 @@ class InternalResourceGroup:
             "totalAdmitted": self.total_admitted,
         }
         if self.children:
-            out["subGroups"] = [c.info() for c in self.children]
+            out["subGroups"] = [c._info() for c in self.children]
         return out
 
     # -- public API used by the dispatcher ----------------------------------
@@ -166,7 +166,7 @@ class InternalResourceGroup:
                priority: int = 0) -> str:
         mgr = self._manager
         with mgr.lock:
-            if self.can_run():
+            if self._can_run():
                 self._inc_running()
                 self.total_admitted += 1
                 run_now = True
@@ -260,4 +260,5 @@ class ResourceGroupManager:
             f"no resource group selector matches user '{user}'")
 
     def info(self) -> list[dict]:
-        return [c.info() for c in self.root.children]
+        with self.lock:
+            return [c._info() for c in self.root.children]

@@ -142,13 +142,30 @@ class QueryManager:
             def start():
                 self.pool.submit(self._run, q, group)
 
-            self._tickets[qid] = (group, start)
+            with self.lock:
+                self._tickets[qid] = (group, start)
             group.submit(start)
+            # cancel() may have run any time after queries[qid] became
+            # visible (listings snapshot it immediately): a cancel that
+            # lands before the group admission above scanned an empty
+            # queue, so the dead entry would sit in a max_queued slot —
+            # forever under a saturated group. Retract on CANCELED
+            # state alone and drop the ticket we may have re-published
+            # over the cancel's pop.
+            with self.lock:
+                retract = q.state == "CANCELED"
+                if retract:
+                    self._tickets.pop(qid, None)
+            if retract:
+                group.cancel_queued(start)
         except (QueryQueueFullError, NoMatchingGroupError) as e:
-            q.error = str(e)
-            q.state = "FAILED"
-            q.finished = time.monotonic()
-            self._tickets.pop(qid, None)
+            with self.lock:
+                # a concurrent cancel() may have won: CANCELED sticks
+                if q.state != "CANCELED":
+                    q.error = str(e)
+                    q.state = "FAILED"
+                q.finished = time.monotonic()
+                self._tickets.pop(qid, None)
         return q
 
     def _run(self, q: QueryInfo, group) -> None:
@@ -176,7 +193,8 @@ class QueryManager:
             finally:
                 q.finished = time.monotonic()
         finally:
-            self._tickets.pop(q.query_id, None)
+            with self.lock:
+                self._tickets.pop(q.query_id, None)
             group.finish()
 
     def _execute(self, q: QueryInfo) -> None:
@@ -233,18 +251,27 @@ class QueryManager:
             for row in table.to_pylist()]
 
     def get(self, qid: str) -> QueryInfo | None:
-        return self.queries.get(qid)
+        # submit() inserts under the lock from dispatcher threads
+        with self.lock:
+            return self.queries.get(qid)
+
+    def snapshot(self) -> list[QueryInfo]:
+        """Stable copy for handler threads: iterating the live dict
+        view races submit() inserting under the lock."""
+        with self.lock:
+            return list(self.queries.values())
 
     def cancel(self, qid: str) -> None:
-        q = self.queries.get(qid)
-        if q is None:
-            return
         with self.lock:
-            if q.state not in ("QUEUED", "RUNNING"):
+            q = self.queries.get(qid)
+            if q is None or q.state not in ("QUEUED", "RUNNING"):
                 return
             q.state = "CANCELED"
             q.finished = time.monotonic()
-            ticket = self._tickets.get(qid)
+            # pop, don't get: a query canceled while still group-queued
+            # never runs _run's finally, so leaving the entry here
+            # would leak a (group, start-closure) per canceled query
+            ticket = self._tickets.pop(qid, None)
             if q.cancel_token is not None:
                 # a RUNNING query observes this at its next host-side
                 # checkpoint (between blocks / retries / spill parts)
@@ -306,7 +333,7 @@ class _Handler(JsonHandler):
         """Prometheus text exposition — the observability export the
         reference provides through JMX+REST (/v1/jmx/mbean; here the
         standard scrape format so any collector can consume it)."""
-        qs = list(self.manager.queries.values())
+        qs = self.manager.snapshot()
         by_state: dict[str, int] = {}
         dur_sum = 0.0
         dur_count = 0
@@ -425,7 +452,7 @@ class _Handler(JsonHandler):
             self.wfile.write(body)
             return
         if self.path == "/v1/cluster":
-            qs = list(self.manager.queries.values())
+            qs = self.manager.snapshot()
             self._send_json({
                 "runningQueries": sum(q.state == "RUNNING" for q in qs),
                 "queuedQueries": sum(q.state == "QUEUED" for q in qs),
@@ -473,7 +500,7 @@ class _Handler(JsonHandler):
             self._send_json([
                 {"queryId": q.query_id, "state": q.state,
                  "query": q.sql, "user": q.user}
-                for q in self.manager.queries.values()
+                for q in self.manager.snapshot()
                 if self._can_view(user, q)])
             return
         if len(parts) == 3 and parts[:2] == ["v1", "query"]:

@@ -1,0 +1,533 @@
+"""Static-analysis suite tests (presto_tpu/lint/): the whole package
+must lint clean (the enforcement that keeps the rules honest), and
+deliberately broken fixtures demonstrate each rule family firing —
+including reconstructions of real violations this suite originally
+caught in the tree (serde missing MatchRecognize, the RemoteWorker
+failure-ratio read, the worker engine-dict iteration race)."""
+
+from __future__ import annotations
+
+import json
+import textwrap
+from pathlib import Path
+
+import pytest
+
+from presto_tpu.lint import run_lint
+from presto_tpu.lint.__main__ import main as lint_main
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def write_pkg(tmp_path: Path, files: dict[str, str]) -> Path:
+    """Materialize sources under tmp_path with presto_tpu-relative
+    names so rule scopes apply to fixtures like to the real tree."""
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    return tmp_path / "presto_tpu"
+
+
+def rules_of(findings):
+    return {f.rule for f in findings}
+
+
+# -- enforcement over the real tree -----------------------------------------
+
+def test_package_lints_clean():
+    """Zero unsuppressed findings across the whole engine: every rule
+    is enforced, not advisory. New violations fail tier-1 here."""
+    findings = run_lint([REPO / "presto_tpu"])
+    assert findings == [], "\n".join(f.format() for f in findings)
+
+
+# -- tracer hygiene ---------------------------------------------------------
+
+TRACER_FIXTURE = """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    def helper(x):
+        return float(jnp.max(x))
+
+    @jax.jit
+    def kernel(x):
+        if jnp.sum(x) > 0:
+            x = np.log(jnp.abs(x))
+        return helper(x)
+
+    def host_only(x):
+        # identical sins, but never traced: must NOT be flagged
+        if jnp.sum(x) > 0:
+            return float(jnp.max(x))
+        return np.log(jnp.abs(x))
+"""
+
+
+def test_tracer_rules_fire_only_in_reachable_code(tmp_path):
+    pkg = write_pkg(tmp_path,
+                    {"presto_tpu/exec/broken.py": TRACER_FIXTURE})
+    findings = run_lint([pkg])
+    assert {"tracer-branch", "tracer-numpy",
+            "tracer-concretize"} <= rules_of(findings)
+    # reachability precision: the host_only copies stay silent
+    host_start = TRACER_FIXTURE.count("\n", 0, TRACER_FIXTURE.index(
+        "def host_only"))
+    assert all(f.line < host_start for f in findings), \
+        [f.format() for f in findings]
+
+
+def test_tracer_branch_on_lax_callback(tmp_path):
+    pkg = write_pkg(tmp_path, {"presto_tpu/ops/broken.py": """
+        import jax
+        import jax.numpy as jnp
+
+        def body(carry, x):
+            if jnp.any(x):
+                carry = carry + 1
+            return carry, x
+
+        def run(xs):
+            return jax.lax.scan(body, 0, xs)
+    """})
+    assert "tracer-branch" in rules_of(run_lint([pkg]))
+
+
+def test_tracer_static_arg_rules(tmp_path):
+    pkg = write_pkg(tmp_path, {"presto_tpu/ops/broken.py": """
+        from functools import partial
+        import jax
+
+        @partial(jax.jit, static_argnames=("cfg", "missing"))
+        def kern(x, cfg={}):
+            return x
+    """})
+    findings = [f for f in run_lint([pkg])
+                if f.rule == "tracer-static-arg"]
+    msgs = " | ".join(f.message for f in findings)
+    assert "unhashable mutable default" in msgs
+    assert "'missing'" in msgs
+
+
+def test_tracer_ignores_static_jnp_metadata(tmp_path):
+    pkg = write_pkg(tmp_path, {"presto_tpu/ops/clean.py": """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def kern(x):
+            if jnp.issubdtype(x.dtype, jnp.floating):
+                return x * jnp.finfo(x.dtype).eps
+            return x
+    """})
+    assert run_lint([pkg]) == []
+
+
+# -- lock discipline --------------------------------------------------------
+
+LOCK_FIXTURE = """
+    import threading
+
+    class Svc:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.state = 0
+            self.unguarded = 0
+
+        def bump(self):
+            with self._lock:
+                self.state += 1
+
+        def peek(self):
+            return self.state  # racy read
+
+        def fine(self):
+            with self._lock:
+                return self.state
+
+        def _helper(self):
+            return self.state  # every call site holds the lock
+
+        def locked_entry(self):
+            with self._lock:
+                return self._helper()
+
+        def touch(self):
+            self.unguarded += 1  # never lock-guarded anywhere: fine
+"""
+
+
+def test_lock_discipline_flags_bare_access_only(tmp_path):
+    pkg = write_pkg(tmp_path,
+                    {"presto_tpu/parallel/broken.py": LOCK_FIXTURE})
+    findings = run_lint([pkg])
+    assert rules_of(findings) == {"lock-discipline"}
+    assert len(findings) == 1
+    assert "peek" in findings[0].message
+    assert "Svc.state" in findings[0].message
+
+
+def test_lock_discipline_failure_ratio_regression(tmp_path):
+    """The shape of the real race this suite caught in
+    parallel/coordinator.py: a decayed health ratio written under the
+    lock by the heartbeat thread, read bare by scheduling code."""
+    pkg = write_pkg(tmp_path, {"presto_tpu/parallel/broken.py": """
+        import threading
+
+        class RemoteWorker:
+            def __init__(self):
+                self.lock = threading.Lock()
+                self.failure_ratio = 0.0
+
+            def record(self, failed):
+                with self.lock:
+                    self.failure_ratio = (0.7 * self.failure_ratio
+                                          + 0.3 * float(failed))
+
+            @property
+            def alive(self):
+                return self.failure_ratio < 0.5
+    """})
+    findings = run_lint([pkg])
+    assert len(findings) == 1
+    assert findings[0].rule == "lock-discipline"
+    assert "failure_ratio" in findings[0].message
+
+
+def test_lock_discipline_sees_outer_alias_in_nested_class(tmp_path):
+    """The worker-server pattern: `outer = self`, a nested handler
+    class touching outer state from request threads."""
+    pkg = write_pkg(tmp_path, {"presto_tpu/server/broken.py": """
+        import threading
+
+        class Server:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._engines = {}
+                outer = self
+
+                class Handler:
+                    def do_GET(self):
+                        return list(outer._engines.values())
+
+                def factory(key):
+                    with outer._lock:
+                        outer._engines[key] = object()
+    """})
+    findings = run_lint([pkg])
+    assert len(findings) == 1
+    assert "_engines" in findings[0].message
+    assert "do_GET" in findings[0].message
+
+
+def test_lock_discipline_scope_excludes_exec(tmp_path):
+    """Lock scope is parallel/, server/, memory.py — the same class in
+    exec/ is not checked (single-threaded per query there)."""
+    pkg = write_pkg(tmp_path,
+                    {"presto_tpu/exec/whatever.py": LOCK_FIXTURE})
+    assert run_lint([pkg]) == []
+
+
+def test_lock_discipline_no_cross_class_name_pooling(tmp_path):
+    """Same-named private methods of unrelated classes must not vouch
+    for each other: B's lock-free self._refresh() call must not
+    disqualify A._refresh (whose own call sites all hold A's lock),
+    and must not be vouched for by A's locked call either."""
+    pkg = write_pkg(tmp_path, {"presto_tpu/server/broken.py": """
+        import threading
+
+        class A:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.state = 0
+
+            def entry(self):
+                with self._lock:
+                    self.state += 1
+                    return self._refresh()
+
+            def _refresh(self):
+                return self.state  # all A call sites hold the lock
+
+        class B:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.other = 0
+
+            def bump(self):
+                with self._lock:
+                    self.other += 1
+
+            def entry(self):
+                return self._refresh()  # lock-free, but B's problem
+
+            def _refresh(self):
+                return self.other  # real race: B reads unlocked
+    """})
+    findings = run_lint([pkg])
+    assert len(findings) == 1, [f.format() for f in findings]
+    assert "B.other" in findings[0].message
+
+
+def test_lock_discipline_mutual_recursion_cannot_vouch(tmp_path):
+    """Least-fixpoint inference: two private helpers whose only call
+    sites are each other (the Thread(target=self._loop) pattern — the
+    target reference is not a call) must NOT count as lock-held; their
+    unguarded reads are exactly the heartbeat-thread race class."""
+    pkg = write_pkg(tmp_path, {"presto_tpu/parallel/broken.py": """
+        import threading
+
+        class Beat:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.count = 0
+
+            def start(self):
+                threading.Thread(target=self._loop).start()
+
+            def bump(self):
+                with self._lock:
+                    self.count += 1
+
+            def _loop(self):
+                self._step()
+
+            def _step(self):
+                if self.count > 3:  # unguarded read on the thread
+                    return
+                self._loop()
+    """})
+    findings = run_lint([pkg])
+    assert len(findings) == 1, [f.format() for f in findings]
+    assert "count" in findings[0].message and "_step" in \
+        findings[0].message
+
+
+def test_tracer_plain_wrapping_decorator_is_not_a_root(tmp_path):
+    """A module-local decorator that merely wraps (no dispatch-table
+    registration) must not mark host code jit-reachable; a registry
+    decorator (stores into a subscript) must."""
+    pkg = write_pkg(tmp_path, {"presto_tpu/ops/broken.py": """
+        import jax.numpy as jnp
+
+        def timed(label):
+            def deco(fn):
+                def inner(*a):
+                    return fn(*a)
+                return inner
+            return deco
+
+        TABLE = {}
+
+        def registered(name):
+            def deco(fn):
+                TABLE[name] = fn
+                return fn
+            return deco
+
+        @timed("host")
+        def host_driver(x):
+            if jnp.sum(x) > 0:  # concrete host arrays: legal
+                return x
+            return x
+
+        @registered("k")
+        def kernel(x):
+            if jnp.sum(x) > 0:  # traced via TABLE dispatch: flagged
+                return x
+            return x
+    """})
+    findings = run_lint([pkg])
+    assert len(findings) == 1, [f.format() for f in findings]
+    assert "kernel" in findings[0].message
+
+
+# -- dispatch exhaustiveness ------------------------------------------------
+
+DISPATCH_NODES = """
+    class PlanNode:
+        pass
+
+    class Alpha(PlanNode):
+        pass
+
+    class Beta(PlanNode):
+        pass
+
+    class Gamma(PlanNode):
+        pass
+"""
+
+
+def test_dispatch_isinstance_site(tmp_path):
+    pkg = write_pkg(tmp_path, {
+        "presto_tpu/plan/nodes.py": DISPATCH_NODES,
+        "presto_tpu/plan/printer.py": """
+            from presto_tpu.plan import nodes as N
+
+            DISPATCH_EXEMPT = {
+                "Gamma": "printed by the fallback on purpose",
+                "Alpha": "stale: actually handled below",
+                "Omega": "no longer exists",
+            }
+
+            def describe(node):
+                if isinstance(node, N.Alpha):
+                    return "alpha"
+                return type(node).__name__
+        """})
+    findings = run_lint([pkg], rules=["plan-dispatch"])
+    msgs = [f.message for f in findings]
+    assert any("Beta" in m and "not handled" in m for m in msgs)
+    assert any("Alpha" in m and "stale" in m for m in msgs)
+    assert any("Omega" in m and "unknown" in m for m in msgs)
+    # Gamma is properly exempted: no finding mentions it as missing
+    assert not any("Gamma" in m and "not handled" in m for m in msgs)
+
+
+def test_dispatch_register_site_catches_missing_node(tmp_path):
+    """The real violation this rule caught: plan/serde.py had never
+    registered MatchRecognize, so serializing such a fragment raised
+    'unregistered plan class' at runtime."""
+    pkg = write_pkg(tmp_path, {
+        "presto_tpu/plan/nodes.py": DISPATCH_NODES,
+        "presto_tpu/plan/serde.py": """
+            from presto_tpu.plan import nodes as N
+
+            _CLASSES = {}
+
+            def _register(*classes):
+                for c in classes:
+                    _CLASSES[c.__name__] = c
+
+            _register(N.Alpha, N.Beta)
+        """})
+    findings = run_lint([pkg], rules=["plan-dispatch"])
+    assert len(findings) == 1
+    assert "Gamma" in findings[0].message
+
+
+def test_dispatch_method_prefix_site(tmp_path):
+    pkg = write_pkg(tmp_path, {
+        "presto_tpu/plan/nodes.py": DISPATCH_NODES,
+        "presto_tpu/exec/executor.py": """
+            from presto_tpu.plan import nodes as N
+
+            class Interp:
+                def run(self, node):
+                    return getattr(
+                        self, "_r_" + type(node).__name__.lower())(node)
+
+                def _r_alpha(self, node):
+                    return 1
+
+                def _r_beta(self, node):
+                    return 2
+        """})
+    findings = run_lint([pkg], rules=["plan-dispatch"])
+    assert len(findings) == 1
+    assert "Gamma" in findings[0].message
+
+
+def test_dispatch_generic_site_needs_marker(tmp_path):
+    pkg = write_pkg(tmp_path, {
+        "presto_tpu/plan/nodes.py": DISPATCH_NODES,
+        "presto_tpu/plan/fingerprint.py": """
+            import dataclasses
+
+            def tok(x):
+                for f in dataclasses.fields(x):
+                    pass
+        """})
+    findings = run_lint([pkg], rules=["plan-dispatch"])
+    assert len(findings) == 1
+    assert "GENERIC_PLAN_DISPATCH" in findings[0].message
+
+
+# -- suppressions and CLI ---------------------------------------------------
+
+def test_per_line_suppression(tmp_path):
+    pkg = write_pkg(tmp_path, {"presto_tpu/exec/broken.py": """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def kern(x):
+            if jnp.sum(x) > 0:  # lint: disable=tracer-branch
+                return x
+            return x
+    """})
+    assert run_lint([pkg]) == []
+
+
+def test_suppression_is_rule_specific(tmp_path):
+    pkg = write_pkg(tmp_path, {"presto_tpu/exec/broken.py": """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def kern(x):
+            if jnp.sum(x) > 0:  # lint: disable=some-other-rule
+                return x
+            return x
+    """})
+    assert rules_of(run_lint([pkg])) == {"tracer-branch"}
+
+
+def test_cli_exit_codes_and_json(tmp_path, capsys):
+    pkg = write_pkg(tmp_path,
+                    {"presto_tpu/parallel/broken.py": LOCK_FIXTURE})
+    assert lint_main([str(pkg), "--json"]) == 1
+    payload = json.loads(capsys.readouterr().out)
+    assert payload and payload[0]["rule"] == "lock-discipline"
+    assert {"path", "line", "col", "message"} <= set(payload[0])
+
+    clean = write_pkg(tmp_path / "c",
+                      {"presto_tpu/exec/nothing.py": "x = 1\n"})
+    assert lint_main([str(clean)]) == 0
+
+    assert lint_main([str(pkg), "--rules", "definitely-not-a-rule"]) == 2
+
+
+def test_cli_rule_subset(tmp_path):
+    pkg = write_pkg(tmp_path, {
+        "presto_tpu/parallel/broken.py": LOCK_FIXTURE,
+        "presto_tpu/exec/broken.py": TRACER_FIXTURE,
+    })
+    only_locks = run_lint([pkg], rules=["lock-discipline"])
+    assert rules_of(only_locks) == {"lock-discipline"}
+
+
+def test_subtree_run_still_checks_dispatch_against_real_registry():
+    """Running on a subtree (the documented CLI workflow) resolves the
+    PlanNode registry from disk relative to the subtree."""
+    findings = run_lint([REPO / "presto_tpu" / "plan"])
+    assert findings == [], "\n".join(f.format() for f in findings)
+
+
+def test_unknown_rule_raises():
+    with pytest.raises(ValueError):
+        run_lint([REPO / "presto_tpu" / "plan"], rules=["nope"])
+
+
+def test_nonexistent_or_empty_path_is_an_error(tmp_path, capsys):
+    """A typo'd path must not read as 'lint clean' (exit 0)."""
+    assert lint_main(["/nonexistent/definitely-not-here"]) == 2
+    assert "do not exist" in capsys.readouterr().err
+    empty = tmp_path / "nopy"
+    empty.mkdir()
+    assert lint_main([str(empty)]) == 2
+    assert "no Python files" in capsys.readouterr().err
+    with pytest.raises(ValueError):
+        run_lint([empty])
+
+
+def test_unparseable_file_is_a_usage_error_not_a_traceback(tmp_path,
+                                                          capsys):
+    bad = tmp_path / "presto_tpu" / "exec"
+    bad.mkdir(parents=True)
+    (bad / "scratch.py").write_text("def broken(:\n")
+    assert lint_main([str(tmp_path / "presto_tpu")]) == 2
+    assert "cannot parse" in capsys.readouterr().err

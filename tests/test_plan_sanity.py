@@ -1,0 +1,153 @@
+"""plan/sanity.py error paths: malformed plans raise PlanSanityError
+naming the offending node type (reference PlanSanityChecker behavior —
+planner bugs fail at plan time, not as trace-time KeyErrors)."""
+
+from __future__ import annotations
+
+import dataclasses
+
+import pytest
+
+from presto_tpu import types as T
+from presto_tpu.expr import ir
+from presto_tpu.expr.aggregates import AggCall
+from presto_tpu.plan import nodes as N
+from presto_tpu.plan.sanity import PlanSanityError, validate_plan
+
+
+def leaf(sym="a", dtype=T.BIGINT):
+    return N.Values(symbols=[sym], types={sym: dtype}, rows=[[1]])
+
+
+def ref(sym="a", dtype=T.BIGINT):
+    return ir.ColumnRef(dtype, sym)
+
+
+def expect(plan, node_name: str, fragment: str):
+    with pytest.raises(PlanSanityError) as exc:
+        validate_plan(plan)
+    msg = str(exc.value)
+    assert msg.startswith(node_name + ":"), msg
+    assert fragment in msg, msg
+
+
+def test_valid_plan_passes():
+    plan = N.Output(
+        N.Filter(leaf(), ir.Call(T.BOOLEAN, "eq",
+                                 (ref(), ir.Literal(T.BIGINT, 1)))),
+        names=["a"], symbols=["a"])
+    validate_plan(plan)
+
+
+def test_filter_unknown_column_ref():
+    plan = N.Filter(leaf("a"), predicate=ref("missing", T.BOOLEAN))
+    expect(plan, "Filter", "missing")
+
+
+def test_project_unknown_column_named():
+    plan = N.Project(leaf("a"), {"out": ref("ghost")})
+    expect(plan, "Project", "assignment out")
+
+
+def test_union_mapping_from_missing_symbol():
+    plan = N.Union(
+        inputs=[leaf("a"), leaf("b")],
+        symbols=["u"], types={"u": T.BIGINT},
+        mappings=[{"u": "a"}, {"u": "nope"}])
+    expect(plan, "Union", "maps u from unknown column nope")
+
+
+def test_output_arity_mismatch():
+    plan = N.Output(leaf("a"), names=["x", "y"], symbols=["a"])
+    expect(plan, "Output", "arity mismatch")
+
+
+def test_values_row_arity():
+    plan = N.Values(symbols=["a", "b"],
+                    types={"a": T.BIGINT, "b": T.BIGINT},
+                    rows=[[1, 2], [3]])
+    expect(plan, "Values", "row 1")
+
+
+def test_tablescan_assignment_type_disagreement():
+    plan = N.TableScan("c", "t", {"s": "col"}, {"other": T.BIGINT})
+    expect(plan, "TableScan", "disagree")
+
+
+def test_unnest_unknown_array_symbol():
+    plan = N.Unnest(leaf("a"), array_syms=["arr"], out_syms=["e"],
+                    out_types={"e": T.BIGINT})
+    expect(plan, "Unnest", "arr")
+
+
+def test_negative_limit():
+    plan = N.Limit(leaf(), count=-1)
+    expect(plan, "Limit", "negative")
+
+
+def test_join_without_criteria_or_filter():
+    plan = N.Join(left=leaf("a"), right=leaf("b"), criteria=[])
+    expect(plan, "Join", "no criteria")
+
+
+def test_semijoin_unknown_filter_key():
+    plan = N.SemiJoin(source=leaf("a"), filter_source=leaf("b"),
+                      source_keys=["a"], filter_keys=["zzz"],
+                      output="m")
+    expect(plan, "SemiJoin", "zzz")
+
+
+def test_window_unknown_partition_key():
+    plan = N.Window(leaf("a"), partition_by=["ghost"])
+    expect(plan, "Window", "ghost")
+
+
+# -- new invariants ---------------------------------------------------------
+
+def test_duplicate_node_object_rejected():
+    shared = leaf("a")
+    plan = N.Union(inputs=[shared, shared], symbols=["u"],
+                   types={"u": T.BIGINT},
+                   mappings=[{"u": "a"}, {"u": "a"}])
+    expect(plan, "Values", "appears twice")
+
+
+def test_distinct_trees_with_equal_structure_pass():
+    plan = N.Union(inputs=[leaf("a"), leaf("a")], symbols=["u"],
+                   types={"u": T.BIGINT},
+                   mappings=[{"u": "a"}, {"u": "a"}])
+    validate_plan(plan)
+
+
+def _agg(source, step, sym="s"):
+    return N.Aggregate(
+        source=source, group_keys=[],
+        aggs={sym: AggCall("sum", ref("a"), T.BIGINT)}, step=step)
+
+
+def test_partial_without_final_rejected_in_full_plan():
+    partial = _agg(leaf("a"), N.AggStep.PARTIAL)
+    plan = N.Output(partial, names=["s$sum"], symbols=["s$sum"])
+    expect(plan, "Aggregate", "without a FINAL")
+
+
+def test_partial_final_pair_across_exchange_passes():
+    partial = _agg(leaf("a"), N.AggStep.PARTIAL)
+    exch = N.Exchange(partial, kind=N.ExchangeType.GATHER)
+    final = dataclasses.replace(_agg(exch, N.AggStep.FINAL))
+    plan = N.Output(final, names=["s"], symbols=["s"])
+    validate_plan(plan)
+
+
+def test_partial_fragment_root_allowed():
+    """Worker fragments legitimately end at a PARTIAL aggregate: the
+    pairing invariant only applies to complete (Output-rooted) plans."""
+    validate_plan(_agg(leaf("a"), N.AggStep.PARTIAL))
+
+
+def test_final_missing_state_columns():
+    # FINAL over a raw scan: the sum's `s$sum`/`s$count` state columns
+    # its merge step consumes are absent
+    final = _agg(leaf("a"), N.AggStep.FINAL)
+    plan = N.Output(final, names=["s"], symbols=["s"])
+    expect(plan, "Aggregate", "missing partial state columns")

@@ -233,3 +233,39 @@ def test_http_set_session_scoped_per_client(server):
     # a's later queries still execute fine with the override bound
     _, rows = a.execute("select 1")
     assert rows == [[1]]
+
+
+def test_cancel_while_queued_releases_ticket_and_slot():
+    """A query canceled while still group-QUEUED must free its
+    max_queued slot AND its dispatcher ticket — the ticket dict
+    otherwise grows by one (group, closure) entry per canceled query
+    for the life of the server."""
+    import time
+
+    from presto_tpu import BIGINT, Engine
+    from presto_tpu.connectors.blackhole import BlackholeConnector
+    from presto_tpu.server.resource_groups import GroupSpec
+    from presto_tpu.server.server import QueryManager
+
+    engine = Engine()
+    bh = BlackholeConnector(rows_per_table=10,
+                            page_processing_delay_s=30.0)
+    bh.create_table("slow", {"x": BIGINT}, {"x": []}, {"x": None})
+    engine.register_catalog("bh", bh)
+    mgr = QueryManager(engine, resource_groups=[
+        GroupSpec("tiny", hard_concurrency_limit=1, max_queued=4)])
+    running = mgr.submit("SELECT count(*) FROM bh.slow", "u")
+    for _ in range(100):
+        if running.state == "RUNNING":
+            break
+        time.sleep(0.05)
+    queued = mgr.submit("SELECT 1", "u")
+    assert queued.state == "QUEUED"
+    mgr.cancel(queued.query_id)
+    assert queued.state == "CANCELED"
+    with mgr.lock:
+        assert queued.query_id not in mgr._tickets
+    # the queue slot freed: the group accepts max_queued new entries
+    for _ in range(4):
+        assert mgr.submit("SELECT 1", "u").state == "QUEUED"
+    mgr.cancel(running.query_id)
