@@ -17,6 +17,7 @@ import numpy as np
 from presto_tpu import types as T
 from presto_tpu.block import Table, _decode_column
 from presto_tpu.connectors.base import Connector
+from presto_tpu.obs.trace import TRACER
 from presto_tpu.session import SYSTEM_SESSION_PROPERTIES, Session
 
 
@@ -186,10 +187,11 @@ class Engine:
         from presto_tpu.plan.planner import LogicalPlanner
         from presto_tpu.plan.optimizer import optimize
 
-        stmt = parse_statement(sql)
-        analysis = Analyzer(self).analyze(stmt)
-        plan = LogicalPlanner(self, analysis).plan(stmt)
-        plan = optimize(plan, self, enable_latemat=enable_latemat)
+        with TRACER.span("plan"):
+            stmt = parse_statement(sql)
+            analysis = Analyzer(self).analyze(stmt)
+            plan = LogicalPlanner(self, analysis).plan(stmt)
+            plan = optimize(plan, self, enable_latemat=enable_latemat)
         return plan, analysis
 
     def explain(self, sql: str) -> str:
@@ -206,12 +208,13 @@ class Engine:
 
         from presto_tpu.plan.sanity import validate_plan
 
-        planner = LogicalPlanner(self, None)
-        plan = planner.plan(A.QueryStatement(query))
-        plan = optimize(plan, self)
-        # invariant validation before execution (reference
-        # PlanSanityChecker runs after every optimizer stage)
-        validate_plan(plan)
+        with TRACER.span("plan"):
+            planner = LogicalPlanner(self, None)
+            plan = planner.plan(A.QueryStatement(query))
+            plan = optimize(plan, self)
+            # invariant validation before execution (reference
+            # PlanSanityChecker runs after every optimizer stage)
+            validate_plan(plan)
         return plan
 
     def _execute_query(self, query, mesh=None) -> Table:

@@ -6,14 +6,25 @@ spi/eventlistener/EventListener.java): the engine emits a created event
 when a query is admitted and a completed event with statistics when it
 finishes; listeners are plain callables registered on the engine.
 Recent completed events also back the system.runtime.queries table.
+
+``monitored()`` is also the engine-level tracing entry: it opens the
+query's root span (obs/trace.py) when no trace is active, so CLI /
+dbapi / direct-Engine queries are traced exactly like HTTP-admitted
+ones (whose root the coordinator server opens under the HTTP query id).
 """
 
 from __future__ import annotations
 
 import dataclasses
+import threading
 import time
 from collections import deque
 from typing import Callable
+
+import numpy as np
+
+from presto_tpu.obs.jsonlog import LOG
+from presto_tpu.obs.trace import TRACER
 
 
 @dataclasses.dataclass
@@ -43,29 +54,48 @@ class QueryCompletedEvent:
 class EventListenerManager:
     """Dispatches lifecycle events to registered listeners and keeps a
     bounded history for system.runtime.queries (reference
-    EventListenerManager + QuerySystemTable)."""
+    EventListenerManager + QuerySystemTable). Thread-safe: the HTTP
+    server runs queries on a pool, so id allocation, listener
+    registration, and the history ring are all lock-guarded."""
 
     def __init__(self, history: int = 1000):
+        self._lock = threading.Lock()
         self._listeners: list[Callable] = []
-        self.history: deque = deque(maxlen=history)
+        self._history: deque = deque(maxlen=history)
         self._seq = 0
 
     def add_listener(self, fn: Callable) -> None:
-        self._listeners.append(fn)
+        with self._lock:
+            self._listeners.append(fn)
 
     def next_query_id(self) -> str:
-        self._seq += 1
-        return f"q_{self._seq:08d}"
+        with self._lock:
+            self._seq += 1
+            return f"q_{self._seq:08d}"
+
+    @property
+    def history(self) -> list:
+        """Snapshot of recent completed events (system.runtime.queries
+        reads this while pool threads append)."""
+        with self._lock:
+            return list(self._history)
 
     def query_created(self, event: QueryCreatedEvent) -> None:
         self._emit(event)
 
     def query_completed(self, event: QueryCompletedEvent) -> None:
-        self.history.append(event)
+        with self._lock:
+            self._history.append(event)
+        LOG.log("query_completed", query_id=event.query_id,
+                user=event.user, state=event.state,
+                elapsed_ms=round(event.elapsed_ms, 3),
+                rows=event.output_rows, error=event.error)
         self._emit(event)
 
     def _emit(self, event) -> None:
-        for fn in self._listeners:
+        with self._lock:
+            listeners = list(self._listeners)
+        for fn in listeners:
             try:
                 fn(event)
             except Exception:
@@ -76,24 +106,30 @@ class EventListenerManager:
 
 def monitored(engine, sql: str, run: Callable):
     """Run ``run()`` under query monitoring: emits created/completed
-    events and records history. Returns run()'s result."""
+    events, records history, and opens the query's root span (child
+    span when a trace — e.g. the HTTP server's — is already active).
+    Returns run()'s result."""
     mgr: EventListenerManager = engine.events
     qid = mgr.next_query_id()
     t0 = time.time()
     mgr.query_created(QueryCreatedEvent(qid, sql, engine.session.user, t0))
-    try:
-        result = run()
-    except Exception as exc:
-        mgr.query_completed(QueryCompletedEvent(
-            qid, sql, engine.session.user, "FAILED", t0, time.time(),
-            0, error=f"{type(exc).__name__}: {exc}"))
-        raise
+    with TRACER.root_or_span(qid, "query", query_id=qid,
+                             user=engine.session.user,
+                             sql=sql[:200]) as sp:
+        try:
+            result = run()
+        except Exception as exc:
+            if sp is not None:
+                sp.attrs["error"] = f"{type(exc).__name__}: {exc}"
+            mgr.query_completed(QueryCompletedEvent(
+                qid, sql, engine.session.user, "FAILED", t0, time.time(),
+                0, error=f"{type(exc).__name__}: {exc}"))
+            raise
     if isinstance(result, list):
         rows = len(result)
     else:
         mask = getattr(result, "mask", None)
         if mask is not None:
-            import numpy as np
             rows = int(np.asarray(mask).sum())
         else:
             rows = getattr(result, "nrows", 0)

@@ -27,8 +27,16 @@ from presto_tpu.block import Column, Table
 from presto_tpu.exec import operators as OP
 from presto_tpu.exec.operators import DTable
 from presto_tpu.expr.compile import Val
+from presto_tpu.obs.metrics import REGISTRY
+from presto_tpu.obs.trace import TRACER
 from presto_tpu.ops.hash import next_pow2
 from presto_tpu.plan import nodes as N
+
+_COMPILES = REGISTRY.counter(
+    "presto_tpu_programs_compiled_total",
+    "XLA programs compiled (cache misses + capacity-retry recompiles)")
+_COMPILE_SECONDS = REGISTRY.histogram(
+    "presto_tpu_compile_seconds", "XLA program compile wall time")
 
 
 # dispatch-exhaustiveness opt-outs (lint/dispatch.py): node types the
@@ -511,20 +519,32 @@ def prepare_plan(engine, plan: N.PlanNode, scan_inputs: list[ScanInput]):
         if entry is None:
             traced_fn, _host_arrays, meta = make_traced(
                 scan_inputs, plan, capacities, engine.session)
-            compiled = jax.jit(traced_fn)
             _t0 = time.perf_counter()
-            out = compiled(*flat_arrays)
+            # explicit AOT lower+compile (not a first jit-wrapper call)
+            # so compile and execute attribute separately in spans;
+            # meta fills during the trace lower() triggers
+            with TRACER.span("compile", attempt=_attempt,
+                             root=type(plan).__name__):
+                compiled = jax.jit(traced_fn).lower(
+                    *flat_arrays).compile()
+            compile_s = time.perf_counter() - _t0
+            _COMPILES.inc()
+            _COMPILE_SECONDS.observe(compile_s)
             if os.environ.get("PRESTO_TPU_LOG_COMPILES"):
-                print(f"[compile] {time.perf_counter() - _t0:.1f}s "
+                print(f"[compile] {compile_s:.1f}s "
                       f"caps={dict(capacities)} "
                       f"root={type(plan).__name__}", file=sys.stderr)
-            # meta fills during the trace triggered by the first call
             engine._program_cache[(base_key, caps_key)] = (compiled, meta)
+            cache_hit = False
         else:
             compiled, meta = entry
-            out = compiled(*flat_arrays)
-        res, live, oks = out
-        oks_np = np.asarray(oks)  # ONE host sync for every flag
+            cache_hit = True
+        with TRACER.span("execute", cache_hit=cache_hit):
+            res, live, oks = compiled(*flat_arrays)
+            # ONE host sync for every flag — also the point the async
+            # dispatch actually finishes, so the span covers real
+            # device time, not just call overhead
+            oks_np = np.asarray(oks)
         if oks_np.all():
             engine._caps_memory[base_key] = dict(capacities)
             return compiled, flat_arrays, meta, (res, live, oks)
@@ -728,7 +748,9 @@ def _segment_carriers(engine, plan: N.PlanNode, pool_tag: str,
             mat = _prune_subtree(sub, needed)
         scans = _collect_with_carriers(mat, engine, carriers)
         _t0 = time.perf_counter()
-        arrays, dicts, types, n = run_plan_device(engine, mat, scans)
+        with TRACER.span("segment", index=seg):
+            arrays, dicts, types, n = run_plan_device(engine, mat,
+                                                      scans)
         if pool is not None:
             pool.reserve(pool_tag, sum(
                 int(a.nbytes) for a in arrays.values()))

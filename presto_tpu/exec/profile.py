@@ -12,12 +12,14 @@ kernel outputs), plus compile and execute wall times for the whole plan.
 from __future__ import annotations
 
 import time
+import uuid
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from presto_tpu.exec.executor import PlanInterpreter, collect_scans
+from presto_tpu.obs.trace import TRACER
 from presto_tpu.plan import nodes as N
 from presto_tpu.plan.printer import format_plan
 
@@ -44,8 +46,6 @@ def explain_analyze(engine, plan: N.PlanNode) -> str:
     of time on this engine (reference analog:
     operator/OperationTimer.java:30 rolled up per operator,
     ExplainAnalyzeOperator.java:34)."""
-    import uuid
-
     from presto_tpu.exec import executor as EX
 
     seg_lines: list[str] = []
@@ -105,11 +105,13 @@ def _explain_one_program(engine, plan: N.PlanNode,
         flat_arrays = [scan.arrays[sym] for scan in scan_inputs
                        for sym in scan.arrays]
         t0 = time.perf_counter()
-        compiled = jax.jit(traced_fn).lower(*flat_arrays).compile()
+        with TRACER.span("compile", analyze=True):
+            compiled = jax.jit(traced_fn).lower(*flat_arrays).compile()
         compile_s = time.perf_counter() - t0
         t0 = time.perf_counter()
-        live, counts, oks = compiled(*flat_arrays)
-        jax.block_until_ready(live)
+        with TRACER.span("execute", analyze=True):
+            live, counts, oks = compiled(*flat_arrays)
+            jax.block_until_ready(live)
         run_s = time.perf_counter() - t0
         if all(bool(np.asarray(o)) for o in oks):
             break

@@ -14,6 +14,10 @@ pipeline, sql/planner/sanity/PlanSanityChecker.java):
   subclass that one of the visitors (serde, printer, sanity,
   fingerprint, executor) forgets fails only on the query shape that
   reaches it.
+- **metric naming** (``lint/metrics.py``): registrations against the
+  obs/metrics registry checked statically with the registry's own
+  validator — a bad name on a rarely-hit path would otherwise only
+  raise in production.
 
 Run ``python -m presto_tpu.lint presto_tpu/`` (exits nonzero on
 findings); suppress a single line with ``# lint: disable=rule-name``
@@ -27,5 +31,6 @@ from presto_tpu.lint.core import (Finding, Project, available_rules,
 from presto_tpu.lint import tracer as _tracer  # noqa: E402,F401
 from presto_tpu.lint import locks as _locks  # noqa: E402,F401
 from presto_tpu.lint import dispatch as _dispatch  # noqa: E402,F401
+from presto_tpu.lint import metrics as _metrics  # noqa: E402,F401
 
 __all__ = ["Finding", "Project", "available_rules", "run_lint"]

@@ -35,6 +35,8 @@ LOCK_SCOPES = (
     "presto_tpu/parallel/",
     "presto_tpu/server/",
     "presto_tpu/memory.py",
+    "presto_tpu/obs/",
+    "presto_tpu/events.py",
 )
 
 _LOCK_NAME_RE = re.compile(

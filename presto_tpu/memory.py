@@ -148,6 +148,10 @@ class MemoryPool:
     def reserve(self, tag: str, nbytes: int) -> None:
         with self._lock:
             if self.capacity and self.reserved + nbytes > self.capacity:
+                from presto_tpu.obs.metrics import REGISTRY
+                REGISTRY.counter(
+                    "presto_tpu_memory_limit_exceeded_total",
+                    "reservations rejected by the pool capacity").inc()
                 raise MemoryLimitExceeded(
                     f"pool exhausted: {self.reserved} + {nbytes} "
                     f"> {self.capacity} bytes (query {tag})")

@@ -1,0 +1,32 @@
+"""Observability: unified metrics registry, distributed span tracer,
+structured JSON logging.
+
+Three pieces, shared by the coordinator, the workers, and the engine's
+execution layers (the reference covers the same ground with
+QueryStats/OperatorStats rollups + the JMX/REST metric surface + the
+OpenTelemetry spans threaded through task RPC in later Trino):
+
+- ``obs.metrics``  — name-validated Counter/Gauge/Histogram registry
+  with Prometheus text exposition; ``GET /metrics`` on BOTH server
+  roles renders the process-wide ``REGISTRY``.
+- ``obs.trace``    — ``Span`` + ``Tracer`` with contextvar ambient
+  context, explicit ``X-Presto-TPU-Trace`` header propagation across
+  coordinator->worker task POSTs, and Chrome trace-event JSON export
+  (``GET /v1/query/{id}/trace``).
+- ``obs.jsonlog``  — opt-in structured JSON line logging
+  (``PRESTO_TPU_LOG=stderr|stdout|<path>``), trace-id stamped.
+"""
+
+from presto_tpu.obs.metrics import (MetricError, MetricsRegistry,
+                                    REGISTRY, validate_metric_name)
+from presto_tpu.obs.trace import (Span, TRACE_HEADER, TRACER, Tracer,
+                                  current_context, parse_context,
+                                  trace_headers)
+from presto_tpu.obs.jsonlog import LOG, configure as configure_logging
+
+__all__ = [
+    "MetricError", "MetricsRegistry", "REGISTRY",
+    "validate_metric_name", "Span", "TRACE_HEADER", "TRACER", "Tracer",
+    "current_context", "parse_context", "trace_headers", "LOG",
+    "configure_logging",
+]

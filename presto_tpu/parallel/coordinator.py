@@ -30,6 +30,7 @@ from concurrent.futures import ThreadPoolExecutor
 import numpy as np
 
 from presto_tpu import types as T
+from presto_tpu.obs import trace as OT
 from presto_tpu.plan import nodes as N
 
 
@@ -82,29 +83,35 @@ class RemoteWorker:
     def post_task_any(self, payload: dict,
                       timeout: float = 300.0) -> dict | bytes:
         """POST a task; returns parsed JSON or raw bytes for binary
-        (inline fragment result) responses."""
-        req = urllib.request.Request(
-            f"{self.uri}/v1/task",
-            data=json.dumps(payload).encode(), method="POST",
-            headers={"Content-Type": "application/json",
-                     **self._auth_headers()})
-        try:
-            with _urlopen(req, timeout=timeout) as resp:
-                body = resp.read()
-                if resp.headers.get("Content-Type", "").startswith(
-                        "application/octet-stream"):
-                    return body
-                out = json.loads(body)
-        except urllib.error.HTTPError as e:
-            # the worker answered: node is up, the TASK failed
+        (inline fragment result) responses. The dispatch records a
+        ``task-dispatch`` span whose id rides the X-Presto-TPU-Trace
+        header, so worker-side spans parent under it."""
+        with OT.TRACER.span("task-dispatch", worker=self.uri,
+                            task_id=str(payload.get("task_id", ""))):
+            req = urllib.request.Request(
+                f"{self.uri}/v1/task",
+                data=json.dumps(payload).encode(), method="POST",
+                headers={"Content-Type": "application/json",
+                         **OT.trace_headers(),
+                         **self._auth_headers()})
             try:
-                msg = json.loads(e.read()).get("error", str(e))
-            except Exception:  # noqa: BLE001
-                msg = str(e)
-            raise TaskError(msg) from e
-        if "error" in out:
-            raise TaskError(out["error"])
-        return out
+                with _urlopen(req, timeout=timeout) as resp:
+                    body = resp.read()
+                    if resp.headers.get("Content-Type",
+                                        "").startswith(
+                            "application/octet-stream"):
+                        return body
+                    out = json.loads(body)
+            except urllib.error.HTTPError as e:
+                # the worker answered: node is up, the TASK failed
+                try:
+                    msg = json.loads(e.read()).get("error", str(e))
+                except Exception:  # noqa: BLE001
+                    msg = str(e)
+                raise TaskError(msg) from e
+            if "error" in out:
+                raise TaskError(out["error"])
+            return out
 
     def delete_task(self, prefix: str, timeout: float = 10.0) -> None:
         req = urllib.request.Request(
@@ -179,11 +186,16 @@ class ClusterCoordinator:
     # -- query execution ----------------------------------------------------
 
     def execute(self, sql: str) -> list[tuple]:
+        return self.execute_table(sql).to_pylist()
+
+    def execute_table(self, sql: str):
+        """Run SQL across the cluster, returning the result Table
+        (typed columns — the HTTP coordinator frontend needs them)."""
         from presto_tpu.events import monitored
 
         return monitored(self.engine, sql, lambda: self._execute(sql))
 
-    def _execute(self, sql: str) -> list[tuple]:
+    def _execute(self, sql: str):
         from presto_tpu.exec.streaming import (_find_streamable,
                                                _replace_node)
 
@@ -195,10 +207,10 @@ class ClusterCoordinator:
         require = bool(self.engine.session.get("require_distribution"))
         allow_fb = bool(self.engine.session.get("allow_local_fallback"))
 
-        def run_local() -> list[tuple]:
+        def run_local():
             self.last_distribution = None
             from presto_tpu.exec.executor import execute_plan
-            return execute_plan(self.engine, plan).to_pylist()
+            return execute_plan(self.engine, plan)
 
         def _scans_tables(node) -> bool:
             from presto_tpu.plan import nodes as NN
@@ -207,7 +219,7 @@ class ClusterCoordinator:
                 return True
             return any(_scans_tables(sub) for sub in node.sources())
 
-        def local(reason: str) -> list[tuple]:
+        def local(reason: str):
             if require:
                 raise NoWorkersError(
                     f"require_distribution is set but the query "
@@ -270,13 +282,18 @@ class ClusterCoordinator:
                    payloads: list[dict]) -> list:
         """One task per worker; any node failure aborts the fragmented
         attempt (buffers on the dead node are lost)."""
+        # dispatch threads do NOT inherit contextvars from this thread;
+        # hand the trace context over explicitly so per-task dispatch
+        # spans parent under the query
+        ctx = OT.current_context()
 
         def run_one(i: int):
             w = workers[i]
             if not w.alive:
                 raise NoWorkersError(f"worker {w.uri} died")
             try:
-                out = w.post_task_any(payloads[i])
+                with OT.TRACER.attach(ctx):
+                    out = w.post_task_any(payloads[i])
                 w.record(False)
                 return out
             except TaskError:
@@ -290,8 +307,7 @@ class ClusterCoordinator:
             return list(pool.map(run_one, range(len(workers))))
 
     def _finish_with_partials(self, plan, agg, boundary,
-                              buffers: list[bytes], meta: dict
-                              ) -> list[tuple]:
+                              buffers: list[bytes], meta: dict):
         """Coordinator completion: concatenate worker partial-aggregate
         buffers, splice a FINAL aggregate over a carrier scan into the
         original plan, and run the remainder locally."""
@@ -330,11 +346,9 @@ class ClusterCoordinator:
         carrier_input = ScanInput(carrier, arrays, dicts,
                                   dict(ctypes), total)
         self.last_distribution = {**meta, "partial_rows": total}
-        return run_plan(self.engine, plan2,
-                        [carrier_input]).to_pylist()
+        return run_plan(self.engine, plan2, [carrier_input])
 
-    def _execute_partial_fragments(self, plan, agg,
-                                   workers) -> list[tuple]:
+    def _execute_partial_fragments(self, plan, agg, workers):
         """Scan->aggregate plans ship the PARTIAL fragment (serialized
         plan IR, not SQL — the worker no longer re-plans) as one split
         per worker with binary columnar results; failed splits fail
@@ -376,10 +390,10 @@ class ClusterCoordinator:
                                   total)
         self.last_distribution = {"nshards": nshards,
                                   "partial_rows": total}
-        return run_plan(self.engine, plan2,
-                        [carrier_input]).to_pylist()
+        return run_plan(self.engine, plan2, [carrier_input])
+
     def _execute_general(self, plan, g,
-                         workers: list[RemoteWorker]) -> list[tuple]:
+                         workers: list[RemoteWorker]):
         """Run a generally-fragmented plan (parallel/fragmenter.py
         fragment_plan_general): stages dispatch in dependency order,
         one task per worker; partitioned stages bucket outputs into W
@@ -469,7 +483,7 @@ class ClusterCoordinator:
                     pass
 
     def _execute_fragmented(self, plan, fragged,
-                            workers: list[RemoteWorker]) -> list[tuple]:
+                            workers: list[RemoteWorker]):
         """Run a fragmented join plan: scan stages partition legs into
         worker buffers, join stages pull co-partitions and join, the
         coordinator finishes (FINAL agg + sort/limit). See
@@ -565,6 +579,7 @@ class ClusterCoordinator:
         split retries on the surviving nodes (the elastic-recovery
         piece the reference lacks mid-query — failures there kill the
         query, SURVEY §5)."""
+        ctx = OT.current_context()  # pool threads don't inherit it
 
         def run_one(i: int) -> dict:
             order = [workers[i % len(workers)]] + [
@@ -575,7 +590,8 @@ class ClusterCoordinator:
                 if not w.alive:
                     continue
                 try:
-                    out = w.post_task_any(payloads[i])
+                    with OT.TRACER.attach(ctx):
+                        out = w.post_task_any(payloads[i])
                     w.record(False)
                     return out
                 except TaskError:

@@ -53,6 +53,7 @@ from presto_tpu.exec.executor import (PlanInterpreter, ScanInput,
                                       collect_scans)
 from presto_tpu.exec.operators import DTable
 from presto_tpu.expr.compile import Val
+from presto_tpu.obs.trace import TRACER as _TRACER
 from presto_tpu.ops import hash as H
 from presto_tpu.ops.hash import next_pow2
 from presto_tpu.parallel import exchange as EX
@@ -757,13 +758,17 @@ def execute_plan_distributed(engine, plan: N.PlanNode,
             out_specs=(P(), P(), P(), P()),
             **_SHARD_MAP_NOCHECK)
         t0 = _time.perf_counter()
-        lowered = jax.jit(sharded).lower(*flat_arrays)
-        compiled = lowered.compile()
+        with _TRACER.span("compile", devices=nshards,
+                          distributed=True):
+            lowered = jax.jit(sharded).lower(*flat_arrays)
+            compiled = lowered.compile()
         compile_s = _time.perf_counter() - t0
         t0 = _time.perf_counter()
-        with mesh:
-            res, live, oks, node_counts = compiled(*flat_arrays)
-        jax.block_until_ready(live)
+        with _TRACER.span("execute", devices=nshards,
+                          distributed=True):
+            with mesh:
+                res, live, oks, node_counts = compiled(*flat_arrays)
+            jax.block_until_ready(live)
         run_s = _time.perf_counter() - t0
         del n_out
         if all(bool(np.asarray(o)) for o in oks):

@@ -26,8 +26,29 @@ import urllib.request
 
 import numpy as np
 
+from presto_tpu.obs import trace as OT
+from presto_tpu.obs.jsonlog import LOG
+from presto_tpu.obs.metrics import REGISTRY
 from presto_tpu.server.httpbase import (HttpService, JsonHandler,
                                         urlopen as _urlopen)
+
+# worker-side instruments (shared registry: every worker in a process
+# contributes, labeled by node id)
+_TASKS = REGISTRY.counter(
+    "presto_tpu_worker_tasks_total",
+    "tasks accepted by the worker task endpoint")
+_TASK_FAILURES = REGISTRY.counter(
+    "presto_tpu_worker_task_failures_total",
+    "worker tasks that raised")
+_EXCHANGE_PAGES = REGISTRY.counter(
+    "presto_tpu_exchange_pages_total",
+    "exchange buffer pages served to consumers")
+_EXCHANGE_BYTES = REGISTRY.counter(
+    "presto_tpu_exchange_bytes_total",
+    "exchange buffer bytes served to consumers")
+_FETCH_BYTES = REGISTRY.counter(
+    "presto_tpu_exchange_fetch_bytes_total",
+    "exchange bytes pulled from peer workers")
 
 
 def execute_partial_task(engine_factory, sql: str, shard: int,
@@ -143,23 +164,31 @@ def _fetch_pages(ref: dict, timeout: float = 240.0,
     token = 0
     pages: list[bytes] = []
     deadline = _time.monotonic() + timeout
-    while True:
-        req = urllib.request.Request(f"{base}/{token}/{reader}",
-                                     headers=headers)
-        with _urlopen(req, timeout=60.0) as resp:
-            blob = resp.read()
-            nxt = int(resp.headers.get("X-PrestoTpu-Next-Token", token))
-            complete = (resp.headers.get("X-PrestoTpu-Complete", "0")
-                        == "1")
-        if blob:
-            pages.append(blob)
-        if nxt == token and complete:
-            return pages
-        token = nxt
-        if _time.monotonic() > deadline:
-            raise TimeoutError(
-                f"exchange fetch of {ref['task_id']}/{ref['part']} "
-                "timed out")
+    with OT.TRACER.span("exchange-fetch", task_id=ref["task_id"],
+                        part=int(ref["part"])) as sp:
+        while True:
+            req = urllib.request.Request(f"{base}/{token}/{reader}",
+                                         headers=headers)
+            with _urlopen(req, timeout=60.0) as resp:
+                blob = resp.read()
+                nxt = int(resp.headers.get("X-PrestoTpu-Next-Token",
+                                           token))
+                complete = (resp.headers.get("X-PrestoTpu-Complete",
+                                             "0") == "1")
+            if blob:
+                pages.append(blob)
+            if nxt == token and complete:
+                nbytes = sum(len(p) for p in pages)
+                _FETCH_BYTES.inc(nbytes)
+                if sp is not None:
+                    sp.attrs["pages"] = len(pages)
+                    sp.attrs["bytes"] = nbytes
+                return pages
+            token = nxt
+            if _time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"exchange fetch of {ref['task_id']}/"
+                    f"{ref['part']} timed out")
 
 
 def execute_fragment_task(engine, req: dict, store: dict,
@@ -337,10 +366,11 @@ class WorkerServer(HttpService):
             def _authorized(self) -> bool:
                 """Shared-secret check on every task/buffer endpoint
                 (reference InternalAuthenticationManager). /v1/status
-                stays open: the failure detector pings it and it leaks
-                only pool sizes."""
+                and /metrics stay open: the failure detector pings the
+                former, scrape collectors poll the latter, and both
+                leak only aggregate sizes."""
                 if outer.shared_secret is None \
-                        or self.path == "/v1/status":
+                        or self.path in ("/v1/status", "/metrics"):
                     return True
                 from presto_tpu.parallel import auth as _auth
                 tok = self.headers.get(_auth.HEADER)
@@ -354,6 +384,42 @@ class WorkerServer(HttpService):
                 if not self._authorized():
                     return
                 parts = self.path.strip("/").split("/")
+                if self.path == "/metrics":
+                    # worker-side gauges refresh at scrape time; the
+                    # text body is the process-wide shared registry
+                    with outer._lock:
+                        engines = list(outer._engines.values())
+                    pools = [e.memory_pool.info() for e in engines]
+                    g = REGISTRY.gauge(
+                        "presto_tpu_worker_cached_engines",
+                        "split-view engines cached on the worker")
+                    g.set(len(engines), node=outer.node_id)
+                    g = REGISTRY.gauge(
+                        "presto_tpu_worker_open_buffers",
+                        "task output buffers held by the worker")
+                    g.set(len(outer.buffers), node=outer.node_id)
+                    g = REGISTRY.gauge(
+                        "presto_tpu_memory_reserved_bytes",
+                        "runtime memory pool reservation")
+                    g.set(sum(p["reservedBytes"] for p in pools),
+                          node=outer.node_id)
+                    body = REGISTRY.render().encode()
+                    self.send_response(200)
+                    self.send_header(
+                        "Content-Type",
+                        "text/plain; version=0.0.4; charset=utf-8")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
+                if len(parts) == 3 and parts[:2] == ["v1", "trace"]:
+                    # per-trace span export for cross-process
+                    # collection: a collector (or the coordinator)
+                    # merges these into the query's unified trace
+                    self._send_json({"spans": [
+                        s.to_dict()
+                        for s in OT.TRACER.spans(parts[2])]})
+                    return
                 if self.path == "/v1/status":
                     # snapshot under the lock engine_factory inserts
                     # under: a status poll racing a task POST must not
@@ -387,6 +453,10 @@ class WorkerServer(HttpService):
                     except TaskFailed as tf:
                         self._send_json({"error": str(tf)}, 500)
                         return
+                    if blob:
+                        _EXCHANGE_PAGES.inc(node=outer.node_id)
+                        _EXCHANGE_BYTES.inc(len(blob),
+                                            node=outer.node_id)
                     self._send_bytes(blob or b"", extra_headers={
                         "X-PrestoTpu-Next-Token": str(nxt),
                         "X-PrestoTpu-Complete":
@@ -432,6 +502,12 @@ class WorkerServer(HttpService):
                     self._send_json({"error": "not found"}, 404)
                     return
                 req = self._read_json()
+                # propagated trace context: worker spans parent under
+                # the coordinator's task-dispatch span
+                ctx = OT.parse_context(
+                    self.headers.get(OT.TRACE_HEADER))
+                kind = "fragment" if "fragment" in req else "partial"
+                _TASKS.inc(node=outer.node_id, kind=kind)
                 try:
                     if "fragment" in req:
                         engine = engine_factory(
@@ -460,15 +536,33 @@ class WorkerServer(HttpService):
                                 "state": "running"}
 
                             def run_async(engine=engine, req=req,
-                                          tid=tid):
+                                          tid=tid, ctx=ctx):
+                                # re-attach the propagated context:
+                                # this thread inherits no contextvars
                                 try:
-                                    out = execute_fragment_task(
-                                        engine, req, outer.buffers,
-                                        secret=outer.shared_secret,
-                                        engine_lock=outer._task_lock)
+                                    with OT.TRACER.attach(
+                                            ctx, node=outer.node_id), \
+                                        OT.TRACER.span(
+                                            "worker-task",
+                                            task_id=tid,
+                                            kind="fragment",
+                                            mode="async"):
+                                        out = execute_fragment_task(
+                                            engine, req,
+                                            outer.buffers,
+                                            secret=(
+                                                outer.shared_secret),
+                                            engine_lock=(
+                                                outer._task_lock))
                                     outer.task_state[tid] = {
                                         "state": "finished", **out}
                                 except Exception as exc:  # noqa: BLE001
+                                    _TASK_FAILURES.inc(
+                                        node=outer.node_id)
+                                    LOG.log("task_failed",
+                                            node=outer.node_id,
+                                            task_id=tid,
+                                            error=repr(exc)[:500])
                                     buf = outer.buffers.get(tid)
                                     if buf is not None:
                                         buf.fail(repr(exc))
@@ -481,20 +575,35 @@ class WorkerServer(HttpService):
                             self._send_json({"taskId": tid,
                                              "state": "running"})
                             return
-                        out = execute_fragment_task(
-                            engine, req, outer.buffers,
-                            secret=outer.shared_secret,
-                            engine_lock=outer._task_lock)
+                        with OT.TRACER.attach(ctx,
+                                              node=outer.node_id), \
+                                OT.TRACER.span(
+                                    "worker-task",
+                                    task_id=str(tid or ""),
+                                    kind="fragment",
+                                    shard=int(req.get("shard", 0))):
+                            out = execute_fragment_task(
+                                engine, req, outer.buffers,
+                                secret=outer.shared_secret,
+                                engine_lock=outer._task_lock)
                         if isinstance(out, bytes):
                             self._send_bytes(out)
                         else:
                             self._send_json(out)
                         return
-                    out = execute_partial_task(
-                        engine_factory, req["sql"],
-                        int(req["shard"]), int(req["nshards"]))
+                    with OT.TRACER.attach(ctx, node=outer.node_id), \
+                            OT.TRACER.span(
+                                "worker-task", kind="partial",
+                                shard=int(req["shard"])):
+                        out = execute_partial_task(
+                            engine_factory, req["sql"],
+                            int(req["shard"]), int(req["nshards"]))
                     self._send_json(out)
                 except Exception as e:  # noqa: BLE001 - to coordinator
+                    _TASK_FAILURES.inc(node=outer.node_id)
+                    LOG.log("task_failed", node=outer.node_id,
+                            task_id=str(req.get("task_id") or ""),
+                            error=f"{type(e).__name__}: {e}")
                     self._send_json(
                         {"error": f"{type(e).__name__}: {e}"}, 500)
 

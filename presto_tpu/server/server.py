@@ -28,9 +28,28 @@ from concurrent.futures import ThreadPoolExecutor
 import numpy as np
 
 from presto_tpu import types as T
+from presto_tpu.obs.jsonlog import LOG
+from presto_tpu.obs.metrics import REGISTRY
+from presto_tpu.obs.trace import TRACER
 from presto_tpu.server.httpbase import HttpService, JsonHandler
 
 PAGE_ROWS = 4096
+
+# coordinator instruments (process-wide shared registry, obs/metrics).
+# The counters are REAL monotonic counters incremented at the state
+# transition — the old scrape-time recomputation from the bounded query
+# snapshot DECREASED when history evicted, which corrupts rate() on any
+# collector.
+_TRANSITIONS = REGISTRY.counter(
+    "presto_tpu_query_state_transitions_total",
+    "query state machine transitions, by entered state")
+_RESULT_ROWS = REGISTRY.counter(
+    "presto_tpu_result_rows_total", "rows returned by finished queries")
+_DURATION = REGISTRY.histogram(
+    "presto_tpu_query_duration_seconds",
+    "query wall time, start of execution to completion")
+_QUERIES_BY_STATE = REGISTRY.gauge(
+    "presto_tpu_queries", "tracked queries by current state")
 
 
 @dataclasses.dataclass
@@ -43,6 +62,9 @@ class QueryInfo:
     columns: list[dict] | None = None
     rows: list[list] | None = None
     created: float = dataclasses.field(default_factory=time.monotonic)
+    # wall-clock twin of ``created`` for the trace timeline (spans use
+    # wall time; ``created`` stays monotonic for duration math)
+    created_wall: float = dataclasses.field(default_factory=time.time)
     started: float | None = None
     finished: float | None = None
     rows_sent: int = 0
@@ -105,10 +127,14 @@ class QueryManager:
     (dispatcher/DispatchManager.java:189 selectGroup + submit)."""
 
     def __init__(self, engine, max_concurrency: int = 8,
-                 resource_groups=None):
+                 resource_groups=None, cluster=None):
         from presto_tpu.server.resource_groups import ResourceGroupManager
 
         self.engine = engine
+        # optional parallel.coordinator.ClusterCoordinator: SELECT
+        # queries then distribute over its HTTP workers instead of
+        # running on the local engine (trace context rides along)
+        self.cluster = cluster
         self.queries: dict[str, QueryInfo] = {}
         self.resource_groups = ResourceGroupManager(resource_groups)
         # the pool must cover every group's concurrency allowance or
@@ -134,6 +160,7 @@ class QueryManager:
         qid = f"{time.strftime('%Y%m%d_%H%M%S')}_{uuid.uuid4().hex[:5]}"
         q = QueryInfo(qid, sql, user,
                       session_properties=session_properties or {})
+        _TRANSITIONS.inc(state="queued")
         with self.lock:
             self.queries[qid] = q
         try:
@@ -164,6 +191,7 @@ class QueryManager:
                 if q.state != "CANCELED":
                     q.error = str(e)
                     q.state = "FAILED"
+                    _TRANSITIONS.inc(state="failed")
                 q.finished = time.monotonic()
                 self._tickets.pop(qid, None)
         return q
@@ -177,21 +205,44 @@ class QueryManager:
                 q.state = "RUNNING"
                 q.started = time.monotonic()
                 q.cancel_token = CancelToken()
-            try:
-                self._execute(q)
-                with self.lock:
-                    if q.state != "CANCELED":
-                        q.state = "FINISHED"
-            except QueryCanceled:
-                with self.lock:
-                    q.state = "CANCELED"
-            except Exception as e:  # noqa: BLE001 - surfaced to client
-                with self.lock:
-                    if q.state != "CANCELED":
-                        q.error = f"{type(e).__name__}: {e}"
-                        q.state = "FAILED"
-            finally:
-                q.finished = time.monotonic()
+            _TRANSITIONS.inc(state="running")
+            # the trace id IS the protocol query id: the root span of
+            # everything this query does on any node; GET
+            # /v1/query/{id}/trace exports the tree
+            with TRACER.trace(q.query_id, "query", user=q.user,
+                              sql=q.sql[:200],
+                              node="coordinator") as root:
+                TRACER.add_span("admission", q.created_wall,
+                                time.time())
+                try:
+                    self._execute(q)
+                    with self.lock:
+                        if q.state != "CANCELED":
+                            q.state = "FINISHED"
+                            _TRANSITIONS.inc(state="finished")
+                            _RESULT_ROWS.inc(len(q.rows or []))
+                            _DURATION.observe(
+                                time.monotonic() - q.started)
+                except QueryCanceled:
+                    with self.lock:
+                        # cancel() usually set the state (and counted
+                        # the transition) already; don't double-count
+                        if q.state != "CANCELED":
+                            q.state = "CANCELED"
+                            _TRANSITIONS.inc(state="canceled")
+                except Exception as e:  # noqa: BLE001 - to client
+                    root.attrs["error"] = f"{type(e).__name__}: {e}"
+                    with self.lock:
+                        if q.state != "CANCELED":
+                            q.error = f"{type(e).__name__}: {e}"
+                            q.state = "FAILED"
+                            _TRANSITIONS.inc(state="failed")
+                finally:
+                    q.finished = time.monotonic()
+            LOG.log("query", query_id=q.query_id, user=q.user,
+                    state=q.state,
+                    elapsed_ms=round((q.finished - q.started) * 1e3, 3),
+                    rows=len(q.rows or []), error=q.error)
         finally:
             with self.lock:
                 self._tickets.pop(q.query_id, None)
@@ -238,9 +289,17 @@ class QueryManager:
             q.rows = [[_json_value(v, T.VARCHAR) for v in row]
                       for row in rows]
             return
-        with self.engine.session.as_user(q.user, overrides):
-            table = self.engine.execute_table(q.sql,
-                                              cancel_token=q.cancel_token)
+        if self.cluster is not None:
+            # multi-host path: fragments ship to the cluster's HTTP
+            # workers; the root span's context rides the task POSTs.
+            # (Host-checkpoint cancellation applies between stages
+            # only; in-flight remote tasks run to completion.)
+            with self.engine.session.as_user(q.user, overrides):
+                table = self.cluster.execute_table(q.sql)
+        else:
+            with self.engine.session.as_user(q.user, overrides):
+                table = self.engine.execute_table(
+                    q.sql, cancel_token=q.cancel_token)
         q.warnings = [w.to_dict() for w in
                       getattr(self.engine, "last_warnings", [])]
         q.columns = [{"name": n, "type": str(c.dtype)}
@@ -267,6 +326,7 @@ class QueryManager:
             if q is None or q.state not in ("QUEUED", "RUNNING"):
                 return
             q.state = "CANCELED"
+            _TRANSITIONS.inc(state="canceled")
             q.finished = time.monotonic()
             # pop, don't get: a query canceled while still group-queued
             # never runs _run's finally, so leaving the entry here
@@ -332,41 +392,35 @@ class _Handler(JsonHandler):
     def _metrics_text(self) -> str:
         """Prometheus text exposition — the observability export the
         reference provides through JMX+REST (/v1/jmx/mbean; here the
-        standard scrape format so any collector can consume it)."""
+        standard scrape format). Counters/histograms accumulate in the
+        shared MetricsRegistry at the event sites; snapshot-derived
+        gauges refresh here at scrape time, then the whole registry
+        renders (the worker's /metrics renders the same registry)."""
         qs = self.manager.snapshot()
-        by_state: dict[str, int] = {}
-        dur_sum = 0.0
-        dur_count = 0
-        rows_sum = 0
-        for q in qs:
-            by_state[q.state] = by_state.get(q.state, 0) + 1
-            if q.finished is not None and q.started is not None:
-                dur_sum += q.finished - q.started
-                dur_count += 1
-                rows_sum += len(q.rows or [])
-        pool = self.manager.engine.memory_pool
-        lines = [
-            # per-state counts shrink when queries change state: gauge
-            "# TYPE presto_tpu_queries gauge",
-            *[f'presto_tpu_queries{{state="{s.lower()}"}} {n}'
-              for s, n in sorted(by_state.items())],
-            "# TYPE presto_tpu_query_duration_seconds summary",
-            f"presto_tpu_query_duration_seconds_sum {dur_sum:.6f}",
-            f"presto_tpu_query_duration_seconds_count {dur_count}",
-            "# TYPE presto_tpu_result_rows_total counter",
-            f"presto_tpu_result_rows_total {rows_sum}",
-            "# TYPE presto_tpu_memory_reserved_bytes gauge",
-            f"presto_tpu_memory_reserved_bytes {pool.reserved}",
-            "# TYPE presto_tpu_memory_capacity_bytes gauge",
-            f"presto_tpu_memory_capacity_bytes {pool.capacity}",
-            "# TYPE presto_tpu_compiled_programs gauge",
-            "presto_tpu_compiled_programs "
-            f"{len(self.manager.engine._program_cache)}",
-            "# TYPE presto_tpu_uptime_seconds gauge",
-            f"presto_tpu_uptime_seconds "
-            f"{time.time() - self.server_start:.1f}",
-        ]
-        return "\n".join(lines) + "\n"
+        for state in ("QUEUED", "RUNNING", "FINISHED", "FAILED",
+                      "CANCELED"):
+            _QUERIES_BY_STATE.set(
+                sum(q.state == state for q in qs),
+                state=state.lower())
+        info = self.manager.engine.memory_pool.info()
+        REGISTRY.gauge(
+            "presto_tpu_memory_reserved_bytes",
+            "runtime memory pool reservation").set(
+            info["reservedBytes"], node="coordinator")
+        REGISTRY.gauge(
+            "presto_tpu_memory_capacity_bytes",
+            "runtime memory pool capacity (0 = unbounded)").set(
+            info["capacityBytes"], node="coordinator")
+        REGISTRY.gauge(
+            "presto_tpu_compiled_programs",
+            "entries in the compiled-program cache").set(
+            len(self.manager.engine._program_cache),
+            node="coordinator")
+        REGISTRY.gauge(
+            "presto_tpu_uptime_seconds",
+            "seconds since server start").set(
+            time.time() - self.server_start, node="coordinator")
+        return REGISTRY.render()
 
     def _query_results(self, q: QueryInfo, token: int) -> dict:
         out: dict = {
@@ -503,6 +557,20 @@ class _Handler(JsonHandler):
                 for q in self.manager.snapshot()
                 if self._can_view(user, q)])
             return
+        if len(parts) == 4 and parts[:2] == ["v1", "query"] \
+                and parts[3] == "trace":
+            # Chrome trace-event JSON of the query's span tree
+            # (chrome://tracing / Perfetto loadable); owner-scoped like
+            # the other per-query endpoints
+            user = self._authenticated_user()
+            if user is None:
+                return
+            q = self.manager.get(parts[2])
+            if q is None or not self._can_view(user, q):
+                self._send_json({"error": "unknown query"}, 404)
+                return
+            self._send_json(TRACER.chrome_trace(q.query_id))
+            return
         if len(parts) == 3 and parts[:2] == ["v1", "query"]:
             user = self._authenticated_user()
             if user is None:
@@ -611,10 +679,11 @@ class CoordinatorServer(HttpService):
 
     def __init__(self, engine, host: str = "127.0.0.1", port: int = 0,
                  resource_groups=None, authenticator=None,
-                 tls: tuple[str, str] | None = None):
+                 tls: tuple[str, str] | None = None, cluster=None):
         handler = type("BoundHandler", (_Handler,), {
             "manager": QueryManager(engine,
-                                    resource_groups=resource_groups),
+                                    resource_groups=resource_groups,
+                                    cluster=cluster),
             "authenticator": authenticator,
             "uri_scheme": "https" if tls is not None else "http"})
         super().__init__(handler, host, port, tls=tls)
