@@ -28,10 +28,16 @@ ratio per query, not just Q1.
 
 Measurement order puts the JOIN queries first among details — rounds 3
 and 4 exhausted the budget before ever measuring a join at SF10
-(VERDICT r04 item 1); scan/agg q06 and deep-join q09 follow.
+(VERDICT r04 item 1). Q9 — the 6-relation join the cost-based
+reorderer (presto_tpu/cost/) exists for — gets a RESERVED budget slice
+ahead of lower-priority q06: five consecutive rounds reported it
+"skipped: bench time budget exhausted" because everything before it
+consumed the budget; now q03/q05 may not eat into its reserve and q06
+runs last on whatever remains.
 
 Env knobs: PRESTO_TPU_BENCH_SF (default 10), PRESTO_TPU_BENCH_REPS (2),
-PRESTO_TPU_BENCH_BUDGET_S (default 600), PRESTO_TPU_TPCH_CACHE (default
+PRESTO_TPU_BENCH_BUDGET_S (default 600), PRESTO_TPU_BENCH_Q9_RESERVE_S
+(default 150 — Q9's guaranteed slice), PRESTO_TPU_TPCH_CACHE (default
 /tmp/presto_tpu_tpch_cache — table datagen cache; generated on first
 run, ~4 min at SF10, fast raw-npy load afterwards).
 """
@@ -167,6 +173,47 @@ def numpy_q3(li, orders, cust_building) -> float:
     return time.perf_counter() - t0
 
 
+def numpy_q9(li, ps, orders, supp, green_part) -> float:
+    """Vectorized NumPy Q9: the 6-relation profit join (part,
+    supplier, lineitem, partsupp, orders, nation) via dense key
+    lookups + a sorted composite-key merge into partsupp — the
+    single-threaded CPU best case the reorderer's Q9 number is graded
+    against."""
+    t0 = time.perf_counter()
+    lm = green_part[li["l_partkey"]]
+    lpart = li["l_partkey"][lm]
+    lsupp = li["l_suppkey"][lm]
+    lord = li["l_orderkey"][lm]
+    # partsupp lookup by composite (partkey, suppkey)
+    smax = int(ps["ps_suppkey"].max()) + 1
+    pskey = ps["ps_partkey"].astype(np.int64) * smax + ps["ps_suppkey"]
+    order = np.argsort(pskey)
+    pskey_sorted = pskey[order]
+    cost_sorted = ps["ps_supplycost"][order]
+    probe = lpart.astype(np.int64) * smax + lsupp
+    pos = np.clip(np.searchsorted(pskey_sorted, probe), 0,
+                  len(pskey_sorted) - 1)
+    supplycost = cost_sorted[pos]
+    # orders lookup: order year by o_orderkey (sorted merge)
+    osort = np.argsort(orders["o_orderkey"])
+    oks = orders["o_orderkey"][osort]
+    years = (orders["o_orderdate"][osort]
+             .astype("datetime64[D]").astype("datetime64[Y]")
+             .astype(np.int64) + 1970)
+    year = years[np.clip(np.searchsorted(oks, lord), 0, len(oks) - 1)]
+    # supplier -> nation, dense by suppkey
+    snat = np.zeros(int(supp["s_suppkey"].max()) + 1, dtype=np.int64)
+    snat[supp["s_suppkey"]] = supp["s_nationkey"]
+    nat = snat[lsupp]
+    amount = (li["l_extendedprice"][lm].astype(np.float64)
+              * (100 - li["l_discount"][lm])
+              - supplycost.astype(np.float64) * li["l_quantity"][lm])
+    gid = nat * 4096 + (year - 1970)
+    uniq, inv = np.unique(gid, return_inverse=True)
+    np.bincount(inv, weights=amount, minlength=len(uniq))
+    return time.perf_counter() - t0
+
+
 def numpy_q5(li, orders, cust, supp, asia_nations) -> float:
     """Vectorized NumPy Q5: six-way star join via searchsorted."""
     t0 = time.perf_counter()
@@ -281,13 +328,33 @@ def main() -> None:
                                                cust_building), 2)
         detail["q05_numpy_s"] = round(numpy_q5(li, orders, cust, supp,
                                                asia_nations), 2)
-        del li, orders, cust, supp
+        # Q9 baseline: 6-relation profit join over the green parts
+        li9 = _cols(lineitem, ("l_orderkey", "l_partkey", "l_suppkey",
+                               "l_quantity", "l_extendedprice",
+                               "l_discount"))
+        ps = _cols(tpch.table("partsupp"),
+                   ("ps_partkey", "ps_suppkey", "ps_supplycost"))
+        pnames = _strs(tpch.table("part"), "p_name")
+        pkeys = np.asarray(tpch.table("part").columns["p_partkey"].data)
+        green_part = np.zeros(int(pkeys.max()) + 1, dtype=bool)
+        green_part[pkeys[np.char.find(pnames.astype("U"),
+                                      "green") >= 0]] = True
+        detail["q09_numpy_s"] = round(numpy_q9(li9, ps, orders, supp,
+                                               green_part), 2)
+        del li, li9, ps, orders, cust, supp
     except Exception as exc:  # baseline failure must not kill bench
         detail["numpy_join_baseline_error"] = repr(exc)[:200]
 
-    # detail queries, JOINS FIRST (q03/q05 are the driver's metric)
-    for name in ("q03", "q05", "q06", "q09"):
+    # detail queries, JOINS FIRST (q03/q05 are the driver's metric).
+    # q09 runs BEFORE q06 and holds a reserved slice the earlier
+    # queries may not consume — five rounds in a row it was skipped as
+    # "bench time budget exhausted" without ever being measured.
+    q9_reserve = float(os.environ.get("PRESTO_TPU_BENCH_Q9_RESERVE_S",
+                                      "150"))
+    for name in ("q03", "q05", "q09", "q06"):
         left = budget - (time.perf_counter() - t_start)
+        if name in ("q03", "q05"):
+            left -= q9_reserve  # keep q09's slice untouchable
         if left <= 60:
             detail[f"{name}_skipped"] = "bench time budget exhausted"
             continue

@@ -195,9 +195,11 @@ class Engine:
         return plan, analysis
 
     def explain(self, sql: str) -> str:
+        from presto_tpu.cost import explain_estimates
         from presto_tpu.plan.printer import format_plan
         plan, _ = self.plan_sql(sql)
-        return format_plan(plan)
+        return format_plan(plan,
+                           estimates=explain_estimates(plan, self))
 
     # -- internals ----------------------------------------------------------
 
@@ -263,8 +265,10 @@ class Engine:
                 return [(explain_analyze(self, plan),)]
             inner = stmt.statement
             if isinstance(inner, A.QueryStatement):
+                from presto_tpu.cost import explain_estimates
                 plan = self._plan_query(inner.query)
-                return [(format_plan(plan),)]
+                return [(format_plan(
+                    plan, estimates=explain_estimates(plan, self)),)]
             raise ValueError("EXPLAIN of non-query statements unsupported")
 
         if isinstance(stmt, A.StartTransaction):

@@ -18,6 +18,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from presto_tpu.cost import row_estimates
 from presto_tpu.exec.executor import PlanInterpreter, collect_scans
 from presto_tpu.obs.trace import TRACER
 from presto_tpu.plan import nodes as N
@@ -83,6 +84,7 @@ def _explain_one_program(engine, plan: N.PlanNode,
         scan_inputs = collect_scans(plan, engine)
     capacities: dict[tuple, int] = {}
     annotations: dict[int, str] = {}
+    estimated = row_estimates(plan, engine)
 
     for _attempt in range(10):
         meta: dict[str, object] = {}
@@ -121,8 +123,14 @@ def _explain_one_program(engine, plan: N.PlanNode,
     else:
         raise RuntimeError("hash table capacity retry limit exceeded")
 
+    # estimated-vs-actual rows per node: estimation bugs show up in
+    # one place (reference PlanPrinter's EXPLAIN ANALYZE estimate
+    # columns)
     for nid, c in zip(meta["count_nodes"], counts):
-        annotations[nid] = f"rows: {int(np.asarray(c))}"
+        actual = int(np.asarray(c))
+        est = estimated.get(nid)
+        annotations[nid] = (f"rows: {actual}" if est is None
+                            else f"rows: {actual} (est {est})")
     header = (f"Query plan (compile {compile_s * 1e3:.1f} ms, "
               f"execute {run_s * 1e3:.1f} ms)\n")
     return header + format_plan(plan, annotations=annotations)
@@ -136,8 +144,10 @@ def explain_analyze_distributed(engine, plan: N.PlanNode, mesh) -> str:
 
     profile: dict = {}
     execute_plan_distributed(engine, plan, mesh, profile=profile)
+    estimated = row_estimates(plan, engine)
     annotations = {
-        nid: f"rows: {rows} [{dist}]"
+        nid: (f"rows: {rows} [{dist}]" if estimated.get(nid) is None
+              else f"rows: {rows} (est {estimated[nid]}) [{dist}]")
         for nid, (rows, dist) in profile["node_rows"].items()}
     header = (f"Distributed plan over {mesh.devices.size} devices "
               f"(compile {profile['compile_s'] * 1e3:.1f} ms, "

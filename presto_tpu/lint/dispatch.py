@@ -43,6 +43,10 @@ SITES: dict[str, tuple[str, str]] = {
     "presto_tpu/plan/serde.py": ("register", "_register"),
     "presto_tpu/plan/fingerprint.py": ("generic", ""),
     "presto_tpu/exec/executor.py": ("method-prefix", "_r_"),
+    # StatsCalculator's per-node estimation rules: a PlanNode without a
+    # stats rule would silently fall to the unknown-estimate default
+    # and poison join ordering
+    "presto_tpu/cost/stats.py": ("method-prefix", "_s_"),
 }
 
 

@@ -238,7 +238,9 @@ class ClusterCoordinator:
                 fragment_join_plan, fragment_plan_general)
             general = fragment_plan_general(
                 plan, mode=str(self.engine.session.get(
-                    "join_distribution_type") or "automatic").lower())
+                    "join_distribution_type") or "automatic").lower(),
+                broadcast_threshold=int(self.engine.session.get(
+                    "broadcast_join_threshold_rows")))
             def _with_failover(run):
                 """Node loss mid-stage loses that query's buffers; the
                 whole stage DAG retries ONCE on the surviving workers

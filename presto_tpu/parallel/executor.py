@@ -48,6 +48,7 @@ _SHARD_MAP_NOCHECK = (
 
 from presto_tpu import types as T
 from presto_tpu.block import Column, Table
+from presto_tpu.cost.model import decide_join_distribution
 from presto_tpu.exec import operators as OP
 from presto_tpu.exec.executor import (PlanInterpreter, ScanInput,
                                       collect_scans)
@@ -223,21 +224,16 @@ class ShardedInterpreter:
 
     def _join_partitioned(self, node: N.Join) -> bool:
         """Broadcast-vs-partitioned distribution choice, analog of the
-        reference's DetermineJoinDistributionType (AUTOMATIC mode uses
-        the planner's build-side estimate against the session
-        threshold)."""
-        if node.distribution == "broadcast":
-            return False
-        if node.distribution == "partitioned":
-            return True
-        mode = str(self.session.get("join_distribution_type")).upper()
-        if mode == "BROADCAST":
-            return False
-        if mode == "PARTITIONED":
-            return True
-        threshold = self.session.get("broadcast_join_threshold_rows")
-        return (node.build_rows is not None
-                and node.build_rows > threshold)
+        reference's DetermineJoinDistributionType — delegated to the
+        cost model's SINGLE decision (cost/model.py), the same one the
+        fragmenter and the ReorderJoins rule consult, so the runtime
+        and the stage cutter cannot disagree about a join."""
+        return decide_join_distribution(
+            node.distribution,
+            str(self.session.get("join_distribution_type")),
+            node.build_rows,
+            int(self.session.get("broadcast_join_threshold_rows")),
+        ) == "partitioned"
 
     # -- leaves -------------------------------------------------------------
 

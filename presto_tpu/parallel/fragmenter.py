@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import dataclasses
 
+from presto_tpu.cost.model import decide_join_distribution
 from presto_tpu.plan import nodes as N
 
 
@@ -98,13 +99,14 @@ class GeneralFragmentedPlan:
     last_stage: str
 
 
-# builds at or under this estimated row count broadcast instead of
-# repartitioning both sides (DetermineJoinDistributionType's
-# AUTOMATIC broadcast cutoff analog)
-BROADCAST_ROWS = 1 << 20
+# the broadcast cutoff lives in the cost model (cost/model.py
+# decide_join_distribution — the SAME decision the runtime executor
+# and the ReorderJoins rule consult, so fragmenter and runtime can no
+# longer disagree about a join's distribution)
 
 
-def fragment_plan_general(plan: N.PlanNode, mode: str = "automatic"
+def fragment_plan_general(plan: N.PlanNode, mode: str = "automatic",
+                          broadcast_threshold: int | None = None
                           ) -> GeneralFragmentedPlan | None:
     """Recursively stage an arbitrary join/semijoin/aggregate plan for
     multi-host execution (reference PlanFragmenter.createSubPlans +
@@ -112,16 +114,18 @@ def fragment_plan_general(plan: N.PlanNode, mode: str = "automatic"
     SPINE (probe chain from the fact scan up to the top aggregate)
     stays row-split or hash-partitioned across workers; every build /
     filter / scalar side becomes its own stage, broadcast when small,
-    co-partitioned when large. Returns None when the plan shape cannot
-    distribute."""
+    co-partitioned when large (the session's
+    broadcast_join_threshold_rows when the coordinator passes it).
+    Returns None when the plan shape cannot distribute."""
     try:
-        return _fragment_general(plan, mode)
+        return _fragment_general(plan, mode, broadcast_threshold)
     except NotDistributable:
         return None
 
 
-def _fragment_general(plan: N.PlanNode,
-                      mode: str = "automatic") -> GeneralFragmentedPlan:
+def _fragment_general(plan: N.PlanNode, mode: str = "automatic",
+                      broadcast_threshold: int | None = None
+                      ) -> GeneralFragmentedPlan:
     # walk the coordinator-side root chain down to the top Aggregate /
     # window chain
     node = plan
@@ -270,14 +274,9 @@ def _fragment_general(plan: N.PlanNode,
                 # PARTITIONED distribution)
                 raise NotDistributable()
             left, dist = lower(node.left, sources, allow_cut)
-            if full or node.distribution == "partitioned" \
-                    or mode == "partitioned":
-                small = False
-            elif node.distribution == "broadcast" \
-                    or mode == "broadcast":
-                small = True
-            else:
-                small = (node.build_rows or 0) <= BROADCAST_ROWS
+            small = not full and decide_join_distribution(
+                node.distribution, mode, node.build_rows,
+                broadcast_threshold) == "broadcast"
             if small or not node.criteria or not allow_cut:
                 sname, stypes = lower_side(node.right)
                 scan = exchange_scan(fresh("x"), stypes)

@@ -19,13 +19,12 @@ from __future__ import annotations
 import dataclasses
 
 from presto_tpu import types as T
+# span-width eligibility is a cost-model decision (HBM for the
+# direct-address table vs probe savings); the thresholds live with the
+# other physical-choice gates in cost/model.py
+from presto_tpu.cost.model import (MAX_SPAN, MAX_SPAN_FACTOR,  # noqa: F401
+                                   dense_span_eligible as _eligible_span)
 from presto_tpu.plan import nodes as N
-
-# widest direct-address table the executor will allocate (slots)
-MAX_SPAN = 1 << 24
-# and the widest relative to the build side (avoid 16M-slot tables for
-# 100-row builds)
-MAX_SPAN_FACTOR = 16
 
 
 def _scan_ranges(node: N.TableScan, engine) -> dict[str, tuple]:
@@ -209,16 +208,6 @@ def reduce_group_keys(keys: list[str], fds: dict[str, set]) -> list:
                     covered.add(dep)
                     frontier.append(dep)
     return kept
-
-
-def _eligible_span(rng: tuple, build_rows: int | None) -> bool:
-    lo, hi = rng
-    span = hi - lo + 1
-    if span <= 0 or span > MAX_SPAN:
-        return False
-    if build_rows and span > max(MAX_SPAN_FACTOR * build_rows, 4096):
-        return False
-    return True
 
 
 def _int_typed(types: dict, sym: str) -> bool:

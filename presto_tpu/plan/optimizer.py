@@ -23,12 +23,19 @@ from presto_tpu.plan import nodes as N
 
 def optimize(plan: N.PlanNode, engine,
              enable_latemat: bool | None = None) -> N.PlanNode:
+    from presto_tpu.cost.reorder import reorder_joins
     from presto_tpu.plan.dense import annotate_dense
     from presto_tpu.plan.latemat import late_materialize
     from presto_tpu.plan.rules import apply_rules
     plan = apply_rules(plan)
     plan = prune_columns(plan)
     plan = inline_trivial_projects(plan)
+    # cost-based join reordering over the pruned shapes (session
+    # optimizer_join_reordering_strategy; cost/reorder.py) — before
+    # scan-filter pushdown so connector stats still see plain table
+    # names, and before dense/latemat so their annotations apply to
+    # the final join order
+    plan = reorder_joins(plan, engine)
     # physical-choice annotation needs final plan shapes; late
     # materialization needs its fd_keys annotations, then re-prunes (the
     # narrowed aggregate source drops dependent columns) and

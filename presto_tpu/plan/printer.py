@@ -10,14 +10,23 @@ from presto_tpu.plan import nodes as N
 
 
 def format_plan(node: N.PlanNode, indent: int = 0,
-                annotations: dict[int, str] | None = None) -> str:
+                annotations: dict[int, str] | None = None,
+                estimates: dict[int, str] | None = None) -> str:
+    """Indented operator tree. ``annotations`` appends bracketed
+    per-node details on the node line (EXPLAIN ANALYZE row counts);
+    ``estimates`` adds an indented per-node detail line (EXPLAIN's
+    'Estimates: {rows, bytes, cpu/memory/network}', reference
+    planprinter/PlanPrinter.formatEstimates — build the map with
+    cost.explain_estimates)."""
     pad = " " * (4 * indent)
     line = pad + _describe(node)
     if annotations and id(node) in annotations:
         line += f"  [{annotations[id(node)]}]"
     parts = [line]
+    if estimates and id(node) in estimates:
+        parts.append(pad + "    " + estimates[id(node)])
     for s in node.sources():
-        parts.append(format_plan(s, indent + 1, annotations))
+        parts.append(format_plan(s, indent + 1, annotations, estimates))
     return "\n".join(parts)
 
 

@@ -34,7 +34,20 @@ SYSTEM_SESSION_PROPERTIES: dict[str, tuple[Any, type, str]] = {
                                "DetermineJoinDistributionType)"),
     "broadcast_join_threshold_rows": (1 << 20, int,
                                       "AUTOMATIC: max build rows for "
-                                      "broadcast joins"),
+                                      "broadcast joins (consulted "
+                                      "through the cost model's single "
+                                      "decision, cost/model.py)"),
+    "optimizer_join_reordering_strategy": (
+        "AUTOMATIC", str,
+        "AUTOMATIC (cost-based DP reorder, cost/reorder.py) | "
+        "ELIMINATE_CROSS_JOINS (keep planner order, refresh "
+        "estimates) | NONE (reference "
+        "SystemSessionProperties.JOIN_REORDERING_STRATEGY)"),
+    "cost_estimation_worst_case_ratio": (
+        8.0, float,
+        "cap on expanding-join output estimates relative to the larger "
+        "input when key statistics are unknown (bounds worst-case "
+        "plans picked off bad estimates)"),
     "partitioned_agg_min_groups": (1 << 15, int,
                                    "min estimated groups before a "
                                    "distributed aggregate hash-repartitions "
