@@ -29,10 +29,14 @@ class Engine:
 
         self.session = session or Session()
         self.catalogs: dict[str, Connector] = {}
-        # compiled-program cache + per-plan successful capacity vectors
-        # (exec/executor.py prepare_plan; reference analog:
-        # gen/PageFunctionCompiler.java:101 compiled-artifact caches)
-        self._program_cache: dict = {}
+        # compiled-program cache: size-bounded LRU fronting an optional
+        # persistent AOT disk store (exec/progcache.py; reference
+        # analog: gen/PageFunctionCompiler.java:101 compiled-artifact
+        # caches). Per-plan successful capacity vectors ride alongside.
+        from presto_tpu.exec.progcache import ProgramCache
+        self._program_cache = ProgramCache(
+            max_entries=int(self.session.get("program_cache_entries")
+                            or 64))
         self._caps_memory: dict = {}
         # host->device transfer cache: id(np array) -> (host ref, dev
         # array). The strong host ref pins the id; repeat executions of
@@ -42,6 +46,12 @@ class Engine:
         self._dev_cache: dict = {}
         self._dev_cache_bytes = 0
         self.dev_cache_limit = 8 << 30  # HBM budget for pinned inputs
+        # parallel segment compilation uploads scan arrays from pool
+        # threads concurrently; the pin cache + byte ledger + eviction
+        # loop must not interleave (two threads popping the same
+        # oldest key is a KeyError)
+        import threading as _t
+        self._dev_cache_lock = _t.Lock()
         # runtime memory ledger: per-program tagged reservations of
         # actual input+output array bytes (memory/MemoryPool.java:44);
         # capacity 0 = unbounded (set memory_pool.capacity to enforce)
@@ -83,22 +93,31 @@ class Engine:
         """Device copy of a host scan array, cached so repeat
         executions reuse HBM-resident inputs instead of re-uploading
         (the reference keeps pages pooled in worker memory). The
-        strong host ref pins the id key; FIFO eviction bounds HBM."""
+        strong host ref pins the id key; FIFO eviction bounds HBM.
+        Thread-safe: parallel segment compilation uploads from pool
+        threads concurrently. The transfer itself runs OUTSIDE the
+        lock so one wave's uploads overlap (a lost race uploads a
+        duplicate once and keeps the first copy — benign)."""
         import jax
         if not isinstance(a, np.ndarray):
             return a  # already a device array (segment carriers)
-        hit = self._dev_cache.get(id(a))
-        if hit is not None and hit[0] is a:
-            return hit[1]
+        with self._dev_cache_lock:
+            hit = self._dev_cache.get(id(a))
+            if hit is not None and hit[0] is a:
+                return hit[1]
         dev = jax.device_put(a)
-        self._dev_cache[id(a)] = (a, dev)
-        self._dev_cache_bytes += a.nbytes
-        while (self._dev_cache_bytes > self.dev_cache_limit
-               and len(self._dev_cache) > 1):
-            k = next(iter(self._dev_cache))
-            old, _old_dev = self._dev_cache.pop(k)
-            self._dev_cache_bytes -= old.nbytes
-        return dev
+        with self._dev_cache_lock:
+            hit = self._dev_cache.get(id(a))
+            if hit is not None and hit[0] is a:
+                return hit[1]  # raced: keep the published copy
+            self._dev_cache[id(a)] = (a, dev)
+            self._dev_cache_bytes += a.nbytes
+            while (self._dev_cache_bytes > self.dev_cache_limit
+                   and len(self._dev_cache) > 1):
+                k = next(iter(self._dev_cache))
+                old, _old_dev = self._dev_cache.pop(k)
+                self._dev_cache_bytes -= old.nbytes
+            return dev
 
     # -- SQL entry points ---------------------------------------------------
 
@@ -244,8 +263,9 @@ class Engine:
                 self.invalidate_device_cache()
 
     def invalidate_device_cache(self) -> None:
-        self._dev_cache.clear()
-        self._dev_cache_bytes = 0
+        with self._dev_cache_lock:
+            self._dev_cache.clear()
+            self._dev_cache_bytes = 0
 
     def _execute_statement_inner(self, stmt, mesh=None) -> list[tuple]:
         from presto_tpu.plan.printer import format_plan

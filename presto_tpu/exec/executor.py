@@ -228,15 +228,21 @@ class PlanInterpreter:
     def _capacity(self, node, default: int, kind: str = "table",
                   override: int | None = None) -> int:
         """Host retry override > session override > planner hint >
-        default."""
+        default. Planner hints are normalized through next_pow2 so
+        used_capacity / overflow-retry keys stay pow2-canonical even
+        for hand-written non-pow2 hints (cache-entry MERGING of nearby
+        hints happens upstream: cost/reorder.py writes pow2-bucketed
+        hints, which is what the plan fingerprint hashes)."""
         cap = self.capacities.get(self._node_key(node, kind))
         if cap is None:
             if override:
                 cap = next_pow2(override)
             elif kind == "table":
-                cap = getattr(node, "capacity", None) or default
+                hint = getattr(node, "capacity", None)
+                cap = next_pow2(hint) if hint else default
             elif kind == "out":
-                cap = getattr(node, "output_capacity", None) or default
+                hint = getattr(node, "output_capacity", None)
+                cap = next_pow2(hint) if hint else default
             else:
                 cap = default
         self.used_capacity[self._node_key(node, kind)] = cap
@@ -396,11 +402,17 @@ class PlanInterpreter:
 
 
 def make_traced(scan_inputs: list[ScanInput], plan: N.PlanNode,
-                capacities: dict[int, int], session=None):
+                capacities: dict[int, int], session=None,
+                interp_factory=None):
     """Build (traced_fn, flat_example_args, meta). ``traced_fn`` is a pure
     jittable function from flat scan arrays to
     (result columns, live mask, ok flags); ``meta`` is populated at trace
-    time with output schema and hash-capacity bookkeeping."""
+    time with output schema and hash-capacity bookkeeping.
+
+    ``interp_factory`` substitutes a PlanInterpreter subclass; when the
+    interpreter records ``row_counts`` (EXPLAIN ANALYZE's
+    ProfilingInterpreter) the traced function returns them as a fourth
+    output and ``meta["count_nodes"]`` lists the node ids."""
     flat_arrays = [
         scan.arrays[sym] for scan in scan_inputs for sym in scan.arrays]
     meta: dict[str, object] = {}
@@ -412,7 +424,8 @@ def make_traced(scan_inputs: list[ScanInput], plan: N.PlanNode,
         for scan in scan_inputs:
             traced = {sym: next(it) for sym in scan.arrays}
             scans[id(scan.node)] = (scan, traced)
-        interp = PlanInterpreter(scans, capacities, session, node_order)
+        interp = (interp_factory or PlanInterpreter)(
+            scans, capacities, session, node_order)
         out = interp.run(plan)
         meta["out"] = [
             (sym, v.dtype, v.dictionary, v.valid is not None)
@@ -434,6 +447,11 @@ def make_traced(scan_inputs: list[ScanInput], plan: N.PlanNode,
         # tunneled device), a (k,) bool array costs one total
         oks = (jnp.stack(interp.ok_flags) if interp.ok_flags
                else jnp.zeros((0,), dtype=bool))
+        row_counts = getattr(interp, "row_counts", None)
+        if row_counts is not None:
+            meta["count_nodes"] = [nid for nid, _ in row_counts]
+            return (tuple(res), out.live_mask(), oks,
+                    tuple(c for _, c in row_counts))
         return tuple(res), out.live_mask(), oks
 
     return traced_fn, flat_arrays, meta
@@ -480,14 +498,25 @@ RETRY_GROWTH = 4  # overshoot on overflow to bound recompiles at ~1
 
 
 def _cache_key(engine, plan, scan_inputs, capacities):
+    """Canonical program-cache key: (plan fingerprint, input shapes,
+    trace-relevant session properties) + pow2-bucketed capacity
+    overrides (exec/progcache.py). The session component resolves
+    through Session.get, so per-thread query overrides participate;
+    properties the trace never reads (host-side limits, planner
+    strategies already captured by the fingerprint) stay out so
+    replans under unrelated SET SESSIONs keep hitting."""
+    from presto_tpu.exec import progcache as PC
     from presto_tpu.plan.fingerprint import plan_fingerprint
     fp = plan_fingerprint(plan)
     shapes = tuple(
         (sym, a.shape, str(a.dtype))
         for scan in scan_inputs for sym, a in scan.arrays.items())
-    sess = tuple(sorted(
-        (k, repr(v)) for k, v in engine.session.properties.items()))
-    return (fp, shapes, sess), tuple(sorted(capacities.items()))
+    sess = PC.trace_session_key(engine.session)
+    # dictionary CONTENT digests: traced programs embed dictionary
+    # codes as constants, so a data rewrite at constant shape must
+    # miss (the persistent store outlives process restarts)
+    dicts = PC.scan_dictionary_key(scan_inputs)
+    return (fp, shapes, dicts, sess), PC.bucket_capacities(capacities)
 
 
 def prepare_plan(engine, plan: N.PlanNode, scan_inputs: list[ScanInput]):
@@ -503,15 +532,28 @@ def prepare_plan(engine, plan: N.PlanNode, scan_inputs: list[ScanInput]):
     On overflow, EVERY failed capacity grows RETRY_GROWTH x at once
     (host-side analog of the reference's rehash,
     MultiChannelGroupByHash.java:140, overshooting to bound the number
-    of recompiles instead of doubling per node)."""
+    of recompiles instead of doubling per node).
+
+    The cache is two-tier (exec/progcache.py): the in-memory LRU
+    fronts a persistent AOT disk store (PRESTO_TPU_PROGRAM_CACHE_DIR),
+    so a warm process — or another worker sharing the directory —
+    deserializes the executable instead of paying lower+compile, and
+    the persisted capacity sidecar skips the overflow-retry ladder."""
+    from presto_tpu.exec import progcache as PC
+    fpr = PC.platform_fingerprint()
+    cache = engine._program_cache
+    cache.configure(engine.session)
     base_key, _ = _cache_key(engine, plan, scan_inputs, {})
-    capacities = dict(engine._caps_memory.get(base_key, {}))
+    known_caps = engine._caps_memory.get(base_key)
+    if known_caps is None:  # {} is a real answer: no overrides needed
+        known_caps = cache.load_caps(base_key, fpr)
+    capacities = dict(known_caps)
 
     from presto_tpu.exec.cancel import checkpoint
     for _attempt in range(6):
         checkpoint()
-        caps_key = tuple(sorted(capacities.items()))
-        entry = engine._program_cache.get((base_key, caps_key))
+        caps_key = PC.bucket_capacities(capacities)
+        entry = cache.lookup((base_key, caps_key), fpr)
         flat_arrays = [
             engine.device_array(scan.arrays[sym])
             if getattr(scan, "cache_device", False) else scan.arrays[sym]
@@ -534,7 +576,11 @@ def prepare_plan(engine, plan: N.PlanNode, scan_inputs: list[ScanInput]):
                 print(f"[compile] {compile_s:.1f}s "
                       f"caps={dict(capacities)} "
                       f"root={type(plan).__name__}", file=sys.stderr)
-            engine._program_cache[(base_key, caps_key)] = (compiled, meta)
+            # memory tier only for now: failed capacity-retry rungs
+            # must not pay serialize+IO (and would pollute the store);
+            # the disk persist happens below, on the successful attempt
+            cache.insert((base_key, caps_key), compiled, meta, fpr,
+                         persist=False)
             cache_hit = False
         else:
             compiled, meta = entry
@@ -546,8 +592,16 @@ def prepare_plan(engine, plan: N.PlanNode, scan_inputs: list[ScanInput]):
             # device time, not just call overhead
             oks_np = np.asarray(oks)
         if oks_np.all():
+            if not cache_hit:
+                cache.insert((base_key, caps_key), compiled, meta, fpr)
+            if engine._caps_memory.get(base_key) != capacities:
+                cache.store_caps(base_key, capacities, fpr)
             engine._caps_memory[base_key] = dict(capacities)
             return compiled, flat_arrays, meta, (res, live, oks)
+        if not cache_hit:
+            # a failed rung's program is dead weight in the bounded
+            # LRU: future runs jump straight to the successful caps
+            cache.discard((base_key, caps_key))
         for key, okv in zip(meta["ok_keys"], oks_np):
             if not okv:
                 capacities[key] = (RETRY_GROWTH
@@ -690,14 +744,11 @@ def _compact_kernel(live, data, cap: int):
 _compact_jit = jax.jit(_compact_kernel, static_argnames=("cap",))
 
 
-def run_plan_device(engine, plan: N.PlanNode,
-                    scan_inputs: list["ScanInput"]):
-    """Like run_plan but keeps results as DEVICE arrays (segment
-    handoff): returns (arrays incl. $valid/__live__, dicts, types, n).
-    Outputs compact to pow2(live count) when that at least halves the
-    buffer, so later segments never churn through dead padding."""
-    _c, _f, meta, (res, live, _oks) = prepare_plan(
-        engine, plan, scan_inputs)
+def device_outputs(meta, res, live):
+    """Unpack one program's (meta, res, live) into segment-carrier form
+    (arrays incl. $valid/__live__, dicts, types, n). Outputs compact to
+    pow2(live count) when that at least halves the buffer, so later
+    segments never churn through dead padding."""
     arrays: dict = {}
     dicts: dict = {}
     types: dict = {}
@@ -723,45 +774,131 @@ def run_plan_device(engine, plan: N.PlanNode,
     return arrays, dicts, types, n
 
 
+def run_plan_device(engine, plan: N.PlanNode,
+                    scan_inputs: list["ScanInput"]):
+    """Like run_plan but keeps results as DEVICE arrays (segment
+    handoff); see device_outputs. Returns (arrays, dicts, types, n,
+    per-node rows=None) — the runner contract of _segment_carriers."""
+    _c, _f, meta, (res, live, _oks) = prepare_plan(
+        engine, plan, scan_inputs)
+    return device_outputs(meta, res, live) + (None,)
+
+
+def _contains_carrier(node: N.PlanNode, names: set[str]) -> bool:
+    """Does a subtree scan any of the named __segment__ carriers?"""
+    if isinstance(node, N.TableScan):
+        return node.catalog == "__segment__" and node.table in names
+    return any(_contains_carrier(s, names) for s in node.sources())
+
+
 def _segment_carriers(engine, plan: N.PlanNode, pool_tag: str,
-                      observer=None):
+                      observer=None, runner=None):
     """Materialize many-join subtrees as device-resident carrier scans
     until the remaining plan fits one program. Returns the rewritten
     plan + carrier inputs. Carrier bytes are reserved under
     ``pool_tag`` (freed by the caller when the pipeline finishes).
-    ``observer(seg, mat, arrays, n, wall_s)`` fires after each segment
-    materializes — EXPLAIN ANALYZE's per-segment attribution hooks in
-    here so profiling always follows the real execution's split/prune
-    sequence."""
+
+    Segments are discovered structurally WAVE by wave: every split the
+    current plan yields that does not consume a carrier of the same
+    wave is mutually independent, so the wave's segments compile and
+    execute concurrently on a bounded thread pool (session
+    ``parallel_compile_width``; XLA compilation releases the GIL). A
+    split that scans a same-wave carrier closes the wave — dependency
+    order between waves is preserved exactly as the old serial loop.
+
+    ``runner(engine, mat, scans) -> (arrays, dicts, types, n,
+    node_rows)`` substitutes the per-segment executor (EXPLAIN ANALYZE
+    passes a profiling runner); ``observer(seg, mat, arrays, n,
+    wall_s, node_rows)`` fires per materialized segment, in segment
+    order."""
+    from presto_tpu.exec import progcache as PC
     from presto_tpu.exec.streaming import _replace_node
 
     pool = getattr(engine, "memory_pool", None)
+    run = runner or run_plan_device
+    width = max(1, int(engine.session.get("parallel_compile_width")
+                       or 1))
+    if pool is not None and pool.capacity:
+        # an enforced memory budget needs the serial guarantee: each
+        # segment's reservation must be able to fail BEFORE the next
+        # segment materializes device buffers — concurrent waves could
+        # overshoot the budget by (width-1) intermediates
+        width = 1
     carriers: dict[int, ScanInput] = {}
     seg = 0
     while True:
-        sub = _find_split(plan, engine)
-        if sub is None:
+        # -- discover one wave of independent segments structurally --
+        wave: list[tuple] = []  # (sub, mat, cnode)
+        wave_names: set[str] = set()
+        probe = plan
+        while True:
+            sub = _find_split(probe, engine)
+            if sub is None or _contains_carrier(sub, wave_names):
+                break
+            needed = _needed_above(probe, sub)
+            mat = sub  # what actually materializes (possibly narrowed)
+            if needed is not None and needed < set(sub.output_symbols):
+                mat = _prune_subtree(sub, needed)
+            name = f"s{seg + len(wave)}"
+            cnode = N.TableScan("__segment__", name,
+                                {s: s for s in mat.output_symbols},
+                                dict(mat.output_types()))
+            probe = _replace_node(probe, sub, cnode)
+            wave.append((sub, mat, cnode))
+            wave_names.add(name)
+        if not wave:
             break
-        needed = _needed_above(plan, sub)
-        mat = sub  # what actually materializes (possibly narrowed)
-        if needed is not None and needed < set(sub.output_symbols):
-            mat = _prune_subtree(sub, needed)
-        scans = _collect_with_carriers(mat, engine, carriers)
-        _t0 = time.perf_counter()
-        with TRACER.span("segment", index=seg):
-            arrays, dicts, types, n = run_plan_device(engine, mat,
-                                                      scans)
-        if pool is not None:
-            pool.reserve(pool_tag, sum(
-                int(a.nbytes) for a in arrays.values()))
-        if observer is not None:
-            observer(seg, mat, arrays, n,
-                     time.perf_counter() - _t0)
-        cnode = N.TableScan("__segment__", f"s{seg}",
-                            {s: s for s in types}, types)
-        seg += 1
-        carriers[id(cnode)] = ScanInput(cnode, arrays, dicts, types, n)
-        plan = _replace_node(plan, sub, cnode)
+
+        # -- materialize the wave (parallel when independent > 1) ----
+        # pool threads inherit neither threading.locals nor
+        # contextvars: hand over the cancel token, the per-thread
+        # session override (HTTP queries compile under the submitter's
+        # property overrides), and the trace context (spans otherwise
+        # vanish for every parallel-compiled segment)
+        from presto_tpu.exec import cancel as _cancel
+        from presto_tpu.obs import trace as _ot
+        from presto_tpu.session import (current_override,
+                                        install_override)
+        _tok = _cancel.current()
+        _ov = current_override()
+        _ctx = _ot.current_context()
+
+        def _materialize(item):
+            idx, mat = item
+            _cancel.install(_tok)
+            install_override(_ov)
+            scans = _collect_with_carriers(mat, engine, carriers)
+            _t0 = time.perf_counter()
+            with TRACER.attach(_ctx), \
+                    TRACER.span("segment", index=seg + idx,
+                                wave_width=len(wave)):
+                out = run(engine, mat, scans)
+            if pool is not None:
+                # reserve inside the job, as the serial loop did: an
+                # over-budget pipeline must raise MemoryLimitExceeded
+                # before FURTHER segments materialize (with width=1
+                # this is exactly the old segment-by-segment guard)
+                pool.reserve(pool_tag, sum(
+                    int(a.nbytes) for a in out[0].values()))
+            return out + (time.perf_counter() - _t0,)
+
+        results = PC.map_parallel(
+            _materialize,
+            [(i, mat) for i, (_s, mat, _c) in enumerate(wave)], width)
+
+        for (_sub, mat, cnode), (arrays, dicts, types, n, node_rows,
+                                 wall_s) in zip(wave, results):
+            if observer is not None:
+                observer(seg, mat, arrays, n, wall_s, node_rows)
+            carriers[id(cnode)] = ScanInput(cnode, arrays, dicts,
+                                            types, n)
+            seg += 1
+        # adopt the wave's fully-spliced tree: _replace_node rebuilds
+        # every interior node, so re-splicing wave items 2..n into the
+        # ORIGINAL plan would miss (their identity only exists in
+        # ``probe``); the carrier leaves keep identity through later
+        # splices, which is what _collect_with_carriers keys on
+        plan = probe
     return plan, carriers
 
 

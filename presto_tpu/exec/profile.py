@@ -7,6 +7,12 @@ time is not individually observable the way the reference times each
 getOutput/addInput call; instead the profile reports what the fused model
 can: actual row counts flowing out of every plan node (emitted as extra
 kernel outputs), plus compile and execute wall times for the whole plan.
+
+Segmented plans (exec/executor.py _find_split) profile per SEGMENT: each
+separately compiled segment re-runs under a profiling interpreter, so
+per-node actual rows — including pruned probe TableScans, the numbers
+the dynamic-filter effectiveness tests read — surface on every segment's
+plan, not just the final program.
 """
 
 from __future__ import annotations
@@ -19,15 +25,17 @@ import jax.numpy as jnp
 import numpy as np
 
 from presto_tpu.cost import row_estimates
-from presto_tpu.exec.executor import PlanInterpreter, collect_scans
+from presto_tpu.exec.executor import (PlanInterpreter, collect_scans,
+                                      device_outputs, make_traced)
 from presto_tpu.obs.trace import TRACER
 from presto_tpu.plan import nodes as N
 from presto_tpu.plan.printer import format_plan
 
 
 class ProfilingInterpreter(PlanInterpreter):
-    def __init__(self, scans, capacities, session=None):
-        super().__init__(scans, capacities, session)
+    def __init__(self, scans, capacities, session=None,
+                 node_order=None):
+        super().__init__(scans, capacities, session, node_order)
         self.row_counts: list[tuple[int, object]] = []
 
     def run(self, node: N.PlanNode):
@@ -37,33 +45,100 @@ class ProfilingInterpreter(PlanInterpreter):
         return dt
 
 
+def _profiled_compile_run(engine, plan, scans):
+    """Shared EXPLAIN ANALYZE ladder: trace under a
+    ProfilingInterpreter, compile OUTSIDE the program cache (the extra
+    row-count outputs must not shadow production entries), and retry
+    on hash-table overflow. The capacity vector is SEEDED from what
+    prepare_plan already learned for this plan (memory or the caps
+    sidecar), so profiling does not replay the overflow ladder with an
+    extra 80-150 s compile per rung. Returns
+    (meta, res, live, counts, compile_s, run_s) of the successful
+    attempt."""
+    from presto_tpu.exec import executor as EX
+    from presto_tpu.exec import progcache as PC
+
+    base_key, _ = EX._cache_key(engine, plan, scans, {})
+    known = engine._caps_memory.get(base_key)
+    if known is None:
+        known = engine._program_cache.load_caps(
+            base_key, PC.platform_fingerprint())
+    capacities: dict[tuple, int] = dict(known)
+    for _attempt in range(10):
+        traced_fn, flat, meta = make_traced(
+            scans, plan, capacities, engine.session,
+            interp_factory=ProfilingInterpreter)
+        t0 = time.perf_counter()
+        with TRACER.span("compile", analyze=True):
+            compiled = jax.jit(traced_fn).lower(*flat).compile()
+        compile_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        with TRACER.span("execute", analyze=True):
+            res, live, oks, counts = compiled(*flat)
+            jax.block_until_ready(live)
+            oks_np = np.asarray(oks)
+        run_s = time.perf_counter() - t0
+        if oks_np.all():
+            return meta, res, live, counts, compile_s, run_s
+        for key, okv in zip(meta["ok_keys"], oks_np):
+            if not okv:
+                capacities[key] = 4 * meta["used_capacity"][key]
+    raise RuntimeError("hash table capacity retry limit exceeded")
+
+
+def _profiled_runner(engine, mat, scans):
+    """run_plan_device twin for segments: returns (arrays, dicts,
+    types, n, {node id: actual rows})."""
+    meta, res, live, counts, _c, _r = _profiled_compile_run(
+        engine, mat, scans)
+    node_rows = {nid: int(np.asarray(c))
+                 for nid, c in zip(meta["count_nodes"], counts)}
+    return device_outputs(meta, res, live) + (node_rows,)
+
+
+def _annotate(mat, node_rows: dict | None, engine) -> dict[int, str]:
+    """Per-node 'rows: actual (est N)' annotations for one segment."""
+    if not node_rows:
+        return {}
+    try:
+        estimated = row_estimates(mat, engine)
+    except Exception:  # noqa: BLE001 - carrier scans may lack stats
+        estimated = {}
+    return {nid: (f"rows: {actual}" if estimated.get(nid) is None
+                  else f"rows: {actual} (est {estimated[nid]})")
+            for nid, actual in node_rows.items()}
+
+
 def explain_analyze(engine, plan: N.PlanNode) -> str:
     """EXPLAIN ANALYZE with PER-SEGMENT wall-clock attribution: each
     separately compiled segment (many-join splits + pre-aggregation
     compaction boundaries, exec/executor.py _find_split) reports its
-    own execute wall and output width, and the final program adds
-    per-node row counts. Per-operator walls inside one segment are not
-    observable under XLA fusion; the segment boundary is the real unit
-    of time on this engine (reference analog:
-    operator/OperationTimer.java:30 rolled up per operator,
-    ExplainAnalyzeOperator.java:34)."""
+    own execute wall, output width, AND per-node actual row counts
+    (profiling runner); the final program adds its own row counts.
+    Per-operator walls inside one segment are not observable under XLA
+    fusion; the segment boundary is the real unit of time on this
+    engine (reference analog: operator/OperationTimer.java:30 rolled
+    up per operator, ExplainAnalyzeOperator.java:34)."""
     from presto_tpu.exec import executor as EX
 
     seg_lines: list[str] = []
     total_t0 = time.perf_counter()
 
-    def observe(seg, mat, arrays, n, wall_s):
+    def observe(seg, mat, arrays, n, wall_s, node_rows):
         live = int(np.asarray(jnp.sum(arrays["__live__"])))
         seg_lines.append(
             f"Segment {seg} ({wall_s * 1e3:.1f} ms, "
             f"{live} live rows -> s{seg}[{n}])\n"
-            + format_plan(mat))
+            + format_plan(mat,
+                          annotations=_annotate(mat, node_rows,
+                                                engine)))
 
     pool = getattr(engine, "memory_pool", None)
     tag = "explain-" + uuid.uuid4().hex[:12]
     try:
         plan, carriers = EX._segment_carriers(engine, plan, tag,
-                                              observer=observe)
+                                              observer=observe,
+                                              runner=_profiled_runner)
         scan_inputs = EX._collect_with_carriers(plan, engine, carriers)
         final = _explain_one_program(engine, plan, scan_inputs)
     finally:
@@ -82,46 +157,10 @@ def _explain_one_program(engine, plan: N.PlanNode,
                          scan_inputs=None) -> str:
     if scan_inputs is None:
         scan_inputs = collect_scans(plan, engine)
-    capacities: dict[tuple, int] = {}
     annotations: dict[int, str] = {}
     estimated = row_estimates(plan, engine)
-
-    for _attempt in range(10):
-        meta: dict[str, object] = {}
-
-        def traced_fn(*args):
-            it = iter(args)
-            scans = {}
-            for scan in scan_inputs:
-                traced = {sym: next(it) for sym in scan.arrays}
-                scans[id(scan.node)] = (scan, traced)
-            interp = ProfilingInterpreter(scans, capacities,
-                                          engine.session)
-            out = interp.run(plan)
-            meta["ok_keys"] = interp.ok_keys
-            meta["used_capacity"] = interp.used_capacity
-            meta["count_nodes"] = [nid for nid, _ in interp.row_counts]
-            counts = tuple(c for _, c in interp.row_counts)
-            return out.live_mask(), counts, tuple(interp.ok_flags)
-
-        flat_arrays = [scan.arrays[sym] for scan in scan_inputs
-                       for sym in scan.arrays]
-        t0 = time.perf_counter()
-        with TRACER.span("compile", analyze=True):
-            compiled = jax.jit(traced_fn).lower(*flat_arrays).compile()
-        compile_s = time.perf_counter() - t0
-        t0 = time.perf_counter()
-        with TRACER.span("execute", analyze=True):
-            live, counts, oks = compiled(*flat_arrays)
-            jax.block_until_ready(live)
-        run_s = time.perf_counter() - t0
-        if all(bool(np.asarray(o)) for o in oks):
-            break
-        for key, okv in zip(meta["ok_keys"], oks):
-            if not bool(np.asarray(okv)):
-                capacities[key] = 4 * meta["used_capacity"][key]
-    else:
-        raise RuntimeError("hash table capacity retry limit exceeded")
+    meta, _res, _live, counts, compile_s, run_s = \
+        _profiled_compile_run(engine, plan, scan_inputs)
 
     # estimated-vs-actual rows per node: estimation bugs show up in
     # one place (reference PlanPrinter's EXPLAIN ANALYZE estimate

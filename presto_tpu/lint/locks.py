@@ -37,6 +37,7 @@ LOCK_SCOPES = (
     "presto_tpu/memory.py",
     "presto_tpu/obs/",
     "presto_tpu/events.py",
+    "presto_tpu/exec/progcache.py",
 )
 
 _LOCK_NAME_RE = re.compile(

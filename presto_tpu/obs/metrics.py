@@ -165,6 +165,11 @@ class Histogram(_Metric):
             row = self._series.get(_label_key(labels))
             return 0 if row is None else row[len(self.buckets)]
 
+    def sum(self, **labels) -> float:
+        with self._lock:
+            row = self._series.get(_label_key(labels))
+            return 0.0 if row is None else row[-1]
+
     def render(self) -> list[str]:
         with self._lock:
             items = sorted((k, list(v)) for k, v in self._series.items())

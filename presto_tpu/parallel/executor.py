@@ -51,7 +51,7 @@ from presto_tpu.block import Column, Table
 from presto_tpu.cost.model import decide_join_distribution
 from presto_tpu.exec import operators as OP
 from presto_tpu.exec.executor import (PlanInterpreter, ScanInput,
-                                      collect_scans)
+                                      collect_scans, preorder_index)
 from presto_tpu.exec.operators import DTable
 from presto_tpu.expr.compile import Val
 from presto_tpu.obs.trace import TRACER as _TRACER
@@ -102,10 +102,12 @@ class ShardedInterpreter:
     intermediate and collectives at distribution boundaries."""
 
     def __init__(self, scans, capacities, nshards: int,
-                 session: Session | None = None):
+                 session: Session | None = None,
+                 node_order: dict[int, int] | None = None):
         self.scans = scans
         self.capacities = capacities
         self.nshards = nshards
+        self.node_order = node_order or {}
         self.session = session or Session()
         self.ok_flags: list = []
         self.ok_keys: list[tuple] = []
@@ -122,31 +124,42 @@ class ShardedInterpreter:
 
     # -- plumbing shared with the local interpreter -------------------------
 
+    def _node_key(self, node, kind: str) -> tuple:
+        # stable preorder positions (falling back to id for nodes built
+        # during interpretation): capacity vectors and overflow retry
+        # keys survive replans AND process restarts, so the persistent
+        # program cache's capacity sidecar stays meaningful
+        return (self.node_order.get(id(node), id(node)), kind)
+
     def _capacity(self, node, default: int, kind: str = "table",
                   override: int | None = None) -> int:
         """Static capacity for a hash table / exchange bucket: host retry
         override > session override > planner hint > default. Planner
         hints are global-table-sized, so only the whole-table kinds read
         them — per-shard structures (exchange buckets, partitioned
-        tables) must use their own per-shard defaults."""
-        cap = self.capacities.get((id(node), kind))
+        tables) must use their own per-shard defaults. Hints are
+        normalized through next_pow2 so capacity vectors and
+        overflow-retry keys stay pow2-canonical."""
+        cap = self.capacities.get(self._node_key(node, kind))
         if cap is None:
             if override:
                 cap = next_pow2(override)
             elif kind == "table":
-                cap = getattr(node, "capacity", None) or default
+                hint = getattr(node, "capacity", None)
+                cap = next_pow2(hint) if hint else default
             elif kind == "out":
-                cap = getattr(node, "output_capacity", None) or default
+                hint = getattr(node, "output_capacity", None)
+                cap = next_pow2(hint) if hint else default
             else:
                 cap = default
-        self.used_capacity[(id(node), kind)] = cap
+        self.used_capacity[self._node_key(node, kind)] = cap
         return cap
 
     def _note_ok(self, node, ok, kind: str = "table"):
         # reduce over the mesh so every shard's overflow is reported
         self.ok_flags.append(
             jax.lax.pmin(ok.astype(jnp.int32), AXIS) > 0)
-        self.ok_keys.append((id(node), kind))
+        self.ok_keys.append(self._node_key(node, kind))
 
     def run(self, node: N.PlanNode) -> DistTable:
         m = getattr(self, "_r_" + type(node).__name__.lower())
@@ -695,11 +708,25 @@ def execute_plan_distributed(engine, plan: N.PlanNode,
                              ) -> Table:
     """Compile + run a logical plan over every device in ``mesh``.
     ``profile`` (EXPLAIN ANALYZE) is filled with per-node mesh-global
-    row counts and compile/run wall times."""
+    row counts and compile/run wall times.
+
+    shard_map programs go through the same two-tier program cache as
+    the local executor (exec/progcache.py): keyed by plan fingerprint,
+    sharded input shapes, scan partitioning, trace-relevant session
+    properties, and pow2-bucketed capacities, with the mesh shape in
+    the platform fingerprint — so a repeat distributed query (or a
+    warm process sharing the disk store) skips lower+compile. EXPLAIN
+    ANALYZE (``profile``) bypasses the cache: its row-count outputs
+    change the program."""
     import time as _time
+
+    from presto_tpu.exec import progcache as PC
+    from presto_tpu.exec.executor import _COMPILES, _COMPILE_SECONDS
+    from presto_tpu.plan.fingerprint import plan_fingerprint
+
     nshards = mesh.devices.size
     scan_inputs = collect_scans(plan, engine)
-    capacities: dict[tuple, int] = {}
+    node_order = preorder_index(plan)
 
     use_part = bool(engine.session.get("use_connector_partitioning"))
     sharded_arrays = []
@@ -716,49 +743,80 @@ def execute_plan_distributed(engine, plan: N.PlanNode,
                   for sym in arrs]
     flat_arrays = [sharded_arrays[i][sym] for i, sym in flat_names]
 
+    use_cache = profile is None
+    fpr = PC.platform_fingerprint(
+        mesh_shape=(tuple(mesh.devices.shape),
+                    tuple(mesh.axis_names)))
+    cache = engine._program_cache
+    base_key = (
+        plan_fingerprint(plan),
+        tuple((i, sym, a.shape, str(a.dtype))
+              for (i, sym), a in zip(flat_names, flat_arrays)),
+        PC.scan_dictionary_key(scan_inputs),
+        PC.trace_session_key(engine.session),
+        tuple((i, scan.part_cols, bool(scan.bucketed))
+              for i, scan in enumerate(scan_inputs)),
+        "shard_map", nshards)
+    capacities: dict[tuple, int] = {}
+    if use_cache:
+        cache.configure(engine.session)
+        known_caps = engine._caps_memory.get(base_key)
+        if known_caps is None:  # {} is a real answer: no overrides
+            known_caps = cache.load_caps(base_key, fpr)
+        capacities = dict(known_caps)
+
     for _attempt in range(10):
-        meta: dict[str, object] = {}
+        caps_key = PC.bucket_capacities(capacities)
+        entry = (cache.lookup((base_key, caps_key), fpr)
+                 if use_cache else None)
+        lowered = None
+        if entry is not None:
+            compiled, meta = entry
+            compile_s = 0.0
+        else:
+            meta: dict[str, object] = {}
 
-        def traced_fn(*args):
-            it = iter(args)
-            scans = {}
-            per_scan: dict[int, dict] = {}
-            for (i, sym), a in zip(flat_names, it):
-                per_scan.setdefault(i, {})[sym] = a
-            for i, scan in enumerate(scan_inputs):
-                scans[id(scan.node)] = (scan, per_scan[i])
-            interp = ShardedInterpreter(scans, capacities, nshards,
-                                        engine.session)
-            interp.collect_counts = profile is not None
-            out = interp.run(plan).dt
-            meta["out"] = [
-                (sym, v.dtype, v.dictionary, v.valid is not None)
-                for sym, v in out.cols.items()]
-            meta["ok_keys"] = interp.ok_keys
-            meta["used_capacity"] = interp.used_capacity
-            meta["count_nodes"] = [
-                (nid, dist) for nid, _, dist in interp.row_counts]
-            res = []
-            for sym, v in out.cols.items():
-                res.append(v.data)
-                res.append(v.valid if v.valid is not None
-                           else jnp.ones((out.n,), dtype=bool))
-            counts = tuple(c for _, c, _ in interp.row_counts)
-            return (tuple(res), out.live_mask(),
-                    tuple(interp.ok_flags), counts)
+            def traced_fn(*args):
+                it = iter(args)
+                scans = {}
+                per_scan: dict[int, dict] = {}
+                for (i, sym), a in zip(flat_names, it):
+                    per_scan.setdefault(i, {})[sym] = a
+                for i, scan in enumerate(scan_inputs):
+                    scans[id(scan.node)] = (scan, per_scan[i])
+                interp = ShardedInterpreter(scans, capacities, nshards,
+                                            engine.session, node_order)
+                interp.collect_counts = profile is not None
+                out = interp.run(plan).dt
+                meta["out"] = [
+                    (sym, v.dtype, v.dictionary, v.valid is not None)
+                    for sym, v in out.cols.items()]
+                meta["ok_keys"] = interp.ok_keys
+                meta["used_capacity"] = interp.used_capacity
+                meta["count_nodes"] = [
+                    (nid, dist) for nid, _, dist in interp.row_counts]
+                res = []
+                for sym, v in out.cols.items():
+                    res.append(v.data)
+                    res.append(v.valid if v.valid is not None
+                               else jnp.ones((out.n,), dtype=bool))
+                counts = tuple(c for _, c, _ in interp.row_counts)
+                return (tuple(res), out.live_mask(),
+                        tuple(interp.ok_flags), counts)
 
-        n_out = None  # resolved after trace
-        sharded = _shard_map(
-            traced_fn, mesh=mesh,
-            in_specs=tuple(P(AXIS) for _ in flat_arrays),
-            out_specs=(P(), P(), P(), P()),
-            **_SHARD_MAP_NOCHECK)
-        t0 = _time.perf_counter()
-        with _TRACER.span("compile", devices=nshards,
-                          distributed=True):
-            lowered = jax.jit(sharded).lower(*flat_arrays)
-            compiled = lowered.compile()
-        compile_s = _time.perf_counter() - t0
+            sharded = _shard_map(
+                traced_fn, mesh=mesh,
+                in_specs=tuple(P(AXIS) for _ in flat_arrays),
+                out_specs=(P(), P(), P(), P()),
+                **_SHARD_MAP_NOCHECK)
+            t0 = _time.perf_counter()
+            with _TRACER.span("compile", devices=nshards,
+                              distributed=True):
+                lowered = jax.jit(sharded).lower(*flat_arrays)
+                compiled = lowered.compile()
+            compile_s = _time.perf_counter() - t0
+            _COMPILES.inc()
+            _COMPILE_SECONDS.observe(compile_s)
         t0 = _time.perf_counter()
         with _TRACER.span("execute", devices=nshards,
                           distributed=True):
@@ -766,8 +824,19 @@ def execute_plan_distributed(engine, plan: N.PlanNode,
                 res, live, oks, node_counts = compiled(*flat_arrays)
             jax.block_until_ready(live)
         run_s = _time.perf_counter() - t0
-        del n_out
         if all(bool(np.asarray(o)) for o in oks):
+            if use_cache:
+                if lowered is not None:
+                    # as_text materializes the whole module — pay it
+                    # once, on the successful attempt, and keep the
+                    # text with the entry so cache hits (and warm
+                    # processes) still surface last_dist_hlo
+                    meta["hlo"] = lowered.as_text()
+                    cache.insert((base_key, caps_key), compiled, meta,
+                                 fpr)
+                if engine._caps_memory.get(base_key) != capacities:
+                    cache.store_caps(base_key, capacities, fpr)
+                engine._caps_memory[base_key] = dict(capacities)
             break
         for key, okv in zip(meta["ok_keys"], oks):
             if not bool(np.asarray(okv)):
@@ -775,10 +844,10 @@ def execute_plan_distributed(engine, plan: N.PlanNode,
     else:
         raise RuntimeError("hash table capacity retry limit exceeded")
 
-    # introspection for tests/EXPLAIN (successful attempt only — as_text
-    # materializes the whole module, so keep it off the retry path):
-    # the distribution strategy is visible as collectives in the program
-    engine.last_dist_hlo = lowered.as_text()
+    # introspection for tests/EXPLAIN: the distribution strategy is
+    # visible as collectives in the program text
+    engine.last_dist_hlo = meta.get("hlo") or (
+        lowered.as_text() if lowered is not None else "")
     engine.last_dist_meta = {"used_capacity": dict(meta["used_capacity"])}
     if profile is not None:
         profile["compile_s"] = compile_s

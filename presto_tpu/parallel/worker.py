@@ -399,6 +399,12 @@ class WorkerServer(HttpService):
                         "task output buffers held by the worker")
                     g.set(len(outer.buffers), node=outer.node_id)
                     g = REGISTRY.gauge(
+                        "presto_tpu_worker_program_cache_entries",
+                        "compiled programs resident across the "
+                        "worker's cached engines (exec/progcache.py)")
+                    g.set(sum(len(e._program_cache) for e in engines),
+                          node=outer.node_id)
+                    g = REGISTRY.gauge(
                         "presto_tpu_memory_reserved_bytes",
                         "runtime memory pool reservation")
                     g.set(sum(p["reservedBytes"] for p in pools),

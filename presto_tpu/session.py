@@ -115,6 +115,20 @@ SYSTEM_SESSION_PROPERTIES: dict[str, tuple[Any, type, str]] = {
                              "fail queries the multi-host coordinator "
                              "cannot distribute instead of silently "
                              "running them on the local engine"),
+    "program_cache_entries": (64, int,
+                              "max compiled XLA programs held in the "
+                              "engine's in-memory LRU program cache "
+                              "(exec/progcache.py; the persistent "
+                              "disk store at "
+                              "PRESTO_TPU_PROGRAM_CACHE_DIR is "
+                              "bounded separately by "
+                              "PRESTO_TPU_PROGRAM_CACHE_DISK_BYTES)"),
+    "parallel_compile_width": (4, int,
+                               "max concurrent XLA compilations for "
+                               "independent plan segments (1 = "
+                               "serial; XLA compilation releases the "
+                               "GIL, so a wave of independent "
+                               "segments compiles in parallel)"),
 }
 
 
@@ -163,6 +177,19 @@ class Session:
 
     def set(self, name: str, value: Any) -> None:
         self.properties[name] = coerce_property(name, value)
+
+
+def current_override() -> tuple:
+    """Snapshot of the calling thread's (user, properties) override —
+    hand it to worker threads that trace/compile on behalf of a query
+    (ThreadPoolExecutor threads share no threading.local state)."""
+    return (getattr(_USER_OVERRIDE, "user", None),
+            getattr(_USER_OVERRIDE, "properties", None))
+
+
+def install_override(ov: tuple) -> None:
+    """Install a current_override() snapshot on this thread."""
+    _USER_OVERRIDE.user, _USER_OVERRIDE.properties = ov
 
 
 def coerce_property(name: str, value: Any) -> Any:

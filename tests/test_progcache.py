@@ -1,0 +1,341 @@
+"""Compile-latency subsystem (exec/progcache.py): cache-key hygiene,
+LRU bounding + metrics, persistent AOT disk store (fresh-process warm
+start with ZERO XLA compiles), corruption fallback, and cross-worker
+disk-store sharing."""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from presto_tpu import Engine
+from presto_tpu import types as T
+from presto_tpu.connectors.memory import MemoryConnector
+from presto_tpu.exec import executor as ex
+from presto_tpu.exec import progcache as PC
+from presto_tpu.obs.metrics import REGISTRY
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_COMPILED = REGISTRY.counter("presto_tpu_programs_compiled_total")
+_HITS = REGISTRY.counter("presto_tpu_program_cache_hits_total")
+_MISSES = REGISTRY.counter("presto_tpu_program_cache_misses_total")
+_EVICTIONS = REGISTRY.counter(
+    "presto_tpu_program_cache_evictions_total")
+_DISK_ERRORS = REGISTRY.counter(
+    "presto_tpu_program_cache_disk_errors_total")
+
+
+def mem_engine(nrows: int = 4096, cache_dir=None) -> Engine:
+    if cache_dir is not None:
+        os.environ[PC.ENV_DIR] = str(cache_dir)
+    conn = MemoryConnector()
+    conn.create_table(
+        "t", {"k": T.BIGINT, "v": T.BIGINT},
+        {"k": np.arange(nrows) % 7, "v": np.arange(nrows)})
+    e = Engine()
+    e.register_catalog("mem", conn)
+    e.session.catalog = "mem"
+    return e
+
+
+# -- cache-key hygiene -------------------------------------------------------
+
+def test_key_stable_across_replans(tpch_tiny):
+    e = Engine()
+    e.register_catalog("tpch", tpch_tiny)
+    sql = "select count(*) from lineitem where l_quantity < 10"
+    p1, _ = e.plan_sql(sql)
+    p2, _ = e.plan_sql(sql)
+    s1 = ex.collect_scans(p1, e)
+    s2 = ex.collect_scans(p2, e)
+    assert ex._cache_key(e, p1, s1, {}) == ex._cache_key(e, p2, s2, {})
+
+
+def test_key_changes_with_plan_fingerprint(tpch_tiny):
+    e = Engine()
+    e.register_catalog("tpch", tpch_tiny)
+    p1, _ = e.plan_sql("select count(*) from lineitem")
+    p2, _ = e.plan_sql("select count(*) from orders")
+    k1 = ex._cache_key(e, p1, ex.collect_scans(p1, e), {})
+    k2 = ex._cache_key(e, p2, ex.collect_scans(p2, e), {})
+    assert k1 != k2
+
+
+def test_key_tracks_trace_relevant_session_only(tpch_tiny):
+    e = Engine()
+    e.register_catalog("tpch", tpch_tiny)
+    plan, _ = e.plan_sql("select count(*) from lineitem")
+    scans = ex.collect_scans(plan, e)
+    base = ex._cache_key(e, plan, scans, {})
+    # host-side limit: not read at trace time, must NOT shift the key
+    e.session.set("query_max_run_time", 123.0)
+    assert ex._cache_key(e, plan, scans, {}) == base
+    # dynamic filtering changes the traced program: MUST shift the key
+    e.session.set("enable_dynamic_filtering", False)
+    assert ex._cache_key(e, plan, scans, {}) != base
+
+
+def test_trace_relevant_properties_cover_interpreter_reads():
+    """Drift guard for the canonical session key: every session
+    property the trace-time interpreters read MUST be in
+    TRACE_RELEVANT_PROPERTIES, or two queries differing only in that
+    property would share one cached program."""
+    import ast
+
+    reads: set[str] = set()
+    scopes = (
+        (os.path.join(REPO, "presto_tpu", "exec", "executor.py"),
+         {"PlanInterpreter"}),
+        (os.path.join(REPO, "presto_tpu", "parallel", "executor.py"),
+         {"ShardedInterpreter"}),
+    )
+    for path, classes in scopes:
+        with open(path, encoding="utf-8") as f:
+            tree = ast.parse(f.read())
+        for node in tree.body:
+            if not (isinstance(node, ast.ClassDef)
+                    and node.name in classes):
+                continue
+            for sub in ast.walk(node):
+                if (isinstance(sub, ast.Call)
+                        and isinstance(sub.func, ast.Attribute)
+                        and sub.func.attr == "get"
+                        and isinstance(sub.func.value, ast.Attribute)
+                        and sub.func.value.attr == "session"
+                        and sub.args
+                        and isinstance(sub.args[0], ast.Constant)):
+                    reads.add(sub.args[0].value)
+    assert reads, "no interpreter session reads found — scope drifted"
+    missing = reads - set(PC.TRACE_RELEVANT_PROPERTIES)
+    assert not missing, (
+        f"trace-time session reads missing from the program-cache "
+        f"key: {sorted(missing)}")
+
+
+def test_key_changes_with_dictionary_content():
+    """Traced programs embed dictionary codes as constants, so a data
+    rewrite at constant shape/dtype must MISS — the disk store
+    outlives process restarts, where identity-based invalidation
+    cannot reach."""
+    def key_for(values):
+        conn = MemoryConnector()
+        conn.create_table(
+            "t", {"s": T.VARCHAR, "v": T.BIGINT},
+            {"s": np.array(values, object), "v": np.arange(3)})
+        e = Engine()
+        e.register_catalog("mem", conn)
+        e.session.catalog = "mem"
+        plan, _ = e.plan_sql("select s, sum(v) from t group by s")
+        return ex._cache_key(e, plan, ex.collect_scans(plan, e), {})
+
+    assert key_for(["a", "b", "a"]) == key_for(["a", "b", "a"])
+    assert key_for(["a", "b", "a"]) != key_for(["a", "c", "a"])
+
+
+def test_capacities_bucket_to_pow2():
+    k = (3, "table")
+    assert PC.bucket_capacities({k: 100}) == PC.bucket_capacities(
+        {k: 128})
+    assert PC.bucket_capacities({k: 100}) != PC.bucket_capacities(
+        {k: 300})
+    # the bucketed value is what the trace uses, so idempotence matters
+    assert PC.bucket_capacities({k: 128}) == ((k, 128),)
+
+
+def test_digest_changes_with_platform_and_mesh():
+    key = ("fp", (), ())
+    local = PC.platform_fingerprint()
+    meshed = PC.platform_fingerprint(mesh_shape=((8,), ("d",)))
+    assert PC.entry_digest(key, local) != PC.entry_digest(key, meshed)
+    other_ver = ("jax-9.9.9",) + tuple(local[1:])
+    assert PC.entry_digest(key, local) != PC.entry_digest(
+        key, other_ver)
+    assert PC.entry_digest(key, local) == PC.entry_digest(key, local)
+
+
+# -- LRU bounding + metrics --------------------------------------------------
+
+def test_lru_bounds_entries_and_counts_evictions():
+    cache = PC.ProgramCache(max_entries=2, disk_dir=None)
+    ev0 = _EVICTIONS.value()
+    for i in range(4):
+        cache.insert(("k", i), object(), {"i": i}, persist=False)
+    assert len(cache) == 2
+    assert _EVICTIONS.value() - ev0 == 2
+    # LRU order: 0 and 1 evicted, 2 and 3 resident
+    m0 = _MISSES.value()
+    assert cache.lookup(("k", 0)) is None
+    assert cache.lookup(("k", 3)) is not None
+    assert _MISSES.value() - m0 == 1
+    assert cache.stats()["bytes"] > 0
+    g = REGISTRY.gauge("presto_tpu_program_cache_resident_bytes")
+    assert g.value() >= 0
+
+
+def test_lookup_refreshes_lru_recency():
+    cache = PC.ProgramCache(max_entries=2, disk_dir=None)
+    cache.insert(("k", "a"), object(), {}, persist=False)
+    cache.insert(("k", "b"), object(), {}, persist=False)
+    assert cache.lookup(("k", "a")) is not None  # a becomes newest
+    cache.insert(("k", "c"), object(), {}, persist=False)  # evicts b
+    assert cache.lookup(("k", "a")) is not None
+    assert cache.lookup(("k", "b")) is None
+
+
+def test_engine_program_cache_is_bounded(tpch_tiny):
+    e = Engine()
+    e.register_catalog("tpch", tpch_tiny)
+    e.session.set("program_cache_entries", 1)
+    ev0 = _EVICTIONS.value()
+    for pred in ("< 10", "< 20"):
+        e.execute(f"select count(*) from lineitem "
+                  f"where l_quantity {pred}")
+    assert len(e._program_cache) == 1
+    assert _EVICTIONS.value() > ev0
+
+
+# -- persistent disk store ---------------------------------------------------
+
+_CHILD = r"""
+import json, sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+from presto_tpu import Engine
+from presto_tpu import types as T
+from presto_tpu.connectors.memory import MemoryConnector
+from presto_tpu.obs.metrics import REGISTRY
+
+conn = MemoryConnector()
+n = 4096
+conn.create_table("t", {"k": T.BIGINT, "v": T.BIGINT},
+                  {"k": np.arange(n) % 7, "v": np.arange(n)})
+e = Engine()
+e.register_catalog("mem", conn)
+e.session.catalog = "mem"
+rows = e.execute("select k, sum(v) from t group by k order by k")
+print(json.dumps({
+    "rows": [[float(x) for x in r] for r in rows],
+    "compiled": REGISTRY.counter(
+        "presto_tpu_programs_compiled_total").value(),
+    "disk_hits": REGISTRY.counter(
+        "presto_tpu_program_cache_hits_total").value(tier="disk")}))
+"""
+
+
+def _run_child(cache_dir) -> dict:
+    env = dict(os.environ,
+               PRESTO_TPU_PROGRAM_CACHE_DIR=str(cache_dir),
+               PRESTO_TPU_XLA_CACHE="", JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, "-c", _CHILD], capture_output=True,
+        text=True, timeout=240, cwd=REPO, env=env)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
+def test_warm_process_compiles_nothing(tmp_path):
+    """THE acceptance check: with PRESTO_TPU_PROGRAM_CACHE_DIR set, a
+    second run of the same query in a FRESH process performs zero XLA
+    compiles (presto_tpu_programs_compiled_total stays 0) and still
+    returns identical rows."""
+    cold = _run_child(tmp_path)
+    assert cold["compiled"] >= 1
+    assert [f for f in os.listdir(tmp_path) if f.endswith(".prog")]
+    warm = _run_child(tmp_path)
+    assert warm["compiled"] == 0, warm
+    assert warm["disk_hits"] >= 1
+    assert warm["rows"] == cold["rows"]
+
+
+def test_disk_hit_then_corruption_fallback(tmp_path, monkeypatch):
+    """One disk-store lifecycle: engine A compiles + persists; engine B
+    (fresh memory tier) disk-hits with zero new compiles; after the
+    stored executables are truncated, engine C falls back to a live
+    compile (miss + disk error counted, no crash, same rows)."""
+    monkeypatch.setenv(PC.ENV_DIR, str(tmp_path))
+    sql = "select k, sum(v) from t group by k order by k"
+    want = mem_engine().execute(sql)
+    progs = [f for f in os.listdir(tmp_path) if f.endswith(".prog")]
+    assert progs
+    d0 = _HITS.value(tier="disk")
+    c0 = _COMPILED.value()
+    got = mem_engine().execute(sql)
+    assert got == want
+    assert _COMPILED.value() == c0  # zero new compiles
+    assert _HITS.value(tier="disk") - d0 >= 1
+    for f in progs:  # truncate every stored executable mid-payload
+        p = os.path.join(tmp_path, f)
+        with open(p, "rb") as fh:
+            blob = fh.read()
+        with open(p, "wb") as fh:
+            fh.write(blob[:max(len(blob) // 3, 1)])
+    err0 = _DISK_ERRORS.value(op="load")
+    c0 = _COMPILED.value()
+    got = mem_engine().execute(sql)  # fresh engine: no memory tier
+    assert got == want
+    assert _COMPILED.value() - c0 >= 1  # live compile fallback
+    assert _DISK_ERRORS.value(op="load") >= err0 + 1
+
+
+# -- cross-worker sharing ----------------------------------------------------
+
+def test_two_worker_cluster_shares_disk_store(tmp_path, monkeypatch):
+    """A fragment compiled on one worker is a disk-cache hit on the
+    other: both workers' engines consult the shared store, so a
+    cluster compiles each fragment once, not once per worker."""
+    import dataclasses as DC
+
+    from presto_tpu.exec.streaming import _find_streamable
+    from presto_tpu.parallel.coordinator import RemoteWorker
+    from presto_tpu.parallel.wire import bytes_to_columns
+    from presto_tpu.parallel.worker import WorkerServer
+    from presto_tpu.plan import nodes as N
+    from presto_tpu.plan.serde import fragment_to_dict
+
+    monkeypatch.setenv(PC.ENV_DIR, str(tmp_path))
+    conn = MemoryConnector()
+    n = 4096  # even split: both shards get identical shapes
+    conn.create_table(
+        "t", {"k": T.BIGINT, "v": T.BIGINT},
+        {"k": np.arange(n) % 5, "v": np.arange(n)})
+
+    local = Engine()
+    local.register_catalog("mem", conn)
+    local.session.catalog = "mem"
+    plan, _ = local.plan_sql("select k, sum(v) from t group by k")
+    agg, _scan = _find_streamable(plan)
+    frag = fragment_to_dict(DC.replace(agg, step=N.AggStep.PARTIAL))
+
+    workers = [WorkerServer({"mem": conn}, node_id=f"pw{i}").start()
+               for i in range(2)]
+    try:
+        remotes = [RemoteWorker(w.uri) for w in workers]
+        c0 = _COMPILED.value()
+        out0 = remotes[0].post_task_any(
+            {"fragment": frag, "shard": 0, "nshards": 2})
+        compiled_by_first = _COMPILED.value() - c0
+        assert compiled_by_first >= 1
+        d0 = _HITS.value(tier="disk")
+        out1 = remotes[1].post_task_any(
+            {"fragment": frag, "shard": 1, "nshards": 2})
+        # second worker: fresh engine, no memory tier — disk hit, zero
+        # additional compiles
+        assert _COMPILED.value() - c0 == compiled_by_first
+        assert _HITS.value(tier="disk") - d0 >= 1
+        # both halves produced real partial states
+        rows0 = bytes_to_columns(out0)[1]
+        rows1 = bytes_to_columns(out1)[1]
+        assert rows0 > 0 and rows1 > 0
+    finally:
+        for w in workers:
+            try:
+                w.stop()
+            except Exception:  # noqa: BLE001
+                pass
