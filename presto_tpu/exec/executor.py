@@ -561,6 +561,10 @@ def prepare_plan(engine, plan: N.PlanNode, scan_inputs: list[ScanInput]):
         if entry is None:
             traced_fn, _host_arrays, meta = make_traced(
                 scan_inputs, plan, capacities, engine.session)
+            # compile-latency chaos point (ft/faults.py): lets the
+            # chaos suite provoke slow compiles deterministically
+            from presto_tpu.ft.faults import FAULTS
+            FAULTS.delay("compile-slow", key=type(plan).__name__)
             _t0 = time.perf_counter()
             # explicit AOT lower+compile (not a first jit-wrapper call)
             # so compile and execute attribute separately in spans;

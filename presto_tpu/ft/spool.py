@@ -1,0 +1,159 @@
+"""Spooled exchange: task output pages persisted for fault tolerance.
+
+The analog of Trino's fault-tolerant spooling exchange (the
+``exchange.base-directories`` filesystem exchange behind
+``retry-policy=TASK``): a worker running a buffered fragment task
+writes every page it emits into a worker-local spool directory
+(atomic tmp+rename, the progcache discipline) alongside the in-memory
+OutputBuffer. The wire format stays the compact columnar one
+(parallel/wire.py framed npz) — per PAPERS.md's Arrow Flight result,
+columnar batch framing, not the transport, dominates exchange cost, so
+the durable copy is byte-identical to the streamed one.
+
+The spool serves through the EXISTING exchange HTTP surface: the
+worker results endpoint falls back to the spool when the in-memory
+buffer is gone (evicted, task deleted, or the page already freed by a
+prior reader's acks), so a TASK retry can re-fetch a dead producer's
+pages from any worker sharing the spool directory instead of aborting
+the query ("buffers on the dead node are lost") or recomputing the
+task.
+
+Layout: ``{dir}/{task_id}/p{partition}.{index:06d}.page`` plus a
+``COMPLETE.json`` marker carrying per-partition page counts and row
+counts; a task without the marker is not served (a half-spooled failed
+attempt must never feed a consumer — stale attempts are additionally
+unreachable because retries get fresh attempt-versioned task ids).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import shutil
+import threading
+
+from presto_tpu.obs.metrics import REGISTRY
+
+_SPOOLED_PAGES = REGISTRY.counter(
+    "presto_tpu_spooled_pages_total",
+    "task output pages persisted to the exchange spool (ft/spool.py)")
+_SPOOL_SERVED = REGISTRY.counter(
+    "presto_tpu_spool_served_pages_total",
+    "exchange pages served from the spool instead of a live buffer")
+
+_TASK_ID_RE = re.compile(r"^[A-Za-z0-9._\-]+$")
+
+COMPLETE_MARKER = "COMPLETE.json"
+
+
+def _safe(task_id: str) -> str:
+    if not _TASK_ID_RE.match(task_id):
+        raise ValueError(f"unspoolable task id {task_id!r}")
+    return task_id
+
+
+class TaskSpool:
+    """One worker's spool directory (may be shared between workers —
+    any worker with the directory can serve any spooled task)."""
+
+    def __init__(self, directory: str):
+        self.directory = directory
+        os.makedirs(directory, exist_ok=True)
+
+    # -- producer side ---------------------------------------------------
+
+    def writer(self, task_id: str) -> "SpoolWriter":
+        return SpoolWriter(self, _safe(task_id))
+
+    # -- consumer side ---------------------------------------------------
+
+    def _task_dir(self, task_id: str) -> str:
+        return os.path.join(self.directory, _safe(task_id))
+
+    def complete_meta(self, task_id: str) -> dict | None:
+        """The completion marker, or None when the task is absent or
+        was never completed (do not serve half-spooled output)."""
+        try:
+            with open(os.path.join(self._task_dir(task_id),
+                                   COMPLETE_MARKER),
+                      encoding="utf-8") as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    def page(self, task_id: str, partition: int,
+             token: int) -> tuple[bytes | None, int, bool]:
+        """Same (blob, next_token, complete) contract as
+        OutputBuffer.page, read from disk. Raises FileNotFoundError
+        when the task is not spooled (caller 404s)."""
+        meta = self.complete_meta(task_id)
+        if meta is None:
+            raise FileNotFoundError(task_id)
+        npages = int(meta["pages"].get(str(partition), 0))
+        if token >= npages:
+            return None, token, True
+        path = os.path.join(self._task_dir(task_id),
+                            f"p{partition}.{token:06d}.page")
+        with open(path, "rb") as f:
+            blob = f.read()
+        _SPOOL_SERVED.inc()
+        return blob, token + 1, False
+
+    def rows(self, task_id: str) -> list[int] | None:
+        meta = self.complete_meta(task_id)
+        return None if meta is None else list(meta["rows"])
+
+    # -- lifecycle -------------------------------------------------------
+
+    def delete_prefix(self, prefix: str) -> None:
+        """Drop every spooled task whose id starts with ``prefix``
+        (query cleanup: one query's tasks share the query-id prefix)."""
+        try:
+            names = os.listdir(self.directory)
+        except OSError:
+            return
+        for name in names:
+            if name.startswith(prefix):
+                shutil.rmtree(os.path.join(self.directory, name),
+                              ignore_errors=True)
+
+
+class SpoolWriter:
+    """Per-task page writer. Page indices are assigned here (the
+    buffer's emit loop is single-threaded per task, but partitions
+    interleave); writes are atomic tmp+rename so a concurrently
+    crashing worker never leaves a torn page for a peer to serve."""
+
+    def __init__(self, spool: TaskSpool, task_id: str):
+        self.spool = spool
+        self.task_id = task_id
+        self.dir = os.path.join(spool.directory, task_id)
+        self._lock = threading.Lock()
+        self._counts: dict[int, int] = {}
+        os.makedirs(self.dir, exist_ok=True)
+
+    def write(self, partition: int, blob: bytes) -> None:
+        with self._lock:
+            index = self._counts.get(partition, 0)
+            self._counts[partition] = index + 1
+        path = os.path.join(self.dir,
+                            f"p{partition}.{index:06d}.page")
+        tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
+        with open(tmp, "wb") as f:
+            f.write(blob)
+        os.replace(tmp, path)
+        _SPOOLED_PAGES.inc()
+
+    def complete(self, rows: list[int]) -> None:
+        with self._lock:
+            pages = {str(p): n for p, n in self._counts.items()}
+        marker = os.path.join(self.dir, COMPLETE_MARKER)
+        tmp = f"{marker}.tmp.{os.getpid()}"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump({"pages": pages, "rows": list(rows)}, f)
+        os.replace(tmp, marker)
+
+    def abort(self) -> None:
+        """Drop a failed attempt's pages — they must never be served."""
+        shutil.rmtree(self.dir, ignore_errors=True)

@@ -18,6 +18,10 @@ pipeline, sql/planner/sanity/PlanSanityChecker.java):
   obs/metrics registry checked statically with the registry's own
   validator — a bad name on a rarely-hit path would otherwise only
   raise in production.
+- **timeout discipline** (``lint/timeouts.py``): every
+  ``urlopen``/``_urlopen`` call site must pass an explicit
+  ``timeout=`` — an internal HTTP call without a deadline turns one
+  dead peer into a hung thread the failure detector cannot see.
 
 Run ``python -m presto_tpu.lint presto_tpu/`` (exits nonzero on
 findings); suppress a single line with ``# lint: disable=rule-name``
@@ -32,5 +36,6 @@ from presto_tpu.lint import tracer as _tracer  # noqa: E402,F401
 from presto_tpu.lint import locks as _locks  # noqa: E402,F401
 from presto_tpu.lint import dispatch as _dispatch  # noqa: E402,F401
 from presto_tpu.lint import metrics as _metrics  # noqa: E402,F401
+from presto_tpu.lint import timeouts as _timeouts  # noqa: E402,F401
 
 __all__ = ["Finding", "Project", "available_rules", "run_lint"]

@@ -38,6 +38,7 @@ LOCK_SCOPES = (
     "presto_tpu/obs/",
     "presto_tpu/events.py",
     "presto_tpu/exec/progcache.py",
+    "presto_tpu/ft/",
 )
 
 _LOCK_NAME_RE = re.compile(

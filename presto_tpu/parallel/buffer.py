@@ -32,15 +32,23 @@ class OutputBuffer:
     """One task's paged output across its partitions."""
 
     def __init__(self, nparts: int, capacity_bytes: int,
-                 readers: int = 1):
+                 readers: int = 1, spool=None):
         """``readers``: consumers that will independently read EACH
         partition (broadcast build sides are read by every downstream
         task). A page's bytes free only once every reader's token has
         passed it — one consumer's acknowledgement must never drop a
-        page another consumer has not fetched."""
+        page another consumer has not fetched.
+
+        ``spool``: optional ft.spool.SpoolWriter; every page is also
+        persisted (before entering the in-memory buffer, so the
+        durable copy exists even if the producer dies mid-add) and the
+        completion/abort markers track the buffer lifecycle. The spool
+        then serves pages this buffer has already freed — see the
+        released-page contract on :meth:`page`."""
         self.nparts = nparts
         self.readers = max(1, int(readers))
         self.capacity = max(1, int(capacity_bytes))
+        self.spool = spool
         self._pages: list[list[bytes | None]] = [[] for _ in
                                                  range(nparts)]
         # per (partition, reader) acknowledged-token position
@@ -65,6 +73,10 @@ class OutputBuffer:
         """Append one page; blocks while the buffer is over capacity
         (backpressure). Raises TaskFailed if the buffer was aborted or
         no consumer made progress for IDLE_ABORT_S."""
+        if self.spool is not None:
+            # durable copy first: a producer dying between spool and
+            # buffer leaves a retryable page, never a phantom one
+            self.spool.write(partition, blob)
         with self._cv:
             idle = 0.0
             while (self._pending + len(blob) > self.capacity
@@ -91,13 +103,19 @@ class OutputBuffer:
     def set_complete(self) -> None:
         with self._cv:
             self._complete = True
+            rows = list(self._rows)
             self._cv.notify_all()
+        if self.spool is not None:
+            self.spool.complete(rows)
 
     def fail(self, message: str) -> None:
         with self._cv:
             self._failed = message[:500]
             self._complete = True
             self._cv.notify_all()
+        if self.spool is not None:
+            # a failed attempt's pages must never feed a consumer
+            self.spool.abort()
 
     # -- consumer side ---------------------------------------------------
 
@@ -107,11 +125,22 @@ class OutputBuffer:
         for ``reader``, acknowledging its pages below the token (a page
         frees once EVERY reader acked past it). Long-polls up to
         ``poll_s`` when the page is not produced yet; (None, token,
-        False) means retry, (None, token, True) means drained."""
+        False) means retry, (None, token, True) means drained.
+
+        A request BELOW the freed watermark (a retried consumer
+        re-reading from token 0 after its first attempt acked pages
+        away) raises TaskFailed instead of silently serving the None
+        holes — the caller must fall back to the spool or re-run the
+        producer, never drop rows."""
         reader = min(max(reader, 0), self.readers - 1)
         with self._cv:
             if self._failed is not None:
                 raise TaskFailed(self._failed)
+            if token < self._freed[partition]:
+                raise TaskFailed(
+                    f"page {token} of partition {partition} was "
+                    "already acknowledged and released (retried "
+                    "consumer must re-fetch from the spool)")
             pages = self._pages[partition]
             acked = self._acked[partition]
             if token > acked[reader]:

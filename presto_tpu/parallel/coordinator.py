@@ -20,7 +20,9 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import re
 import threading
+import time
 import urllib.error
 import urllib.request
 
@@ -30,8 +32,20 @@ from concurrent.futures import ThreadPoolExecutor
 import numpy as np
 
 from presto_tpu import types as T
+from presto_tpu.ft import retry as FTR
+from presto_tpu.ft.faults import FAULTS
 from presto_tpu.obs import trace as OT
+from presto_tpu.obs.metrics import REGISTRY
 from presto_tpu.plan import nodes as N
+
+_TASK_RETRIES = REGISTRY.counter(
+    "presto_tpu_task_retries_total",
+    "fragment tasks re-dispatched after a failure "
+    "(retry_policy=TASK, ft/retry.py)")
+_QUERY_RETRIES = REGISTRY.counter(
+    "presto_tpu_query_retries_total",
+    "whole fragmented attempts re-run on surviving workers "
+    "(retry_policy=QUERY)")
 
 
 class NoWorkersError(RuntimeError):
@@ -51,6 +65,7 @@ class RemoteWorker:
                               if shared_secret is not None
                               else _auth.default_secret())
         self.failure_ratio = 0.0  # exponential decay of ping failures
+        self.state = "active"  # last lifecycle state seen by ping()
         self.lock = threading.Lock()
 
     def _auth_headers(self) -> dict:
@@ -74,18 +89,41 @@ class RemoteWorker:
         with self.lock:
             return self.failure_ratio < self.THRESHOLD
 
-    def post_task(self, payload: dict, timeout: float = 300.0) -> dict:
+    @property
+    def schedulable(self) -> bool:
+        """Alive AND accepting tasks: a draining node
+        (``shutting_down``) stays healthy — its buffers keep serving —
+        but receives no new work (reference graceful shutdown)."""
+        with self.lock:
+            return (self.failure_ratio < self.THRESHOLD
+                    and self.state == "active")
+
+    def post_task(self, payload: dict,
+                  timeout: float | None = None) -> dict:
         out = self.post_task_any(payload, timeout)
         if isinstance(out, bytes):
             raise TaskError("unexpected binary task response")
         return out
 
+    # session ``task_request_timeout_s`` overrides per query; this is
+    # the fallback for direct callers
+    DEFAULT_TASK_TIMEOUT_S = 300.0
+
     def post_task_any(self, payload: dict,
-                      timeout: float = 300.0) -> dict | bytes:
+                      timeout: float | None = None) -> dict | bytes:
         """POST a task; returns parsed JSON or raw bytes for binary
         (inline fragment result) responses. The dispatch records a
         ``task-dispatch`` span whose id rides the X-Presto-TPU-Trace
-        header, so worker-side spans parent under it."""
+        header, so worker-side spans parent under it.
+
+        HTTP 502/503/504 (drain, overload) propagate as transient
+        failures; any other worker answer is a deterministic
+        TaskError. No transport-level retry here on purpose: the
+        task/query retry layers own POST failures, and they rotate
+        to another worker — strictly better than re-POSTing to the
+        same one."""
+        if timeout is None:
+            timeout = self.DEFAULT_TASK_TIMEOUT_S
         with OT.TRACER.span("task-dispatch", worker=self.uri,
                             task_id=str(payload.get("task_id", ""))):
             req = urllib.request.Request(
@@ -103,6 +141,8 @@ class RemoteWorker:
                         return body
                     out = json.loads(body)
             except urllib.error.HTTPError as e:
+                if e.code in FTR.TRANSIENT_HTTP_CODES:
+                    raise  # node cannot take work: transient
                 # the worker answered: node is up, the TASK failed
                 try:
                     msg = json.loads(e.read()).get("error", str(e))
@@ -124,38 +164,69 @@ class RemoteWorker:
             pass
 
     def ping(self, timeout: float = 2.0) -> bool:
+        """Healthy = the node answers /v1/status with a known state.
+        A DRAINING node pings healthy (its buffers must stay
+        reachable); ``schedulable`` is what excludes it from new
+        work. The ``heartbeat-blackout`` fault point simulates an
+        unreachable node deterministically (ft/faults.py)."""
+        if FAULTS.should_fire("heartbeat-blackout", key=self.uri):
+            return False
         try:
             with _urlopen(urllib.request.Request(
                     f"{self.uri}/v1/status"), timeout=timeout) as resp:
-                return json.loads(resp.read()).get("state") == "active"
+                st = str(json.loads(resp.read()).get("state") or "")
         except Exception:  # noqa: BLE001 - any failure counts
             return False
+        with self.lock:
+            self.state = st
+        return st in ("active", "shutting_down")
 
 
 class HeartbeatFailureDetector:
     """Continuously pings workers; decayed failure ratio over threshold
-    marks a node dead (HeartbeatFailureDetector.java:78)."""
+    marks a node dead (HeartbeatFailureDetector.java:78).
+
+    ``ping_timeout``: () -> float giving the per-ping HTTP deadline
+    (the coordinator wires the session's ``heartbeat_timeout_s``)."""
 
     def __init__(self, workers: list[RemoteWorker],
-                 interval_s: float = 0.5):
+                 interval_s: float = 0.5, ping_timeout=None):
         self.workers = workers
         self.interval_s = interval_s
+        self._ping_timeout = ping_timeout
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
 
     def start(self) -> None:
-        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="presto-tpu-heartbeat")
         self._thread.start()
 
     def stop(self) -> None:
+        """Interruptible shutdown: the loop re-checks the stop Event
+        between individual pings, so the worst-case join is ~one ping
+        timeout — the old fixed join(5) could return with the thread
+        still alive behind a slow ping, leaking it."""
         self._stop.set()
-        if self._thread is not None:
-            self._thread.join(timeout=5)
+        t = self._thread
+        if t is not None:
+            t.join(timeout=self.timeout_s() + self.interval_s + 5)
+        self._thread = None
+
+    def timeout_s(self) -> float:
+        if self._ping_timeout is None:
+            return 2.0
+        try:
+            return float(self._ping_timeout())
+        except Exception:  # noqa: BLE001 - session misconfig
+            return 2.0
 
     def _loop(self) -> None:
         while not self._stop.wait(self.interval_s):
             for w in list(self.workers):
-                w.record(not w.ping())
+                if self._stop.is_set():
+                    return
+                w.record(not w.ping(timeout=self.timeout_s()))
 
 
 class ClusterCoordinator:
@@ -167,7 +238,8 @@ class ClusterCoordinator:
         self.engine = engine
         self.workers: list[RemoteWorker] = []
         self.detector = HeartbeatFailureDetector(
-            self.workers, heartbeat_interval_s)
+            self.workers, heartbeat_interval_s,
+            ping_timeout=self._ping_timeout)
         self.last_distribution: dict | None = None
 
     def add_worker(self, uri: str) -> None:
@@ -181,7 +253,24 @@ class ClusterCoordinator:
         self.detector.stop()
 
     def live_workers(self) -> list[RemoteWorker]:
-        return [w for w in self.workers if w.alive]
+        return [w for w in self.workers if w.schedulable]
+
+    # -- session-configured fault-tolerance knobs (ft/retry.py) ----------
+
+    def _retry_policy(self) -> str:
+        policy = str(self.engine.session.get("retry_policy")
+                     or "QUERY").upper()
+        if policy not in FTR.RETRY_POLICIES:
+            raise ValueError(
+                f"unknown retry_policy {policy!r} "
+                f"(one of {FTR.RETRY_POLICIES})")
+        return policy
+
+    def _task_timeout(self) -> float:
+        return float(self.engine.session.get("task_request_timeout_s"))
+
+    def _ping_timeout(self) -> float:
+        return float(self.engine.session.get("heartbeat_timeout_s"))
 
     # -- query execution ----------------------------------------------------
 
@@ -241,33 +330,82 @@ class ClusterCoordinator:
                     "join_distribution_type") or "automatic").lower(),
                 broadcast_threshold=int(self.engine.session.get(
                     "broadcast_join_threshold_rows")))
+            policy = self._retry_policy()
+            budget = float(self.engine.session.get("retry_deadline_s"))
+            deadline = FTR.Deadline(budget)
+
             def _with_failover(run):
-                """Node loss mid-stage loses that query's buffers; the
-                whole stage DAG retries ONCE on the surviving workers
-                (stage-level failover — the analog of the split-level
-                retry in _dispatch_splits). If no workers survive or
-                the retry fails too, the query FAILS like the
-                reference's REMOTE_TASK_ERROR unless local fallback
-                was opted into."""
-                try:
-                    return run(workers)
-                except (NoWorkersError, TaskError):
-                    survivors = [w for w in workers if w.ping()]
-                    if survivors and len(survivors) < len(workers):
-                        try:
-                            return run(survivors)
-                        except (NoWorkersError, TaskError):
-                            pass
-                    if require or not allow_fb:
-                        raise
-                    return run_local()
+                """Node loss mid-stage loses that query's buffers
+                (without the spooled exchange); under retry_policy=
+                QUERY the whole stage DAG re-runs on the surviving
+                workers, up to ``query_retry_attempts`` times with
+                full-jitter backoff under the retry deadline budget
+                (the original single-failover semantics are the
+                defaults). NONE fails on the first error. A
+                deterministic TaskError only retries when the cluster
+                actually shrank — on a stable cluster it would fail
+                identically. If retries exhaust, the query FAILS like
+                the reference's REMOTE_TASK_ERROR unless local
+                fallback was opted into."""
+                session = self.engine.session
+                max_retries = max(
+                    0, int(session.get("query_retry_attempts")))
+                delays = FTR.backoff_from_session(session,
+                                                  max_retries)
+                ws = workers
+                retries = 0
+                while True:
+                    try:
+                        return run(ws)
+                    except (NoWorkersError, TaskError) as e:
+                        if policy == "NONE":
+                            raise
+                        # ping refreshes w.state; schedulable then
+                        # drops draining nodes (they answer pings but
+                        # 503 every task POST)
+                        survivors = [
+                            w for w in ws
+                            if w.ping(timeout=self._ping_timeout())
+                            and w.schedulable]
+                        shrank = bool(survivors) \
+                            and len(survivors) < len(ws)
+                        transient = not isinstance(e, TaskError)
+                        if retries < max_retries and survivors \
+                                and (shrank or transient) \
+                                and not deadline.expired:
+                            _QUERY_RETRIES.inc()
+                            delay = delays.delay_s(retries)
+                            with OT.TRACER.span(
+                                    "query-retry", attempt=retries,
+                                    survivors=len(survivors),
+                                    error=f"{type(e).__name__}: "
+                                          f"{str(e)[:200]}"):
+                                time.sleep(delay)
+                            ws = survivors
+                            retries += 1
+                            continue
+                        if require or not allow_fb:
+                            raise
+                        return run_local()
 
             if general is not None:
+                if policy == "TASK":
+                    try:
+                        return self._execute_general_ft(
+                            plan, general, workers, deadline)
+                    except (NoWorkersError, TaskError,
+                            FTR.DeadlineExceeded):
+                        if require or not allow_fb:
+                            raise
+                        return run_local()
                 return _with_failover(
                     lambda ws: self._execute_general(plan, general,
                                                      ws))
             fragged = fragment_join_plan(plan)
             if fragged is not None:
+                # raw-row join shapes (no aggregate) keep stage-level
+                # QUERY failover even under TASK policy: the join
+                # fragmenter's streamed stages are not task-retryable
                 return _with_failover(
                     lambda ws: self._execute_fragmented(plan, fragged,
                                                         ws))
@@ -283,11 +421,15 @@ class ClusterCoordinator:
     def _run_stage(self, workers: list[RemoteWorker],
                    payloads: list[dict]) -> list:
         """One task per worker; any node failure aborts the fragmented
-        attempt (buffers on the dead node are lost)."""
+        attempt (buffers on the dead node are lost) and surfaces to
+        the retry_policy layer: QUERY re-runs the DAG on survivors,
+        TASK avoids this path entirely (_execute_general_ft
+        re-dispatches single tasks over the spooled exchange)."""
         # dispatch threads do NOT inherit contextvars from this thread;
         # hand the trace context over explicitly so per-task dispatch
         # spans parent under the query
         ctx = OT.current_context()
+        timeout = self._task_timeout()
 
         def run_one(i: int):
             w = workers[i]
@@ -295,7 +437,8 @@ class ClusterCoordinator:
                 raise NoWorkersError(f"worker {w.uri} died")
             try:
                 with OT.TRACER.attach(ctx):
-                    out = w.post_task_any(payloads[i])
+                    out = w.post_task_any(payloads[i],
+                                          timeout=timeout)
                 w.record(False)
                 return out
             except TaskError:
@@ -410,16 +553,7 @@ class ClusterCoordinator:
         qid = uuid.uuid4().hex[:8]
         W = len(workers)
         nparts_of: dict[str, int] = {}
-        # how many downstream tasks read EACH partition of a producer's
-        # buffer: 1 in "part" mode (consumer i owns partition i), W in
-        # "all" (broadcast) mode — the buffer frees a page only when
-        # every reader acked past it
-        readers_of: dict[str, int] = {}
-        for st in g.stages:
-            for _tname, (producer, mode) in st.sources.items():
-                readers_of[producer] = max(
-                    readers_of.get(producer, 1),
-                    W if mode == "all" else 1)
+        readers_of = g.consumer_readers(W)
 
         try:
             inline: list | None = None
@@ -477,6 +611,214 @@ class ClusterCoordinator:
                 plan, g.agg, g.boundary, inline,
                 {"nshards": W, "mode": "fragments",
                  "stages": len(g.stages)})
+        finally:
+            for w in workers:
+                try:
+                    w.delete_task(qid)
+                except Exception:  # noqa: BLE001 - best-effort cleanup
+                    pass
+
+    def _execute_general_ft(self, plan, g, workers: list[RemoteWorker],
+                            deadline: FTR.Deadline):
+        """retry_policy=TASK execution of the general stage DAG over
+        the spooled exchange (the Trino fault-tolerant-execution
+        analog). Differences from :meth:`_execute_general`:
+
+        - stages dispatch SYNCHRONOUSLY (no ``async`` streaming):
+          every task's success is known when its POST returns, so a
+          failure re-dispatches just that task — the pipelining lost
+          to the barrier is the same price Trino FTE pays for
+          task-granular retryability;
+        - task ids are attempt-versioned (``{qid}.{stage}.{shard}aN``)
+          so a speculative/retried dispatch never collides with the
+          failed attempt's buffer, and consumers are pointed at the
+          exact surviving attempt;
+        - a consumer failing with an ExchangeFetchError triggers
+          exchange REPAIR: if the producer node died and spooling is
+          on, the consumer is re-pointed at a surviving worker serving
+          the producer's spooled pages (shared spool directory);
+          otherwise only that producer task is recomputed — the
+          "buffers on the dead node are lost" abort is gone.
+
+        Retries are bounded by ``task_retry_attempts`` per task, slept
+        with full-jitter backoff, charged against the query's retry
+        deadline, counted in ``presto_tpu_task_retries_total`` and
+        visible as ``task-retry`` spans."""
+        import uuid
+
+        from presto_tpu.plan.serde import fragment_to_dict
+
+        session = self.engine.session
+        qid = uuid.uuid4().hex[:8]
+        W = len(workers)
+        task_backoff = FTR.backoff_from_session(
+            session, int(session.get("task_retry_attempts")))
+        spool_on = bool(session.get("exchange_spooling"))
+        task_timeout = self._task_timeout()
+        ctx = OT.current_context()
+
+        readers_of = g.consumer_readers(W)
+        stage_by_name = {st.name: st for st in g.stages}
+        nparts_of: dict[str, int] = {}
+        frag_of: dict[str, dict] = {}
+
+        # shared retry state: placed[stage][shard] = (worker, task_id)
+        # of the attempt whose output consumers should read
+        state_lock = threading.Lock()
+        placed: dict[str, dict[int, tuple[RemoteWorker, str]]] = {}
+        attempts: dict[tuple[str, int], int] = {}
+        retries = [0]
+
+        def live_pool() -> list[RemoteWorker]:
+            pool = [w for w in workers if w.schedulable]
+            if not pool:
+                raise NoWorkersError("no schedulable workers remain")
+            return pool
+
+        def build_payload(st, shard: int, tid: str,
+                          last: bool) -> dict:
+            sources: dict = {}
+            for tname, (producer, mode) in st.sources.items():
+                with state_lock:
+                    pl = dict(placed[producer])
+                if mode == "part":
+                    refs = [{"uri": pl[s][0].uri, "task_id": pl[s][1],
+                             "part": shard} for s in sorted(pl)]
+                else:  # "all": broadcast read of every buffer
+                    np_ = nparts_of[producer]
+                    refs = [{"uri": pl[s][0].uri, "task_id": pl[s][1],
+                             "part": p, "reader": shard}
+                            for s in sorted(pl) for p in range(np_)]
+                sources[tname] = refs
+            p: dict = {"fragment": frag_of[st.name], "task_id": tid,
+                       "shard": shard, "nshards": W}
+            if sources:
+                p["sources"] = sources
+            if st.partition_keys is not None:
+                p["partition"] = {"nparts": W,
+                                  "keys": st.partition_keys}
+            elif not last:
+                p["store"] = True
+            if readers_of.get(st.name, 1) > 1:
+                p["readers"] = readers_of[st.name]
+            if spool_on and (st.partition_keys is not None
+                             or not last):
+                # buffered output spools (task ids here are per-shard
+                # unique, so shared spool directories cannot collide)
+                p["spool"] = True
+            # no "async": the POST runs the fragment to completion so
+            # this task's outcome is attributable to this task alone
+            return p
+
+        def repair_exchange(message: str) -> bool:
+            """Consumer could not pull a producer's pages. Returns
+            True when the exchange was repaired (re-point or re-run)
+            and the consumer should retry; False when the failure is
+            not an exchange failure (a real application error)."""
+            hit = FTR.parse_exchange_failure(message)
+            if hit is None:
+                return False
+            ptid, puri = hit
+            m = re.match(
+                rf"^{re.escape(qid)}\.(.+?)\.(\d+)(?:a\d+)?$", ptid)
+            if m is None:
+                return False
+            pstage, pshard = m.group(1), int(m.group(2))
+            with state_lock:
+                cur = placed.get(pstage, {}).get(pshard)
+            if cur is None:
+                return False
+            cur_w, cur_tid = cur
+            if cur_tid != ptid:
+                return True  # a concurrent consumer already repaired
+            dead = cur_w.uri == puri and not cur_w.ping(
+                timeout=self._ping_timeout())
+            if spool_on and dead:
+                # any surviving worker sharing the spool directory can
+                # serve the dead producer's persisted pages under the
+                # SAME task id — zero recomputation
+                alt = [w for w in live_pool() if w.uri != puri]
+                if alt:
+                    with state_lock:
+                        placed[pstage][pshard] = (
+                            alt[pshard % len(alt)], ptid)
+                    return True
+            st = stage_by_name.get(pstage)
+            if st is None:
+                return False
+            # recompute ONLY the failed producer task
+            dispatch(st, pshard, last=False)
+            return True
+
+        def dispatch(st, shard: int, last: bool):
+            while True:
+                with state_lock:
+                    n = attempts.get((st.name, shard), 0)
+                    attempts[(st.name, shard)] = n + 1
+                tid = f"{qid}.{st.name}.{shard}" + (
+                    f"a{n}" if n else "")
+                pool = live_pool()
+                w = pool[(shard + n) % len(pool)]
+                payload = build_payload(st, shard, tid, last)
+                err: Exception
+                try:
+                    with OT.TRACER.attach(ctx):
+                        out = w.post_task_any(payload,
+                                              timeout=task_timeout)
+                    w.record(False)
+                    with state_lock:
+                        placed[st.name][shard] = (w, tid)
+                    return out
+                except TaskError as te:
+                    if not repair_exchange(str(te)):
+                        raise  # deterministic application error
+                    err = te
+                    reason = "exchange-repair"
+                except FTR.DeadlineExceeded:
+                    raise
+                except Exception as e:  # noqa: BLE001 - node failure
+                    w.record(True)
+                    w.record(True)  # fast-fail: push over threshold
+                    err = e
+                    reason = f"node-failure:{type(e).__name__}"
+                if n + 1 >= task_backoff.attempts:
+                    raise NoWorkersError(
+                        f"task {st.name}.{shard} failed after "
+                        f"{n + 1} attempts: {err}")
+                deadline.check(f"task {st.name}.{shard}")
+                _TASK_RETRIES.inc()
+                with state_lock:
+                    retries[0] += 1
+                delay = task_backoff.delay_s(n)
+                with OT.TRACER.attach(ctx), OT.TRACER.span(
+                        "task-retry", task_id=tid, attempt=n,
+                        reason=reason, delay_s=round(delay, 4),
+                        error=f"{type(err).__name__}: "
+                              f"{str(err)[:200]}"):
+                    time.sleep(delay)
+
+        try:
+            inline: list | None = None
+            for st in g.stages:
+                frag_of[st.name] = fragment_to_dict(st.fragment)
+                nparts_of[st.name] = (W if st.partition_keys is not None
+                                      else 1)
+                with state_lock:
+                    placed.setdefault(st.name, {})
+                last = st.name == g.last_stage
+                with ThreadPoolExecutor(max_workers=W) as pool:
+                    outs = list(pool.map(
+                        lambda i: dispatch(st, i, last), range(W)))
+                if last:
+                    inline = outs
+            assert inline is not None
+            with state_lock:
+                task_retries = retries[0]
+            return self._finish_with_partials(
+                plan, g.agg, g.boundary, inline,
+                {"nshards": W, "mode": "fragments",
+                 "stages": len(g.stages), "retry_policy": "TASK",
+                 "task_retries": task_retries})
         finally:
             for w in workers:
                 try:
@@ -580,20 +922,30 @@ class ClusterCoordinator:
         """Each split runs on its assigned worker; a failed worker's
         split retries on the surviving nodes (the elastic-recovery
         piece the reference lacks mid-query — failures there kill the
-        query, SURVEY §5)."""
+        query, SURVEY §5). retry_policy=NONE disables the cross-worker
+        retry: the split fails the query loudly."""
         ctx = OT.current_context()  # pool threads don't inherit it
+        timeout = self._task_timeout()
+        failover = self._retry_policy() != "NONE"
 
         def run_one(i: int) -> dict:
             order = [workers[i % len(workers)]] + [
                 w for j, w in enumerate(workers)
                 if j != i % len(workers)]
+            if not failover:
+                order = order[:1]
             last_err: Exception | None = None
+            tried = 0
             for w in order:
                 if not w.alive:
                     continue
+                tried += 1
+                if tried > 1:
+                    _TASK_RETRIES.inc()
                 try:
                     with OT.TRACER.attach(ctx):
-                        out = w.post_task_any(payloads[i])
+                        out = w.post_task_any(payloads[i],
+                                              timeout=timeout)
                     w.record(False)
                     return out
                 except TaskError:

@@ -98,6 +98,22 @@ class GeneralFragmentedPlan:
     agg: N.Aggregate | None  # top aggregate (FINAL runs on coordinator)
     last_stage: str
 
+    def consumer_readers(self, nworkers: int) -> dict[str, int]:
+        """Producer stage -> how many downstream tasks independently
+        read EACH partition of its buffer: 1 in "part" mode (consumer
+        i owns partition i), ``nworkers`` in "all" (broadcast) mode —
+        a page frees only when every reader acked past it. Shared by
+        the streaming (_execute_general) and task-retry
+        (_execute_general_ft) dispatchers, which must agree or a
+        buffer would free pages a retried reader still needs."""
+        readers: dict[str, int] = {}
+        for st in self.stages:
+            for _t, (producer, mode) in st.sources.items():
+                readers[producer] = max(
+                    readers.get(producer, 1),
+                    nworkers if mode == "all" else 1)
+        return readers
+
 
 # the broadcast cutoff lives in the cost model (cost/model.py
 # decide_join_distribution — the SAME decision the runtime executor

@@ -20,12 +20,15 @@ The multi-host analog of the reference worker runtime
 from __future__ import annotations
 
 import dataclasses
+import socket
 import threading
 import os
 import urllib.request
 
 import numpy as np
 
+from presto_tpu.ft import retry as FTR
+from presto_tpu.ft.faults import FAULTS
 from presto_tpu.obs import trace as OT
 from presto_tpu.obs.jsonlog import LOG
 from presto_tpu.obs.metrics import REGISTRY
@@ -147,6 +150,14 @@ def _auth_headers(secret: str | None) -> dict:
     return {_auth.HEADER: _auth.make_token(secret)}
 
 
+# worker-local transient-retry policy for single exchange page GETs: a
+# blip (connection reset, proxy 503) retries here; a hard producer
+# failure escalates as ExchangeFetchError for the coordinator's
+# TASK-retry repair (spool re-point / producer re-run)
+_FETCH_BACKOFF = FTR.BackoffPolicy(attempts=3, initial_delay_s=0.05,
+                                   max_delay_s=1.0)
+
+
 def _fetch_pages(ref: dict, timeout: float = 240.0,
                  secret: str | None = None) -> list[bytes]:
     """Pull one partition's pages with continuation tokens until the
@@ -154,7 +165,9 @@ def _fetch_pages(ref: dict, timeout: float = 240.0,
     page below T on the producer, releasing its buffer bytes (reference
     operator/HttpPageBufferClient.java:321-411). Long-polls through
     not-yet-produced pages, so a consumer scheduled before its producer
-    finishes simply waits on the data plane."""
+    finishes simply waits on the data plane. Transient per-page
+    failures retry locally (ft.retrying_call); anything else raises
+    :class:`presto_tpu.ft.ExchangeFetchError` naming the producer."""
     import time as _time
 
     headers = _auth_headers(secret)
@@ -167,14 +180,30 @@ def _fetch_pages(ref: dict, timeout: float = 240.0,
     with OT.TRACER.span("exchange-fetch", task_id=ref["task_id"],
                         part=int(ref["part"])) as sp:
         while True:
+            fkey = f"{ref['task_id']}:{ref['part']}:{token}"
+            FAULTS.delay("exchange-fetch-delay", key=fkey)
             req = urllib.request.Request(f"{base}/{token}/{reader}",
                                          headers=headers)
-            with _urlopen(req, timeout=60.0) as resp:
-                blob = resp.read()
-                nxt = int(resp.headers.get("X-PrestoTpu-Next-Token",
-                                           token))
-                complete = (resp.headers.get("X-PrestoTpu-Complete",
+
+            def _get(req=req, fkey=fkey):
+                if FAULTS.should_fire("exchange-fetch-drop", key=fkey):
+                    raise ConnectionResetError(
+                        "injected exchange-fetch drop")
+                with _urlopen(req, timeout=60.0) as resp:
+                    return (resp.read(),
+                            int(resp.headers.get(
+                                "X-PrestoTpu-Next-Token", token)),
+                            resp.headers.get("X-PrestoTpu-Complete",
                                              "0") == "1")
+
+            try:
+                blob, nxt, complete = FTR.retrying_call(
+                    _get, op="exchange-fetch", backoff=_FETCH_BACKOFF)
+            except Exception as e:  # noqa: BLE001 - escalate w/ coords
+                raise FTR.ExchangeFetchError(
+                    str(ref["task_id"]), int(ref["part"]),
+                    str(ref["uri"]),
+                    f"{type(e).__name__}: {e}") from e
             if blob:
                 pages.append(blob)
             if nxt == token and complete:
@@ -186,9 +215,10 @@ def _fetch_pages(ref: dict, timeout: float = 240.0,
                 return pages
             token = nxt
             if _time.monotonic() > deadline:
-                raise TimeoutError(
-                    f"exchange fetch of {ref['task_id']}/"
-                    f"{ref['part']} timed out")
+                raise FTR.ExchangeFetchError(
+                    str(ref["task_id"]), int(ref["part"]),
+                    str(ref["uri"]),
+                    f"fetch timed out after {timeout:.0f}s")
 
 
 def execute_fragment_task(engine, req: dict, store: dict,
@@ -327,10 +357,15 @@ class WorkerServer(HttpService):
     cached per (shard, nshards) so the compiled-program cache survives
     across tasks of repeat queries."""
 
+    # NOTE on spool sharing: a spool directory may be shared between
+    # workers (that is what lets a survivor serve a dead producer's
+    # pages), which is safe because only retry_policy=TASK payloads
+    # request spooling and their task ids are globally unique
     def __init__(self, catalogs: dict, host: str = "127.0.0.1",
                  port: int = 0, node_id: str = "worker",
                  shared_secret: str | None = None,
-                 tls: tuple[str, str] | None = None):
+                 tls: tuple[str, str] | None = None,
+                 spool_dir: str | None = None):
         from presto_tpu.parallel import auth as _auth
         self.catalogs = catalogs
         self.node_id = node_id
@@ -345,6 +380,18 @@ class WorkerServer(HttpService):
         # catalog; serialize them (one task at a time per worker, the
         # single-device analog of task_concurrency=1)
         self._task_lock = threading.Lock()
+        # lifecycle state: "active" accepts tasks; "shutting_down"
+        # (PUT /v1/info/state, the reference's graceful-shutdown
+        # protocol) rejects new tasks with 503 while running tasks
+        # finish and existing buffers/spool keep serving
+        self._state = "active"
+        spool_dir = (spool_dir if spool_dir is not None
+                     else os.environ.get("PRESTO_TPU_SPOOL_DIR"))
+        if spool_dir:
+            from presto_tpu.ft.spool import TaskSpool
+            self.spool: TaskSpool | None = TaskSpool(spool_dir)
+        else:
+            self.spool = None
 
         def engine_factory(shard: int, nshards: int):
             from presto_tpu import Engine
@@ -434,7 +481,7 @@ class WorkerServer(HttpService):
                         engines = list(outer._engines.values())
                     pools = [e.memory_pool.info() for e in engines]
                     self._send_json({
-                        "nodeId": outer.node_id, "state": "active",
+                        "nodeId": outer.node_id, "state": outer.state,
                         "memory": {
                             "reservedBytes": sum(
                                 p["reservedBytes"] for p in pools),
@@ -446,19 +493,37 @@ class WorkerServer(HttpService):
                         and parts[3] == "results"):
                     # paged: /v1/task/{tid}/results/{part}/{token}
                     # [/{reader}] — token T acknowledges the reader's
-                    # pages < T (reference TaskResource.java:261-336)
+                    # pages < T (reference TaskResource.java:261-336).
+                    # The spool (ft/spool.py) backs this endpoint: a
+                    # missing buffer (dead/restarted producer, task
+                    # deleted) or an already-released page (retried
+                    # consumer re-reading from token 0) serves from
+                    # the spooled copy instead of failing the query.
+                    part_i = int(parts[4])
+                    token_i = int(parts[5])
+                    reader_i = int(parts[6]) if len(parts) == 7 else 0
+                    from presto_tpu.parallel.buffer import TaskFailed
                     buf = outer.buffers.get(parts[2])
                     if buf is None:
-                        self._send_json({"error": "no such buffer"}, 404)
-                        return
-                    from presto_tpu.parallel.buffer import TaskFailed
-                    try:
-                        blob, nxt, complete = buf.page(
-                            int(parts[4]), int(parts[5]),
-                            int(parts[6]) if len(parts) == 7 else 0)
-                    except TaskFailed as tf:
-                        self._send_json({"error": str(tf)}, 500)
-                        return
+                        sp = outer.spool_page(parts[2], part_i,
+                                              token_i)
+                        if sp is None:
+                            self._send_json(
+                                {"error": "no such buffer"}, 404)
+                            return
+                        blob, nxt, complete = sp
+                    else:
+                        try:
+                            blob, nxt, complete = buf.page(
+                                part_i, token_i, reader_i)
+                        except TaskFailed as tf:
+                            sp = outer.spool_page(parts[2], part_i,
+                                                  token_i)
+                            if sp is None:
+                                self._send_json({"error": str(tf)},
+                                                500)
+                                return
+                            blob, nxt, complete = sp
                     if blob:
                         _EXCHANGE_PAGES.inc(node=outer.node_id)
                         _EXCHANGE_BYTES.inc(len(blob),
@@ -497,7 +562,37 @@ class WorkerServer(HttpService):
                     for tid in list(outer.task_state):
                         if tid.startswith(prefix):
                             outer.task_state.pop(tid, None)
+                    if outer.spool is not None:
+                        outer.spool.delete_prefix(prefix)
                     self._send_json({})
+                    return
+                self._send_json({"error": "not found"}, 404)
+
+            def do_PUT(self):  # noqa: N802
+                if not self._authorized():
+                    return
+                if self.path == "/v1/info/state":
+                    # graceful drain (reference NodeState SHUTTING_DOWN
+                    # over PUT /v1/info/state): stop ACCEPTING tasks,
+                    # let running ones finish, keep serving buffers;
+                    # the coordinator stops scheduling to this node.
+                    # ACTIVE re-enables (tests + rolling restarts).
+                    body = self._read_json()
+                    state = (body.get("state")
+                             if isinstance(body, dict) else body)
+                    state = str(state or "").upper()
+                    if state == "SHUTTING_DOWN":
+                        outer.set_state("shutting_down")
+                    elif state == "ACTIVE":
+                        outer.set_state("active")
+                    else:
+                        self._send_json(
+                            {"error": f"unknown state {state!r}"}, 400)
+                        return
+                    LOG.log("worker_state", node=outer.node_id,
+                            state=outer.state)
+                    self._send_json({"nodeId": outer.node_id,
+                                     "state": outer.state})
                     return
                 self._send_json({"error": "not found"}, 404)
 
@@ -508,6 +603,29 @@ class WorkerServer(HttpService):
                     self._send_json({"error": "not found"}, 404)
                     return
                 req = self._read_json()
+                fkey = (f"{outer.node_id}:"
+                        f"{req.get('task_id') or ''}")
+                if FAULTS.should_fire("worker-task-crash", key=fkey):
+                    # simulate the worker dying mid-dispatch: the
+                    # connection drops with no response, which the
+                    # coordinator sees exactly like a crashed node
+                    try:
+                        self.connection.shutdown(socket.SHUT_RDWR)
+                    except OSError:
+                        pass
+                    self.connection.close()
+                    return
+                if FAULTS.should_fire("task-post-503", key=fkey):
+                    self._send_json(
+                        {"error": "injected service unavailable"}, 503)
+                    return
+                if not outer.accepting_tasks():
+                    # draining: 503 is classified transient, so a
+                    # retrying coordinator re-dispatches elsewhere
+                    self._send_json(
+                        {"error": f"worker {outer.node_id} is "
+                                  "shutting down"}, 503)
+                    return
                 # propagated trace context: worker spans parent under
                 # the coordinator's task-dispatch span
                 ctx = OT.parse_context(
@@ -534,9 +652,23 @@ class WorkerServer(HttpService):
                             # consumer exists, so its cap is unbounded
                             cap = (BUFFER_BYTES if req.get("async")
                                    else 1 << 62)
+                            # spooling is opt-in per task ("spool":
+                            # true rides retry_policy=TASK payloads,
+                            # whose task ids are per-shard unique):
+                            # QUERY-mode stages share one task id
+                            # across workers, which would collide in
+                            # a shared spool directory
+                            writer = None
+                            if outer.spool is not None \
+                                    and req.get("spool"):
+                                try:
+                                    writer = outer.spool.writer(tid)
+                                except ValueError:
+                                    writer = None  # unspoolable id
                             outer.buffers[tid] = OutputBuffer(
                                 nparts, cap,
-                                readers=int(req.get("readers", 1)))
+                                readers=int(req.get("readers", 1)),
+                                spool=writer)
                         if req.get("async"):
                             outer.task_state[tid] = {
                                 "state": "running"}
@@ -614,3 +746,28 @@ class WorkerServer(HttpService):
                         {"error": f"{type(e).__name__}: {e}"}, 500)
 
         super().__init__(Handler, host, port, tls=tls)
+
+    # -- lifecycle state (graceful drain) --------------------------------
+
+    @property
+    def state(self) -> str:
+        # task POSTs read this concurrently with drain PUTs
+        with self._lock:
+            return self._state
+
+    def set_state(self, state: str) -> None:
+        with self._lock:
+            self._state = state
+
+    def accepting_tasks(self) -> bool:
+        return self.state == "active"
+
+    def spool_page(self, task_id: str, partition: int, token: int):
+        """(blob, next, complete) from the spool, or None when the
+        task is not spooled here (caller decides how to fail)."""
+        if self.spool is None:
+            return None
+        try:
+            return self.spool.page(task_id, partition, token)
+        except (FileNotFoundError, ValueError, OSError):
+            return None

@@ -507,7 +507,7 @@ class _Handler(JsonHandler):
             return
         if self.path == "/v1/cluster":
             qs = self.manager.snapshot()
-            self._send_json({
+            out = {
                 "runningQueries": sum(q.state == "RUNNING" for q in qs),
                 "queuedQueries": sum(q.state == "QUEUED" for q in qs),
                 "finishedQueries": sum(q.state == "FINISHED"
@@ -515,7 +515,18 @@ class _Handler(JsonHandler):
                 "failedQueries": sum(q.state in ("FAILED", "CANCELED")
                                      for q in qs),
                 "totalQueries": len(qs),
-            })
+            }
+            cluster = self.manager.cluster
+            if cluster is not None:
+                # node lifecycle visibility for the FT subsystem: a
+                # draining worker shows alive but not schedulable
+                # (operators watch the drain complete here before
+                # stopping the process)
+                out["workers"] = [
+                    {"uri": w.uri, "alive": w.alive,
+                     "schedulable": w.schedulable}
+                    for w in cluster.workers]
+            self._send_json(out)
             return
         if self.path == "/v1/info":
             self._send_json({

@@ -129,6 +129,45 @@ SYSTEM_SESSION_PROPERTIES: dict[str, tuple[Any, type, str]] = {
                                "serial; XLA compilation releases the "
                                "GIL, so a wave of independent "
                                "segments compiles in parallel)"),
+    "retry_policy": ("QUERY", str,
+                     "NONE | QUERY | TASK (ft/retry.py; reference "
+                     "retry-policy). NONE fails the query on the "
+                     "first node/task failure, QUERY re-runs the "
+                     "whole fragmented attempt on surviving workers, "
+                     "TASK re-dispatches only failed fragment tasks "
+                     "over the spooled exchange"),
+    "task_retry_attempts": (4, int,
+                            "max attempts per fragment task under "
+                            "retry_policy=TASK (reference "
+                            "task-retry-attempts-per-task)"),
+    "query_retry_attempts": (1, int,
+                             "max whole-DAG retries under "
+                             "retry_policy=QUERY (reference "
+                             "query-retry-attempts)"),
+    "retry_initial_delay_s": (0.05, float,
+                              "base of the exponential full-jitter "
+                              "retry backoff (ft/retry.py "
+                              "BackoffPolicy)"),
+    "retry_max_delay_s": (2.0, float,
+                          "cap on a single retry backoff sleep"),
+    "retry_deadline_s": (0.0, float,
+                         "per-query wall-clock retry budget in "
+                         "seconds (0 = unlimited); an exhausted "
+                         "budget fails the query loudly instead of "
+                         "retrying forever"),
+    "exchange_spooling": (True, bool,
+                          "persist buffered task output pages to the "
+                          "worker spool directory "
+                          "(PRESTO_TPU_SPOOL_DIR) so TASK retries "
+                          "re-fetch a dead producer's pages instead "
+                          "of recomputing (ft/spool.py; no-op when "
+                          "no spool directory is configured)"),
+    "task_request_timeout_s": (300.0, float,
+                               "HTTP deadline for coordinator->worker "
+                               "task POSTs (was hard-coded 300)"),
+    "heartbeat_timeout_s": (2.0, float,
+                            "HTTP deadline for failure-detector "
+                            "pings (was hard-coded 2)"),
 }
 
 

@@ -115,10 +115,151 @@ def _fold_plain_grouping(node):
     return None
 
 
+def _rel_alias(r: A.Relation) -> str | None:
+    if isinstance(r, A.AliasedRelation):
+        return r.alias
+    if isinstance(r, A.TableRef):
+        return r.parts[-1]
+    return None
+
+
+def _full_join_anti_key(on: A.Expression,
+                        left_alias: str) -> A.Dereference | None:
+    """A left-side equi-join key column out of the ON condition: in
+    ``L LEFT JOIN R``'s flipped anti branch, that column is NULL
+    exactly on the R rows with no L match (an equality never matches
+    through NULL, so matched rows always carry a non-null key).
+
+    Only top-level AND conjuncts qualify: an equality under OR/NOT
+    is not implied by a match (``ON l.a = r.a OR l.b = r.b`` can
+    match rows whose ``l.a`` is NULL, so anti-filtering on it would
+    duplicate those rows)."""
+    def conjuncts(e):
+        if isinstance(e, A.LogicalOp) and e.op == "and":
+            for t in e.terms:
+                yield from conjuncts(t)
+        else:
+            yield e
+    for node in conjuncts(on):
+        if isinstance(node, A.BinaryOp) and node.op == "=":
+            for side in (node.left, node.right):
+                if isinstance(side, A.Dereference) \
+                        and side.parts[0] == left_alias:
+                    return side
+    return None
+
+
+def _walk_expr(e):
+    import dataclasses as _dc
+    if not _dc.is_dataclass(e) or isinstance(e, type):
+        return
+    yield e
+    for f in _dc.fields(e):
+        v = getattr(e, f.name)
+        for item in (v if isinstance(v, tuple) else (v,)):
+            if _dc.is_dataclass(item) and not isinstance(item, type):
+                yield from _walk_expr(item)
+
+
+def _emulate_full_join(s: A.QuerySpec) -> A.QuerySpec | None:
+    """Rewrite ``SELECT ... FROM L la FULL JOIN R ra ON cond ...``
+    for sqlite builds without FULL OUTER JOIN support (< 3.39): the
+    join becomes a derived table
+
+        SELECT <refs> FROM L la LEFT JOIN R ra ON cond
+        UNION ALL
+        SELECT <refs> FROM R ra LEFT JOIN L la ON cond
+        WHERE la.<key> IS NULL           -- anti-joined right rows
+
+    exposing exactly the alias-qualified columns the outer SELECT /
+    WHERE / GROUP BY reference (collected from the spec and renamed
+    ``cN``), with those references rewritten to the derived columns.
+    Aggregates, windows, and the original WHERE stay in the OUTER
+    spec, so their semantics over the unioned rows are unchanged.
+    Returns None when the shape doesn't apply (no full join, or no
+    equi-key to anti-join on)."""
+    import dataclasses as _dc
+    from presto_tpu.sql.grouping import rewrite_ast as _ra
+
+    jr = s.from_relation
+    if not isinstance(jr, A.JoinRelation) or jr.join_type != "full" \
+            or jr.on is None:
+        return None
+    la, ra = _rel_alias(jr.left), _rel_alias(jr.right)
+    if la is None or ra is None:
+        return None
+    anti = _full_join_anti_key(jr.on, la)
+    if anti is None:
+        return None
+
+    # every alias-qualified column the spec references (select items,
+    # where, group by, having — sub-queries included: a correlated
+    # reference to the join's columns must resolve against the derived
+    # table too)
+    refs: dict[A.Expression, str] = {}
+
+    def collect(node):
+        if isinstance(node, A.Dereference) and node.parts[0] in (la,
+                                                                 ra):
+            refs.setdefault(node, f"c{len(refs)}")
+        return None
+
+    for item in s.select_items:
+        _ra(item.expression, collect)
+    if s.where is not None:
+        _ra(s.where, collect)
+    for g in s.group_by:
+        for e in g.expressions:
+            _ra(e, collect)
+    if s.having is not None:
+        _ra(s.having, collect)
+    if not refs:
+        return None
+    refs.setdefault(anti, f"c{len(refs)}")
+
+    items = tuple(A.SelectItem(e, name) for e, name in refs.items())
+    b1 = A.QuerySpec(select_items=items,
+                     from_relation=A.JoinRelation(
+                         "left", jr.left, jr.right, on=jr.on))
+    b2 = A.QuerySpec(select_items=items,
+                     from_relation=A.JoinRelation(
+                         "left", jr.right, jr.left, on=jr.on),
+                     where=A.IsNullPredicate(anti, negated=False))
+    union = A.SetOperation("union", distinct=False, left=b1, right=b2)
+    derived = A.AliasedRelation(
+        A.SubqueryRelation(A.Query(union)), "__full_join__")
+
+    def substitute(node):
+        name = refs.get(node)
+        return A.Identifier(name) if name is not None else None
+
+    new_items = tuple(
+        A.SelectItem(_ra(i.expression, substitute), i.alias)
+        for i in s.select_items)
+    new_where = (_ra(s.where, substitute)
+                 if s.where is not None else None)
+    new_group = tuple(
+        _dc.replace(g, expressions=tuple(
+            _ra(e, substitute) for e in g.expressions))
+        for g in s.group_by)
+    new_having = (_ra(s.having, substitute)
+                  if s.having is not None else None)
+    return _dc.replace(s, select_items=new_items,
+                       from_relation=derived, where=new_where,
+                       group_by=new_group, having=new_having)
+
+
 def _spec(s: A.QuerySpec) -> str:
+    import sqlite3
+
     import dataclasses as _dc
     from presto_tpu.sql.grouping import (expand_grouping_sets,
                                          resolve_ordinal, rewrite_ast)
+    if sqlite3.sqlite_version_info < (3, 39):
+        # host sqlite predates native FULL/RIGHT OUTER JOIN: emulate
+        rewritten = _emulate_full_join(s)
+        if rewritten is not None:
+            s = rewritten
     gsets = expand_grouping_sets(s)
     if gsets is None:
         if s.group_by:

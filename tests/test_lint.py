@@ -344,6 +344,47 @@ def test_tracer_plain_wrapping_decorator_is_not_a_root(tmp_path):
     assert "kernel" in findings[0].message
 
 
+# -- timeout discipline -----------------------------------------------------
+
+
+def test_timeout_discipline_flags_deadline_free_urlopen(tmp_path):
+    """Every urlopen/_urlopen call site must spell timeout= — a
+    deadline-free internal HTTP call hangs a thread on a dead peer."""
+    pkg = write_pkg(tmp_path, {"presto_tpu/parallel/broken.py": """
+        import urllib.request
+        from presto_tpu.server.httpbase import urlopen as _urlopen
+
+        def bad(req):
+            with urllib.request.urlopen(req) as r:  # no deadline
+                return r.read()
+
+        def also_bad(req):
+            with _urlopen(req) as r:
+                return r.read()
+
+        def fine(req):
+            with _urlopen(req, timeout=10.0) as r:
+                return r.read()
+
+        def threaded_fine(req, timeout):
+            return urllib.request.urlopen(req, timeout=timeout)
+    """})
+    findings = run_lint([pkg], rules=["timeout-discipline"])
+    assert len(findings) == 2, [f.format() for f in findings]
+    assert all("timeout=" in f.message for f in findings)
+    assert {f.line for f in findings} == {6, 10}
+
+
+def test_timeout_discipline_suppressible(tmp_path):
+    pkg = write_pkg(tmp_path, {"presto_tpu/exec/broken.py": """
+        import urllib.request
+
+        def bad(req):  # lint: disable on the call line works
+            return urllib.request.urlopen(req)  # lint: disable=timeout-discipline
+    """})
+    assert run_lint([pkg], rules=["timeout-discipline"]) == []
+
+
 # -- dispatch exhaustiveness ------------------------------------------------
 
 DISPATCH_NODES = """

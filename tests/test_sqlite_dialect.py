@@ -1,0 +1,67 @@
+"""Unit tests for the sqlite oracle dialect's FULL OUTER JOIN
+emulation (sqlite < 3.39 has no FULL JOIN): the LEFT JOIN ∪
+anti-joined-right rewrite must be byte-equivalent to a real full
+join, and must DECLINE (return None) when no anti-join key is
+implied by every matched row — anti-filtering on an equality found
+under OR/NOT would duplicate rows matched through another disjunct.
+"""
+
+from __future__ import annotations
+
+import sqlite3
+
+import presto_tpu.sql.ast as A
+from presto_tpu.sql.parser import parse_statement
+from presto_tpu.sql.sqlite_dialect import (
+    _emulate_full_join, _full_join_anti_key, to_sqlite)
+
+
+def _spec(sql: str) -> A.QuerySpec:
+    q = parse_statement(sql)
+    return q.query.body
+
+
+def test_anti_key_from_conjuncts():
+    s = _spec("SELECT * FROM l la FULL JOIN r ra"
+              " ON la.a = ra.a AND la.b = ra.b")
+    key = _full_join_anti_key(s.from_relation.on, "la")
+    assert isinstance(key, A.Dereference) and key.parts == ("la", "a")
+
+
+def test_anti_key_declines_disjunctive_on():
+    # ON l.a = r.a OR l.b = r.b can match rows whose l.a is NULL, so
+    # no single left column is non-null on every matched row
+    s = _spec("SELECT * FROM l la FULL JOIN r ra"
+              " ON la.a = ra.a OR la.b = ra.b")
+    assert _full_join_anti_key(s.from_relation.on, "la") is None
+    assert _emulate_full_join(s) is None
+
+
+def test_anti_key_declines_negated_on():
+    s = _spec("SELECT * FROM l la FULL JOIN r ra"
+              " ON NOT (la.a = ra.a)")
+    assert _full_join_anti_key(s.from_relation.on, "la") is None
+
+
+def test_emulation_matches_full_join_semantics():
+    # hand-computed full-join over tables with NULL keys and
+    # unmatched rows on both sides
+    conn = sqlite3.connect(":memory:")
+    conn.execute("CREATE TABLE l (a INTEGER, v TEXT)")
+    conn.execute("CREATE TABLE r (a INTEGER, w TEXT)")
+    conn.executemany("INSERT INTO l VALUES (?, ?)",
+                     [(1, "l1"), (2, "l2"), (None, "lN")])
+    conn.executemany("INSERT INTO r VALUES (?, ?)",
+                     [(2, "r2"), (3, "r3"), (None, "rN")])
+    s = _spec("SELECT la.v, ra.w FROM l la FULL JOIN r ra"
+              " ON la.a = ra.a")
+    rewritten = _emulate_full_join(s)
+    assert rewritten is not None
+    sql = to_sqlite(A.Query(rewritten))
+    assert "FULL JOIN" not in sql.upper()
+    got = sorted(conn.execute(sql).fetchall(),
+                 key=lambda t: (str(t[0]), str(t[1])))
+    want = sorted([("l1", None), ("l2", "r2"), ("lN", None),
+                   (None, "r3"), (None, "rN")],
+                  key=lambda t: (str(t[0]), str(t[1])))
+    assert got == want
