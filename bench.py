@@ -45,6 +45,14 @@ obs compile histogram. The store persists across bench invocations,
 so repeat runs' "cold" measurements are warm too — which is what
 finally fits Q9 inside the budget.
 
+``bench.py --serve`` (also folded into the default run as serve_*
+detail keys, in its own subprocess) drives N concurrent HTTP clients
+through the real protocol against an in-process coordinator and
+reports sustained queries/sec, p50/p99 latency, and error counts —
+the concurrent-serving scale metric. Knobs:
+PRESTO_TPU_BENCH_SERVE_CLIENTS (4), PRESTO_TPU_BENCH_SERVE_S (20),
+PRESTO_TPU_BENCH_SERVE_SF (0.01).
+
 Env knobs: PRESTO_TPU_BENCH_SF (default 10), PRESTO_TPU_BENCH_REPS (2),
 PRESTO_TPU_BENCH_BUDGET_S (default 600), PRESTO_TPU_BENCH_Q9_RESERVE_S
 (default 150 — Q9's guaranteed slice), PRESTO_TPU_TPCH_CACHE (default
@@ -165,6 +173,120 @@ def warm_metrics(detail: dict, name: str, nrows: int, sf: float,
     detail[f"{name}_warm_compiles"] = r.get("programs_compiled")
     detail[f"{name}_warm_cache_hits_disk"] = r.get("cache_hits_disk")
     detail[f"{name}_warm_compile_s"] = r.get("compile_s")
+
+
+# -- concurrent-serving QPS bench (bench.py --serve) -------------------------
+# Drives N concurrent HTTP clients through the REAL protocol (POST
+# /v1/statement + nextUri polling) against an in-process coordinator,
+# reporting sustained queries/sec and p50/p99 latency — the scale
+# metric ROADMAP item 1 asks for alongside rows/s. The query mix is
+# deliberately small-shape (compiled once in a warmup pass) so the
+# numbers measure the SERVING path — dispatch, admission, session
+# overrides, result paging — not XLA compile.
+
+SERVE_QUERIES = (
+    "select count(*) from nation",
+    "select r_name, count(*) as c from region group by r_name "
+    "order by r_name",
+    "select n_regionkey, count(*) as c from nation "
+    "group by n_regionkey order by n_regionkey",
+    "select count(*) from supplier where s_acctbal > 0",
+)
+
+
+def _quantile_ms(sorted_s: list, q: float) -> float:
+    if not sorted_s:
+        return 0.0
+    idx = min(len(sorted_s) - 1, int(q * len(sorted_s)))
+    return round(sorted_s[idx] * 1e3, 2)
+
+
+def run_serve_bench() -> dict:
+    """The --serve mode body: returns (and prints) the serve detail."""
+    import threading
+
+    from presto_tpu import Engine
+    from presto_tpu.client import Client, QueryFailed
+    from presto_tpu.connectors.tpch import TpchConnector
+    from presto_tpu.server import CoordinatorServer
+
+    nclients = int(os.environ.get("PRESTO_TPU_BENCH_SERVE_CLIENTS",
+                                  "4"))
+    duration = float(os.environ.get("PRESTO_TPU_BENCH_SERVE_S", "20"))
+    sf = float(os.environ.get("PRESTO_TPU_BENCH_SERVE_SF", "0.01"))
+    engine = Engine()
+    engine.register_catalog("tpch", TpchConnector(scale=sf))
+    srv = CoordinatorServer(engine).start()
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        warm = Client(base, user="bench")
+        for q in SERVE_QUERIES:
+            warm.execute(q)  # compile outside the timed window
+
+        latencies: list[list] = [[] for _ in range(nclients)]
+        errors = [0] * nclients
+        deadline = time.perf_counter() + duration
+
+        def drive(i: int) -> None:
+            c = Client(base, user=f"bench{i}")
+            n = 0
+            while time.perf_counter() < deadline:
+                sql = SERVE_QUERIES[(i + n) % len(SERVE_QUERIES)]
+                t0 = time.perf_counter()
+                try:
+                    c.execute(sql, poll_interval=0.005)
+                    latencies[i].append(time.perf_counter() - t0)
+                except QueryFailed:
+                    errors[i] += 1
+                except Exception:  # noqa: BLE001 - transport hiccups
+                    # a dead driver thread would silently skew
+                    # serve_qps; count the failure and keep driving
+                    errors[i] += 1
+                n += 1
+
+        threads = [threading.Thread(target=drive, args=(i,))
+                   for i in range(nclients)]
+        t_start = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t_start
+        all_lat = sorted(x for per in latencies for x in per)
+        completed = len(all_lat)
+        return {
+            "serve_clients": nclients,
+            "serve_seconds": round(wall, 1),
+            "serve_sf": sf,
+            "serve_queries_completed": completed,
+            "serve_qps": round(completed / max(wall, 1e-9), 1),
+            "serve_p50_ms": _quantile_ms(all_lat, 0.50),
+            "serve_p99_ms": _quantile_ms(all_lat, 0.99),
+            "serve_errors": sum(errors),
+        }
+    finally:
+        srv.stop()
+
+
+def serve_metrics(detail: dict, budget_left: float) -> None:
+    """Run the QPS bench in its OWN subprocess (the parent stays off
+    the device, same isolation rationale as measure_query) and fold
+    the serve_* keys into the bench detail."""
+    need = float(os.environ.get("PRESTO_TPU_BENCH_SERVE_S", "20")) + 60
+    if budget_left <= need:
+        detail["serve_skipped"] = "bench time budget exhausted"
+        return
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--serve"],
+            capture_output=True, text=True,
+            timeout=min(budget_left - 10, need + 120),
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+        line = (proc.stdout or "").strip().splitlines()[-1]
+        out = json.loads(line)
+        detail.update(out.get("detail") or {})
+    except Exception as exc:  # noqa: BLE001 - serve is additive
+        detail["serve_error"] = repr(exc)[:200]
 
 
 def _cols(table, names):
@@ -307,6 +429,13 @@ def numpy_q5(li, orders, cust, supp, asia_nations) -> float:
 
 
 def main() -> None:
+    if "--serve" in sys.argv[1:]:
+        out = run_serve_bench()
+        print(json.dumps({
+            "metric": "serve_qps", "value": out["serve_qps"],
+            "unit": "queries/s", "detail": out}))
+        return
+
     sf = float(os.environ.get("PRESTO_TPU_BENCH_SF", "10"))
     reps = int(os.environ.get("PRESTO_TPU_BENCH_REPS", "2"))
     budget = float(os.environ.get("PRESTO_TPU_BENCH_BUDGET_S", "600"))
@@ -448,6 +577,10 @@ def main() -> None:
         if f"{name}_rows_per_sec" in detail or name == "q01":
             warm_metrics(detail, name, nrows, sf,
                          budget - (time.perf_counter() - t_start))
+
+    # concurrent-serving QPS + latency (own subprocess, tiny SF): the
+    # scale numbers ride the same BENCH json as the throughput ones
+    serve_metrics(detail, budget - (time.perf_counter() - t_start))
 
     print(json.dumps({**headline, "detail": detail}))
 

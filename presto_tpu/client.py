@@ -10,13 +10,20 @@ from __future__ import annotations
 
 import json
 import time
+import urllib.error
 import urllib.request
 
 from presto_tpu.server.httpbase import urlopen as _urlopen
 
 
 class QueryFailed(Exception):
-    pass
+    """Carries the protocol error code (reference errorName —
+    QUERY_QUEUE_FULL, CLUSTER_OUT_OF_MEMORY, EXCEEDED_TIME_LIMIT, ...)
+    so callers triage overload shedding vs real failures."""
+
+    def __init__(self, message: str, error_name: str | None = None):
+        super().__init__(message)
+        self.error_name = error_name
 
 
 class Client:
@@ -47,8 +54,21 @@ class Client:
             cred = base64.b64encode(
                 f"{self.user}:{self.password}".encode()).decode()
             req.add_header("Authorization", f"Basic {cred}")
-        with _urlopen(req, timeout=300) as resp:
-            return json.loads(resp.read() or b"{}")
+        try:
+            with _urlopen(req, timeout=300) as resp:
+                return json.loads(resp.read() or b"{}")
+        except urllib.error.HTTPError as e:
+            # overload shedding answers 429 with the QueryResults JSON
+            # (QUERY_QUEUE_FULL + Retry-After); surface it as a result
+            # so execute() raises the classified QueryFailed. Other
+            # statuses (401 auth, 404 ownership) propagate untouched.
+            if e.code != 429:
+                raise
+            body = e.read()
+            try:
+                return json.loads(body)
+            except (ValueError, TypeError):
+                raise e from None
 
     def execute(self, sql: str, poll_interval: float = 0.02):
         """Run SQL; returns (columns, rows). Blocks until FINISHED.
@@ -61,7 +81,8 @@ class Client:
         self.warnings = []
         while True:
             if "error" in out and out["error"]:
-                raise QueryFailed(out["error"].get("message", "failed"))
+                raise QueryFailed(out["error"].get("message", "failed"),
+                                  out["error"].get("errorName"))
             if out.get("columns"):
                 columns = out["columns"]
             if out.get("setSession"):

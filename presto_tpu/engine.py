@@ -73,6 +73,11 @@ class Engine:
         # diagnostics
         import threading as _threading
         self._warn_tl = _threading.local()
+        # per-THREAD one-shot plan handoff (offer_preplanned /
+        # take_preplanned): the HTTP admission layer plans a query to
+        # size its memory reservation; the execution path on the same
+        # thread reuses that plan instead of planning twice
+        self._preplanned_tl = _threading.local()
         # query lifecycle events + history (events.py)
         self.events = EventListenerManager()
         # engine-owned virtual catalogs (reference information_schema +
@@ -168,10 +173,12 @@ class Engine:
             stmt = rewrite_statement(parse_statement(sql), self)
             if not isinstance(stmt, A.QueryStatement):
                 raise ValueError("execute_table expects a SELECT query")
+            preplanned = self.take_preplanned(sql)
             with self._cancel_scope(cancel_token):
                 return monitored(
                     self, sql,
-                    lambda: self._execute_query(stmt.query, mesh))
+                    lambda: self._execute_query(stmt.query, mesh,
+                                                preplanned=preplanned))
         finally:
             self._warn_tl.value = WC.list()
             W.pop()
@@ -206,12 +213,54 @@ class Engine:
         from presto_tpu.plan.planner import LogicalPlanner
         from presto_tpu.plan.optimizer import optimize
 
+        import time as _time
+
+        t0 = _time.monotonic()
         with TRACER.span("plan"):
             stmt = parse_statement(sql)
             analysis = Analyzer(self).analyze(stmt)
+            self._planning_checkpoint(t0)
             plan = LogicalPlanner(self, analysis).plan(stmt)
+            self._planning_checkpoint(t0)
             plan = optimize(plan, self, enable_latemat=enable_latemat)
+            self._planning_checkpoint(t0)
         return plan, analysis
+
+    def offer_preplanned(self, sql: str, plan) -> None:
+        """Hand a just-built plan for ``sql`` to THIS THREAD's next
+        execution of the same statement (the admission layer plans to
+        size its reservation; replanning identical SQL under the same
+        session on the same thread would double the planning cost).
+        One-shot: consumed by the next take_preplanned, and cleared by
+        clear_preplanned when the offering scope exits."""
+        self._preplanned_tl.value = (sql, plan)
+
+    def take_preplanned(self, sql: str):
+        """Consume the thread's offered plan if it matches ``sql``."""
+        offered = getattr(self._preplanned_tl, "value", None)
+        self._preplanned_tl.value = None
+        if offered is not None and offered[0] == sql:
+            return offered[1]
+        return None
+
+    def clear_preplanned(self) -> None:
+        self._preplanned_tl.value = None
+
+    def _planning_checkpoint(self, t0: float) -> None:
+        """Planning-phase seam: observe cancellation (a reaped or
+        killed query stops planning) and enforce the session's
+        ``query_max_planning_time`` (reference QueryTracker
+        enforceTimeLimits on queries stuck in planning)."""
+        import time as _time
+
+        from presto_tpu.exec import cancel as C
+
+        C.checkpoint()
+        limit = float(self.session.get("query_max_planning_time") or 0)
+        if limit and _time.monotonic() - t0 > limit:
+            raise C.TimeLimitExceeded(
+                f"query exceeded query_max_planning_time "
+                f"({limit:g}s)")
 
     def explain(self, sql: str) -> str:
         from presto_tpu.cost import explain_estimates
@@ -222,25 +271,36 @@ class Engine:
 
     # -- internals ----------------------------------------------------------
 
-    def _plan_query(self, query):
+    def _plan_query(self, query, preplanned=None):
         from presto_tpu.plan.optimizer import optimize
         from presto_tpu.plan.planner import LogicalPlanner
         from presto_tpu.sql import ast as A
 
         from presto_tpu.plan.sanity import validate_plan
 
+        import time as _time
+
+        if preplanned is not None:
+            # admission already planned this exact SQL on this thread
+            # (plan_sql, same session scope); only the pre-execution
+            # invariant validation remains
+            validate_plan(preplanned)
+            return preplanned
+        t0 = _time.monotonic()
         with TRACER.span("plan"):
             planner = LogicalPlanner(self, None)
             plan = planner.plan(A.QueryStatement(query))
+            self._planning_checkpoint(t0)
             plan = optimize(plan, self)
+            self._planning_checkpoint(t0)
             # invariant validation before execution (reference
             # PlanSanityChecker runs after every optimizer stage)
             validate_plan(plan)
         return plan
 
-    def _execute_query(self, query, mesh=None) -> Table:
+    def _execute_query(self, query, mesh=None, preplanned=None) -> Table:
         self.last_spill = None
-        plan = self._plan_query(query)
+        plan = self._plan_query(query, preplanned=preplanned)
         if mesh is not None:
             from presto_tpu.parallel.executor import (
                 execute_plan_distributed)

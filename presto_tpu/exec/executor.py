@@ -788,6 +788,20 @@ def run_plan_device(engine, plan: N.PlanNode,
     return device_outputs(meta, res, live) + (None,)
 
 
+def _pool_wait(engine) -> tuple[float, float]:
+    """(block_s, kill_after_s) for memory-pool reservations: how long
+    an over-capacity reservation blocks for concurrent queries to free
+    bytes, and when sustained exhaustion triggers the low-memory killer
+    (memory.MemoryPool.reserve; both 0 by default — the single-query
+    fail-fast behavior)."""
+    try:
+        sess = engine.session
+        return (float(sess.get("memory_reserve_timeout_s") or 0.0),
+                float(sess.get("low_memory_killer_delay_s") or 0.0))
+    except Exception:  # noqa: BLE001 - engines without a session
+        return (0.0, 0.0)
+
+
 def _contains_carrier(node: N.PlanNode, names: set[str]) -> bool:
     """Does a subtree scan any of the named __segment__ carriers?"""
     if isinstance(node, N.TableScan):
@@ -881,9 +895,13 @@ def _segment_carriers(engine, plan: N.PlanNode, pool_tag: str,
                 # reserve inside the job, as the serial loop did: an
                 # over-budget pipeline must raise MemoryLimitExceeded
                 # before FURTHER segments materialize (with width=1
-                # this is exactly the old segment-by-segment guard)
-                pool.reserve(pool_tag, sum(
-                    int(a.nbytes) for a in out[0].values()))
+                # this is exactly the old segment-by-segment guard).
+                # Freed by the CALLER's finally (_execute_segmented /
+                # run_plan_live / profile.explain_analyze own pool_tag).
+                block_s, kill_s = _pool_wait(engine)
+                pool.reserve(pool_tag, sum(  # lint: disable=pool-discipline
+                    int(a.nbytes) for a in out[0].values()),
+                    block_s=block_s, kill_after_s=kill_s, owner=_tok)
             return out + (time.perf_counter() - _t0,)
 
         results = PC.map_parallel(
@@ -1071,18 +1089,24 @@ def run_plan(engine, plan: N.PlanNode,
     pool = getattr(engine, "memory_pool", None)
     tag = uuid.uuid4().hex[:12]
     if pool is not None:
+        from presto_tpu.exec import cancel as _cancel
+        block_s, kill_s = _pool_wait(engine)
+        owner = _cancel.current()
         # host (numpy) inputs only: device-resident segment carriers
         # are already reserved under their pipeline's seg- tag
         pool.reserve(tag, sum(
             a.nbytes for scan in scan_inputs
             for a in scan.arrays.values()
-            if isinstance(a, np.ndarray)))
+            if isinstance(a, np.ndarray)),
+            block_s=block_s, kill_after_s=kill_s, owner=owner)
     try:
         _compiled, _flat, meta, (res, live, _oks) = prepare_plan(
             engine, plan, scan_inputs)
         if pool is not None:
             # device-side shape math only — no transfer
-            pool.reserve(tag, sum(int(r.nbytes) for r in res))
+            pool.reserve(tag, sum(int(r.nbytes) for r in res),
+                         block_s=block_s, kill_after_s=kill_s,
+                         owner=owner)
 
         # one batched device->host transfer for every output column:
         # per-array np.asarray pays a tunnel round-trip each

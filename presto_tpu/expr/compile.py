@@ -654,6 +654,37 @@ def _mod(e, args):
     a, b = args
     if isinstance(e.dtype, T.DoubleType):
         a, b = cast_val(a, T.DOUBLE), cast_val(b, T.DOUBLE)
+    elif (is_long_dec(a.dtype) or is_long_dec(b.dtype)
+          or is_long_dec(e.dtype)):
+        # LONG decimal remainder via int128 (the int64 align/fmod
+        # below would broadcast over the [n,2] limb arrays and decode
+        # garbage — ADVICE r5 medium). Scales align up to the result
+        # scale s = max(sa, sb); the remainder of the aligned values
+        # is already at scale s (= e.dtype.scale by the planner's %
+        # derivation).
+        from presto_tpu.ops import int128 as I
+        sa = a.dtype.scale if isinstance(a.dtype, T.DecimalType) else 0
+        sb = b.dtype.scale if isinstance(b.dtype, T.DecimalType) else 0
+        s = max(sa, sb)
+        pa = (a.dtype.precision
+              if isinstance(a.dtype, T.DecimalType) else 19)
+        pb = (b.dtype.precision
+              if isinstance(b.dtype, T.DecimalType) else 19)
+        need = max(pa + s - sa, pb + s - sb)
+        if need > 38:
+            # the planner rejects `%` with this shape at plan time;
+            # this guards the mod() function route to the same seam —
+            # aligning past 38 digits wraps int128 into a silently
+            # wrong remainder
+            raise NotImplementedError(
+                f"decimal remainder aligning {a.dtype} and {b.dtype} "
+                f"needs {need} digits, exceeding the maximum decimal "
+                f"precision 38")
+        x, y = as128(a, s), as128(b, s)
+        bz = I.eq(y, jnp.zeros_like(y))
+        r = I.rem_trunc(x, y)
+        out = r if is_long_dec(e.dtype) else I.to_i64(r)
+        return Val(e.dtype, out, and_valid(a.valid, b.valid, ~bz))
     elif isinstance(a.dtype, T.DecimalType) or \
             isinstance(b.dtype, T.DecimalType):
         # align scales: (a*f) mod (b*f) = f*(a mod b), so the scaled-
@@ -1539,10 +1570,46 @@ def _round(e, args):
         drop = a.dtype.scale - digits
         if drop <= 0:
             return Val(e.dtype, a.data, a.valid)
-        return Val(e.dtype, _div_round(a.data, 10 ** drop) * (10 ** drop)
-                   if isinstance(e.dtype, T.DecimalType) and
-                   e.dtype.scale == a.dtype.scale
-                   else _div_round(a.data, 10 ** drop), a.valid)
+        keep_scale = (isinstance(e.dtype, T.DecimalType)
+                      and e.dtype.scale == a.dtype.scale)
+        if is_long_dec(a.dtype) or is_long_dec(e.dtype):
+            # LONG decimals are [n,2] int128 limb arrays: the int64
+            # _div_round below would divide the limbs elementwise and
+            # return garbage (ADVICE r5 high) — round through int128
+            from presto_tpu.ops import int128 as I
+            d = (a.data if is_long_dec(a.dtype)
+                 else I.from_i64(a.data.astype(jnp.int64)))
+            if drop > 38:
+                # 10^drop exceeds int128 (wraps into a garbage
+                # divisor), but |x| < 10^38 <= 0.5 * 10^drop, so every
+                # value half-up rounds to exactly zero
+                q = jnp.zeros_like(d)
+                if is_long_dec(e.dtype):
+                    return Val(e.dtype, q, a.valid)
+                return Val(e.dtype, I.to_i64(q), a.valid)
+            f = I.from_i64(jnp.int64(10 ** min(drop, 18)))
+            if drop > 18:
+                f = I.rescale_up(f, drop - 18)
+            q = I.div_round_half_up(d, jnp.broadcast_to(f, d.shape))
+            if keep_scale:
+                q = I.rescale_up(q, drop)
+            elif digits < 0:
+                # result scale is 0 but the rounding unit is 10^-digits:
+                # round(123.45, -1) -> 12 tens -> 120
+                q = I.rescale_up(q, -digits)
+            if is_long_dec(e.dtype):
+                return Val(e.dtype, q, a.valid)
+            return Val(e.dtype, I.to_i64(q), a.valid)
+        if drop > 18:
+            # SHORT decimals hold |x| < 10^18 <= 0.5 * 10^drop: zero,
+            # and 10^drop would not fit the int64 divisor anyway
+            return Val(e.dtype, jnp.zeros_like(a.data), a.valid)
+        # negative digits round to multiples of 10^-digits at scale 0:
+        # the quotient counts units of 10^-digits, scale it back up
+        mult = ((10 ** drop) if keep_scale
+                else (10 ** -digits) if digits < 0 else 1)
+        return Val(e.dtype, _div_round(a.data, 10 ** drop) * mult,
+                   a.valid)
     f = 10.0 ** digits
     return Val(e.dtype, jnp.round(a.data * f) / f, a.valid)
 

@@ -37,5 +37,6 @@ from presto_tpu.lint import locks as _locks  # noqa: E402,F401
 from presto_tpu.lint import dispatch as _dispatch  # noqa: E402,F401
 from presto_tpu.lint import metrics as _metrics  # noqa: E402,F401
 from presto_tpu.lint import timeouts as _timeouts  # noqa: E402,F401
+from presto_tpu.lint import pools as _pools  # noqa: E402,F401
 
 __all__ = ["Finding", "Project", "available_rules", "run_lint"]

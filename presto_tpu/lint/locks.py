@@ -33,11 +33,16 @@ from presto_tpu.lint.core import (Finding, Project, SourceModule, rule)
 
 LOCK_SCOPES = (
     "presto_tpu/parallel/",
+    # server/ covers the concurrent-serving governance modules too
+    # (server/governance.py reaper, server/server.py admission)
     "presto_tpu/server/",
     "presto_tpu/memory.py",
     "presto_tpu/obs/",
     "presto_tpu/events.py",
     "presto_tpu/exec/progcache.py",
+    # cross-thread cancellation/kill state (the reaper and the
+    # low-memory killer write tokens other threads observe)
+    "presto_tpu/exec/cancel.py",
     "presto_tpu/ft/",
 )
 

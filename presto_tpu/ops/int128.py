@@ -204,6 +204,17 @@ def div_round_half_up(a, b):
     return jnp.where(sign_neg[..., None], neg(q), q)
 
 
+def rem_trunc(a, b):
+    """Signed remainder truncating toward zero: the result takes the
+    DIVIDEND's sign (reference UnscaledDecimal128Arithmetic.remainder,
+    SQL mod semantics). b == 0 yields 0 (callers mask validity)."""
+    ua, ub = abs_(a), abs_(b)
+    ub_safe = jnp.where(eq(ub, jnp.zeros_like(ub))[..., None],
+                        from_i64(jnp.int64(1)), ub)
+    _q, r = divmod_u(ua, ub_safe)
+    return jnp.where(is_neg(a)[..., None], neg(r), r)
+
+
 def sort_keys(v):
     """Order-preserving (primary, secondary) u64 sort-key pair: the
     sign-flipped high limb then the unsigned low limb."""

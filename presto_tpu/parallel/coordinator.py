@@ -32,6 +32,7 @@ from concurrent.futures import ThreadPoolExecutor
 import numpy as np
 
 from presto_tpu import types as T
+from presto_tpu.exec import cancel as CANCEL
 from presto_tpu.ft import retry as FTR
 from presto_tpu.ft.faults import FAULTS
 from presto_tpu.obs import trace as OT
@@ -277,21 +278,57 @@ class ClusterCoordinator:
     def execute(self, sql: str) -> list[tuple]:
         return self.execute_table(sql).to_pylist()
 
-    def execute_table(self, sql: str):
+    def execute_table(self, sql: str, query_id: str | None = None,
+                      cancel_token=None):
         """Run SQL across the cluster, returning the result Table
-        (typed columns — the HTTP coordinator frontend needs them)."""
+        (typed columns — the HTTP coordinator frontend needs them).
+
+        ``query_id`` names the worker-side task-id prefix, so the
+        caller (the HTTP QueryManager's reaper above all) can cancel
+        this query's in-flight tasks by prefix; ``cancel_token``
+        installs a cooperative cancellation scope checked between
+        stages and before every retry."""
         from presto_tpu.events import monitored
 
-        return monitored(self.engine, sql, lambda: self._execute(sql))
+        def run():
+            with self.engine._cancel_scope(cancel_token):
+                return self._execute(sql, query_id=query_id)
 
-    def _execute(self, sql: str):
+        return monitored(self.engine, sql, run)
+
+    def cancel_query(self, query_id: str) -> None:
+        """Best-effort DELETE of every worker task belonging to
+        ``query_id`` (task ids are prefixed with it): buffers are
+        dropped, producers blocked on full buffers are failed loose,
+        and spooled pages are removed — a reaped or abandoned query
+        stops burning worker time (reference HttpRemoteTask abort +
+        TaskResource DELETE). The DELETEs fan out in parallel under
+        one short bound: this runs on the single reaper thread, and a
+        dead worker (the very situation that reaps queries) must not
+        stall every other query's lifetime enforcement behind serial
+        10s connect timeouts."""
+        threads = [
+            threading.Thread(
+                target=w.delete_task, args=(query_id,),
+                kwargs={"timeout": 5.0}, daemon=True,
+                name=f"presto-tpu-cancel-{query_id}")
+            for w in list(self.workers)]
+        for t in threads:
+            t.start()
+        deadline = time.monotonic() + 5.0
+        for t in threads:
+            t.join(timeout=max(0.0, deadline - time.monotonic()))
+
+    def _execute(self, sql: str, query_id: str | None = None):
         from presto_tpu.exec.streaming import (_find_streamable,
                                                _replace_node)
 
         # plan with late materialization off: its rewritten shape
         # (dimension re-join above the aggregate) is a single-chip
         # width optimization the fragmenter cannot stage
-        plan, _ = self.engine.plan_sql(sql, enable_latemat=False)
+        plan = self.engine.take_preplanned(sql)
+        if plan is None:
+            plan, _ = self.engine.plan_sql(sql, enable_latemat=False)
         workers = self.live_workers()
         require = bool(self.engine.session.get("require_distribution"))
         allow_fb = bool(self.engine.session.get("allow_local_fallback"))
@@ -355,6 +392,9 @@ class ClusterCoordinator:
                 ws = workers
                 retries = 0
                 while True:
+                    # a canceled/reaped/memory-killed query must stop
+                    # retrying (and stop dispatching) at this seam
+                    CANCEL.checkpoint()
                     try:
                         return run(ws)
                     except (NoWorkersError, TaskError) as e:
@@ -392,7 +432,8 @@ class ClusterCoordinator:
                 if policy == "TASK":
                     try:
                         return self._execute_general_ft(
-                            plan, general, workers, deadline)
+                            plan, general, workers, deadline,
+                            query_id=query_id)
                     except (NoWorkersError, TaskError,
                             FTR.DeadlineExceeded):
                         if require or not allow_fb:
@@ -400,15 +441,16 @@ class ClusterCoordinator:
                         return run_local()
                 return _with_failover(
                     lambda ws: self._execute_general(plan, general,
-                                                     ws))
+                                                     ws,
+                                                     query_id=query_id))
             fragged = fragment_join_plan(plan)
             if fragged is not None:
                 # raw-row join shapes (no aggregate) keep stage-level
                 # QUERY failover even under TASK policy: the join
                 # fragmenter's streamed stages are not task-retryable
                 return _with_failover(
-                    lambda ws: self._execute_fragmented(plan, fragged,
-                                                        ws))
+                    lambda ws: self._execute_fragmented(
+                        plan, fragged, ws, query_id=query_id))
         found = _find_streamable(plan)
         if found is None or not workers:
             # single-node fallback: run the plan we already built (the
@@ -430,8 +472,11 @@ class ClusterCoordinator:
         # spans parent under the query
         ctx = OT.current_context()
         timeout = self._task_timeout()
+        tok = CANCEL.current()  # pool threads don't inherit it
 
         def run_one(i: int):
+            if tok is not None:
+                tok.check()
             w = workers[i]
             if not w.alive:
                 raise NoWorkersError(f"worker {w.uri} died")
@@ -538,7 +583,8 @@ class ClusterCoordinator:
         return run_plan(self.engine, plan2, [carrier_input])
 
     def _execute_general(self, plan, g,
-                         workers: list[RemoteWorker]):
+                         workers: list[RemoteWorker],
+                         query_id: str | None = None):
         """Run a generally-fragmented plan (parallel/fragmenter.py
         fragment_plan_general): stages dispatch in dependency order,
         one task per worker; partitioned stages bucket outputs into W
@@ -550,7 +596,12 @@ class ClusterCoordinator:
 
         from presto_tpu.plan.serde import fragment_to_dict
 
-        qid = uuid.uuid4().hex[:8]
+        # unique per ATTEMPT (a QUERY retry re-enters here and must
+        # not collide with the failed attempt's buffers) but prefixed
+        # by the protocol query id so cancel_query's prefix DELETE
+        # reaches every attempt
+        qid = (f"{query_id}.{uuid.uuid4().hex[:6]}" if query_id
+               else uuid.uuid4().hex[:8])
         W = len(workers)
         nparts_of: dict[str, int] = {}
         readers_of = g.consumer_readers(W)
@@ -558,6 +609,9 @@ class ClusterCoordinator:
         try:
             inline: list | None = None
             for st in g.stages:
+                # host-side seam: a canceled/reaped query stops
+                # dispatching further stages here
+                CANCEL.checkpoint()
                 frag = fragment_to_dict(st.fragment)
                 last = st.name == g.last_stage
                 payloads = []
@@ -619,7 +673,8 @@ class ClusterCoordinator:
                     pass
 
     def _execute_general_ft(self, plan, g, workers: list[RemoteWorker],
-                            deadline: FTR.Deadline):
+                            deadline: FTR.Deadline,
+                            query_id: str | None = None):
         """retry_policy=TASK execution of the general stage DAG over
         the spooled exchange (the Trino fault-tolerant-execution
         analog). Differences from :meth:`_execute_general`:
@@ -649,13 +704,16 @@ class ClusterCoordinator:
         from presto_tpu.plan.serde import fragment_to_dict
 
         session = self.engine.session
-        qid = uuid.uuid4().hex[:8]
+        qid = query_id or uuid.uuid4().hex[:8]
         W = len(workers)
         task_backoff = FTR.backoff_from_session(
             session, int(session.get("task_retry_attempts")))
         spool_on = bool(session.get("exchange_spooling"))
         task_timeout = self._task_timeout()
         ctx = OT.current_context()
+        # dispatch pool threads inherit neither contextvars nor the
+        # thread-local cancel token; capture it for their checkpoints
+        tok = CANCEL.current()
 
         readers_of = g.consumer_readers(W)
         stage_by_name = {st.name: st for st in g.stages}
@@ -752,6 +810,10 @@ class ClusterCoordinator:
 
         def dispatch(st, shard: int, last: bool):
             while True:
+                # reaped/canceled queries stop re-dispatching; the
+                # QueryCanceled propagates (it is not a node failure)
+                if tok is not None:
+                    tok.check()
                 with state_lock:
                     n = attempts.get((st.name, shard), 0)
                     attempts[(st.name, shard)] = n + 1
@@ -800,6 +862,7 @@ class ClusterCoordinator:
         try:
             inline: list | None = None
             for st in g.stages:
+                CANCEL.checkpoint()
                 frag_of[st.name] = fragment_to_dict(st.fragment)
                 nparts_of[st.name] = (W if st.partition_keys is not None
                                       else 1)
@@ -827,7 +890,8 @@ class ClusterCoordinator:
                     pass
 
     def _execute_fragmented(self, plan, fragged,
-                            workers: list[RemoteWorker]):
+                            workers: list[RemoteWorker],
+                            query_id: str | None = None):
         """Run a fragmented join plan: scan stages partition legs into
         worker buffers, join stages pull co-partitions and join, the
         coordinator finishes (FINAL agg + sort/limit). See
@@ -838,7 +902,9 @@ class ClusterCoordinator:
         from presto_tpu.plan import nodes as N
         from presto_tpu.plan.serde import fragment_to_dict
 
-        qid = uuid.uuid4().hex[:8]
+        # attempt-unique, query-id-prefixed (see _execute_general)
+        qid = (f"{query_id}.{uuid.uuid4().hex[:6]}" if query_id
+               else uuid.uuid4().hex[:8])
         W = len(workers)
 
         def exchange_scan(name: str, types: dict) -> N.TableScan:
@@ -866,6 +932,7 @@ class ClusterCoordinator:
             # -- join stages -------------------------------------------
             inline_results: list[bytes] | None = None
             for js in fragged.join_stages:
+                CANCEL.checkpoint()
                 probe_scan = exchange_scan("probe",
                                            stage_types[js.probe_name])
                 build_scan = exchange_scan("build",
@@ -927,8 +994,11 @@ class ClusterCoordinator:
         ctx = OT.current_context()  # pool threads don't inherit it
         timeout = self._task_timeout()
         failover = self._retry_policy() != "NONE"
+        tok = CANCEL.current()  # nor the cancel token
 
         def run_one(i: int) -> dict:
+            if tok is not None:
+                tok.check()
             order = [workers[i % len(workers)]] + [
                 w for j, w in enumerate(workers)
                 if j != i % len(workers)]

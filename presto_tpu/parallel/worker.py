@@ -52,6 +52,14 @@ _EXCHANGE_BYTES = REGISTRY.counter(
 _FETCH_BYTES = REGISTRY.counter(
     "presto_tpu_exchange_fetch_bytes_total",
     "exchange bytes pulled from peer workers")
+_TASKS_SHED = REGISTRY.counter(
+    "presto_tpu_query_shed_total",
+    "work rejected for overload protection (worker task-queue caps, "
+    "coordinator queue-full), by site")
+_TASK_DEPTH = REGISTRY.gauge(
+    "presto_tpu_worker_task_queue_depth",
+    "tasks currently running or admitted on the worker (the bounded "
+    "intake that 503s when full)")
 
 
 def execute_partial_task(engine_factory, sql: str, shard: int,
@@ -365,10 +373,21 @@ class WorkerServer(HttpService):
                  port: int = 0, node_id: str = "worker",
                  shared_secret: str | None = None,
                  tls: tuple[str, str] | None = None,
-                 spool_dir: str | None = None):
+                 spool_dir: str | None = None,
+                 max_tasks: int | None = None):
         from presto_tpu.parallel import auth as _auth
         self.catalogs = catalogs
         self.node_id = node_id
+        # overload backpressure: at most this many tasks running or
+        # admitted at once; excess POSTs are shed with 503 +
+        # Retry-After, which ft.retrying_call classifies transient so
+        # the task/query retry layers rotate to another worker instead
+        # of hammering this one (reference task.max-worker-threads +
+        # the SqlTaskManager queue bound)
+        self._max_tasks = (max_tasks if max_tasks is not None
+                           else int(os.environ.get(
+                               "PRESTO_TPU_WORKER_MAX_TASKS", "16")))
+        self._active_tasks = 0
         self.shared_secret = (shared_secret
                               if shared_secret is not None
                               else _auth.default_secret())
@@ -401,6 +420,13 @@ class WorkerServer(HttpService):
                 e = self._engines.get((shard, nshards))
                 if e is None:
                     e = Engine()
+                    # worker-side memory governance: cap the runtime
+                    # pool so N concurrent fragment tasks cannot OOM
+                    # the device (0 = unbounded, the default)
+                    cap = int(os.environ.get(
+                        "PRESTO_TPU_WORKER_MEMORY_BYTES", "0") or 0)
+                    if cap:
+                        e.memory_pool.capacity = cap
                     for name, conn in catalogs.items():
                         e.register_catalog(
                             name, SplitConnector(conn, shard, nshards))
@@ -624,15 +650,35 @@ class WorkerServer(HttpService):
                     # retrying coordinator re-dispatches elsewhere
                     self._send_json(
                         {"error": f"worker {outer.node_id} is "
-                                  "shutting down"}, 503)
+                                  "shutting down"}, 503,
+                        extra_headers={"Retry-After": "1"})
                     return
-                # propagated trace context: worker spans parent under
-                # the coordinator's task-dispatch span
-                ctx = OT.parse_context(
-                    self.headers.get(OT.TRACE_HEADER))
-                kind = "fragment" if "fragment" in req else "partial"
-                _TASKS.inc(node=outer.node_id, kind=kind)
+                if not outer.begin_task():
+                    # task-queue cap: shed with 503 + Retry-After —
+                    # transient by ft.retrying_call's contract, so the
+                    # coordinator's retry layers rotate workers
+                    # instead of hammering this one
+                    _TASKS_SHED.inc(site="worker-task-queue",
+                                    node=outer.node_id)
+                    self._send_json(
+                        {"error": f"worker {outer.node_id} task "
+                                  f"queue is full "
+                                  f"({outer._max_tasks} tasks)"}, 503,
+                        extra_headers={"Retry-After": "1"})
+                    return
+                # the handler releases the task slot unless an async
+                # worker thread took ownership of it; the try opens
+                # IMMEDIATELY after the claim — any exception before
+                # ownership transfer must reach the releasing finally
+                release_slot = True
                 try:
+                    # propagated trace context: worker spans parent
+                    # under the coordinator's task-dispatch span
+                    ctx = OT.parse_context(
+                        self.headers.get(OT.TRACE_HEADER))
+                    kind = ("fragment" if "fragment" in req
+                            else "partial")
+                    _TASKS.inc(node=outer.node_id, kind=kind)
                     if "fragment" in req:
                         engine = engine_factory(
                             int(req.get("shard", 0)),
@@ -707,9 +753,29 @@ class WorkerServer(HttpService):
                                     outer.task_state[tid] = {
                                         "state": "failed",
                                         "error": repr(exc)[:500]}
+                                finally:
+                                    # the async thread owns the task
+                                    # slot claimed at intake
+                                    outer.end_task()
 
-                            threading.Thread(target=run_async,
-                                             daemon=True).start()
+                            # slot ownership passes to the task thread
+                            # BEFORE it starts (a fast task must not
+                            # race the handler's finally into a double
+                            # release)
+                            release_slot = False
+                            thread = threading.Thread(target=run_async,
+                                                      daemon=True)
+                            try:
+                                thread.start()
+                            except Exception as exc:
+                                # the thread never ran: run_async will
+                                # not release the slot — take it back
+                                # or overload shrinks intake forever
+                                release_slot = True
+                                outer.task_state[tid] = {
+                                    "state": "failed",
+                                    "error": repr(exc)[:200]}
+                                raise
                             self._send_json({"taskId": tid,
                                              "state": "running"})
                             return
@@ -744,6 +810,9 @@ class WorkerServer(HttpService):
                             error=f"{type(e).__name__}: {e}")
                     self._send_json(
                         {"error": f"{type(e).__name__}: {e}"}, 500)
+                finally:
+                    if release_slot:
+                        outer.end_task()
 
         super().__init__(Handler, host, port, tls=tls)
 
@@ -761,6 +830,26 @@ class WorkerServer(HttpService):
 
     def accepting_tasks(self) -> bool:
         return self.state == "active"
+
+    # -- overload backpressure (bounded task intake) ----------------------
+
+    def begin_task(self) -> bool:
+        """Claim a task slot; False = at the cap (caller sheds with
+        503 + Retry-After). Async tasks hold their slot until their
+        worker thread finishes, so the depth gauge counts real load."""
+        with self._lock:
+            if self._active_tasks >= self._max_tasks:
+                return False
+            self._active_tasks += 1
+            depth = self._active_tasks
+        _TASK_DEPTH.set(depth, node=self.node_id)
+        return True
+
+    def end_task(self) -> None:
+        with self._lock:
+            self._active_tasks -= 1
+            depth = self._active_tasks
+        _TASK_DEPTH.set(depth, node=self.node_id)
 
     def spool_page(self, task_id: str, partition: int, token: int):
         """(blob, next, complete) from the spool, or None when the

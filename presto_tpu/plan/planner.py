@@ -332,12 +332,31 @@ def arith_result_type(op: str, a: T.DataType, b: T.DataType) -> T.DataType:
                 max(sa, sb))
         if op == "%":
             # DecimalOperators.java:503
+            if max(pa - sa, pb - sb) + max(sa, sb) > 38:
+                # remainder aligns both operands to max(sa, sb) in
+                # int128 at runtime; an operand needing > 38 digits
+                # after alignment wraps silently (the reference uses
+                # wider intermediates here) — wrong answers are worse
+                # than loud failures
+                raise SemanticError(
+                    f"DECIMAL remainder requires aligning {a} and {b} "
+                    f"to {max(pa - sa, pb - sb) + max(sa, sb)} digits, "
+                    f"exceeding the maximum decimal precision 38 "
+                    f"(cast an operand to DOUBLE for approximate "
+                    f"arithmetic)")
             return T.DecimalType(
                 max(1, min(38, min(pa - sa, pb - sb) + max(sa, sb))),
                 max(sa, sb))
         if op == "*":
             if sa + sb > 38:
-                return T.DOUBLE
+                # reference DecimalOperators rejects out-of-range
+                # derivations; silently degrading to DOUBLE loses
+                # exactness the caller asked DECIMAL for
+                raise SemanticError(
+                    f"DECIMAL scale {sa + sb} must be in range "
+                    f"[0, 38]: {a} * {b} exceeds the maximum decimal "
+                    f"precision (cast an operand to DOUBLE for "
+                    f"approximate arithmetic)")
             return T.DecimalType(min(38, pa + pb), sa + sb)
         if op == "/":
             return T.DecimalType(
@@ -781,7 +800,14 @@ class ExprPlanner:
                 digits = 0
                 if len(args) > 1 and isinstance(args[1], ir.Literal):
                     digits = int(args[1].value)
-                out = T.DecimalType(18, min(a.dtype.scale, max(digits, 0)))
+                # LONG decimals keep their precision class (reference
+                # round(decimal(p,s), d) -> decimal(min(38, p+1), s');
+                # short inputs keep the historical 18 so results stay
+                # single-limb)
+                prec = (min(38, a.dtype.precision + 1)
+                        if a.dtype.is_long else 18)
+                out = T.DecimalType(prec,
+                                    min(a.dtype.scale, max(digits, 0)))
                 return ir.Call(out, "round", args)
             return ir.Call(a.dtype, "round", args)
         if name in ("sqrt", "cbrt", "floor", "ceil", "ceiling", "power",

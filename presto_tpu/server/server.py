@@ -19,6 +19,7 @@ FINISHED|FAILED|CANCELED mirrors execution/QueryState.
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import threading
 import time
@@ -50,6 +51,10 @@ _DURATION = REGISTRY.histogram(
     "query wall time, start of execution to completion")
 _QUERIES_BY_STATE = REGISTRY.gauge(
     "presto_tpu_queries", "tracked queries by current state")
+_SHED = REGISTRY.counter(
+    "presto_tpu_query_shed_total",
+    "work rejected for overload protection (worker task-queue caps, "
+    "coordinator queue-full), by site")
 
 
 @dataclasses.dataclass
@@ -59,6 +64,9 @@ class QueryInfo:
     user: str
     state: str = "QUEUED"  # QUEUED|RUNNING|FINISHED|FAILED|CANCELED
     error: str | None = None
+    # protocol error code (reference StandardErrorCode names):
+    # QUERY_QUEUE_FULL, EXCEEDED_TIME_LIMIT, CLUSTER_OUT_OF_MEMORY, ...
+    error_name: str | None = None
     columns: list[dict] | None = None
     rows: list[list] | None = None
     created: float = dataclasses.field(default_factory=time.monotonic)
@@ -120,6 +128,21 @@ def _json_value(v, dtype: T.DataType):
     return v
 
 
+def _classify_error(e: BaseException) -> str | None:
+    """Protocol error code for a failed query (reference
+    StandardErrorCode) — clients triage overload/kill/timeout failures
+    without parsing messages."""
+    from presto_tpu.exec.cancel import TimeLimitExceeded
+    from presto_tpu.memory import MemoryKilledError, MemoryLimitExceeded
+    if isinstance(e, MemoryKilledError):
+        return "CLUSTER_OUT_OF_MEMORY"
+    if isinstance(e, MemoryLimitExceeded):
+        return "EXCEEDED_MEMORY_LIMIT"
+    if isinstance(e, TimeLimitExceeded):
+        return "EXCEEDED_TIME_LIMIT"
+    return None
+
+
 class QueryManager:
     """Dispatch + tracking (DispatchManager + QueryTracker analog).
     Admission goes through resource groups: a query over its group's
@@ -127,7 +150,12 @@ class QueryManager:
     (dispatcher/DispatchManager.java:189 selectGroup + submit)."""
 
     def __init__(self, engine, max_concurrency: int = 8,
-                 resource_groups=None, cluster=None):
+                 resource_groups=None, cluster=None,
+                 query_memory_bytes: int | None = None):
+        import os
+
+        from presto_tpu.memory import MemoryPool
+        from presto_tpu.server.governance import QueryReaper
         from presto_tpu.server.resource_groups import ResourceGroupManager
 
         self.engine = engine
@@ -137,6 +165,27 @@ class QueryManager:
         self.cluster = cluster
         self.queries: dict[str, QueryInfo] = {}
         self.resource_groups = ResourceGroupManager(resource_groups)
+        # cluster memory governance (reference ClusterMemoryManager +
+        # per-query QueryContext limits): each SELECT reserves its
+        # plan-time estimate (memory.estimate_plan_memory) in this
+        # query-level pool at admission and holds it until completion.
+        # Over-capacity queries BLOCK up to the session's
+        # memory_reserve_timeout_s; sustained exhaustion triggers the
+        # low-memory killer (the blocked query's
+        # low_memory_killer_delay_s), which kills the largest
+        # reservation with a loud MemoryKilledError. Capacity 0 (the
+        # default) disables admission charging entirely.
+        self.query_pool = MemoryPool(
+            query_memory_bytes if query_memory_bytes is not None
+            else int(os.environ.get(
+                "PRESTO_TPU_QUERY_MEMORY_POOL_BYTES", "0") or 0),
+            name="query")
+        # the engine's operator-level runtime pool is env-sizable too
+        # (workers read PRESTO_TPU_WORKER_MEMORY_BYTES the same way)
+        engine_cap = int(os.environ.get(
+            "PRESTO_TPU_MEMORY_POOL_BYTES", "0") or 0)
+        if engine_cap and not engine.memory_pool.capacity:
+            engine.memory_pool.capacity = engine_cap
         # the pool must cover every group's concurrency allowance or
         # group-admitted queries would serialize behind each other in
         # the pool FIFO, defeating per-group isolation; reject configs
@@ -151,6 +200,11 @@ class QueryManager:
             max_workers=max(max_concurrency, allowance))
         self.lock = threading.Lock()
         self._tickets: dict[str, tuple] = {}  # qid -> (group, start_fn)
+        # lifetime enforcement: the reaper fails queries past
+        # query_max_{queued,run}_time and cancels their worker tasks.
+        # Started LAST: its sweep reads self.lock/queries, and a
+        # constructor that raises above must not leak a live thread
+        self.reaper = QueryReaper(self).start()
 
     def submit(self, sql: str, user: str,
                session_properties: dict | None = None) -> QueryInfo:
@@ -172,24 +226,30 @@ class QueryManager:
             with self.lock:
                 self._tickets[qid] = (group, start)
             group.submit(start)
-            # cancel() may have run any time after queries[qid] became
-            # visible (listings snapshot it immediately): a cancel that
-            # lands before the group admission above scanned an empty
-            # queue, so the dead entry would sit in a max_queued slot —
-            # forever under a saturated group. Retract on CANCELED
-            # state alone and drop the ticket we may have re-published
-            # over the cancel's pop.
+            # cancel() or the reaper may have run any time after
+            # queries[qid] became visible (listings snapshot it
+            # immediately): a cancel/reap that lands before the group
+            # admission above scanned an empty queue, so the dead
+            # entry would sit in a max_queued slot — forever under a
+            # saturated group. Retract on any terminal state and drop
+            # the ticket we may have re-published over the pop.
             with self.lock:
-                retract = q.state == "CANCELED"
+                retract = q.state in ("CANCELED", "FAILED")
                 if retract:
                     self._tickets.pop(qid, None)
             if retract:
                 group.cancel_queued(start)
         except (QueryQueueFullError, NoMatchingGroupError) as e:
+            if isinstance(e, QueryQueueFullError):
+                _SHED.inc(site="coordinator-queue-full")
             with self.lock:
                 # a concurrent cancel() may have won: CANCELED sticks
                 if q.state != "CANCELED":
                     q.error = str(e)
+                    q.error_name = (
+                        "QUERY_QUEUE_FULL"
+                        if isinstance(e, QueryQueueFullError)
+                        else "QUERY_REJECTED")
                     q.state = "FAILED"
                     _TRANSITIONS.inc(state="failed")
                 q.finished = time.monotonic()
@@ -197,10 +257,13 @@ class QueryManager:
         return q
 
     def _run(self, q: QueryInfo, group) -> None:
-        from presto_tpu.exec.cancel import CancelToken, QueryCanceled
+        from presto_tpu.exec.cancel import (CancelToken, QueryCanceled,
+                                            TimeLimitExceeded)
         try:
             with self.lock:
-                if q.state == "CANCELED":
+                if q.state != "QUEUED":
+                    # canceled or reaped while group-queued: the
+                    # terminal state (and its transition count) sticks
                     return
                 q.state = "RUNNING"
                 q.started = time.monotonic()
@@ -214,27 +277,46 @@ class QueryManager:
                               node="coordinator") as root:
                 TRACER.add_span("admission", q.created_wall,
                                 time.time())
+                # terminal transitions only fire from RUNNING: the
+                # reaper/canceller owns any state it already set (the
+                # orphaned run thread must not overwrite FAILED)
                 try:
                     self._execute(q)
                     with self.lock:
-                        if q.state != "CANCELED":
+                        if q.state == "RUNNING":
                             q.state = "FINISHED"
                             _TRANSITIONS.inc(state="finished")
                             _RESULT_ROWS.inc(len(q.rows or []))
                             _DURATION.observe(
                                 time.monotonic() - q.started)
+                except TimeLimitExceeded as e:
+                    # an exceeded lifetime limit detected INSIDE the
+                    # engine (planning seam, checkpoint deadline) is a
+                    # loud FAILURE, not a user cancellation — same
+                    # terminal shape the reaper produces
+                    root.attrs["error"] = str(e)
+                    with self.lock:
+                        if q.state == "RUNNING":
+                            q.error = str(e)
+                            q.error_name = "EXCEEDED_TIME_LIMIT"
+                            q.state = "FAILED"
+                            _TRANSITIONS.inc(state="failed")
+                            from presto_tpu.server.governance import (
+                                REAPED)
+                            REAPED.inc(kind="checkpoint")
                 except QueryCanceled:
                     with self.lock:
                         # cancel() usually set the state (and counted
                         # the transition) already; don't double-count
-                        if q.state != "CANCELED":
+                        if q.state == "RUNNING":
                             q.state = "CANCELED"
                             _TRANSITIONS.inc(state="canceled")
                 except Exception as e:  # noqa: BLE001 - to client
                     root.attrs["error"] = f"{type(e).__name__}: {e}"
                     with self.lock:
-                        if q.state != "CANCELED":
+                        if q.state == "RUNNING":
                             q.error = f"{type(e).__name__}: {e}"
+                            q.error_name = _classify_error(e)
                             q.state = "FAILED"
                             _TRANSITIONS.inc(state="failed")
                 finally:
@@ -289,17 +371,23 @@ class QueryManager:
             q.rows = [[_json_value(v, T.VARCHAR) for v in row]
                       for row in rows]
             return
-        if self.cluster is not None:
-            # multi-host path: fragments ship to the cluster's HTTP
-            # workers; the root span's context rides the task POSTs.
-            # (Host-checkpoint cancellation applies between stages
-            # only; in-flight remote tasks run to completion.)
-            with self.engine.session.as_user(q.user, overrides):
-                table = self.cluster.execute_table(q.sql)
-        else:
-            with self.engine.session.as_user(q.user, overrides):
-                table = self.engine.execute_table(
-                    q.sql, cancel_token=q.cancel_token)
+        with self._admission(q, overrides):
+            if self.cluster is not None:
+                # multi-host path: fragments ship to the cluster's
+                # HTTP workers under the protocol query id, so the
+                # reaper can cancel this query's tasks by prefix; the
+                # root span's context rides the task POSTs.
+                # (Host-checkpoint cancellation applies between
+                # stages and retries; in-flight remote tasks run to
+                # completion.)
+                with self.engine.session.as_user(q.user, overrides):
+                    table = self.cluster.execute_table(
+                        q.sql, query_id=q.query_id,
+                        cancel_token=q.cancel_token)
+            else:
+                with self.engine.session.as_user(q.user, overrides):
+                    table = self.engine.execute_table(
+                        q.sql, cancel_token=q.cancel_token)
         q.warnings = [w.to_dict() for w in
                       getattr(self.engine, "last_warnings", [])]
         q.columns = [{"name": n, "type": str(c.dtype)}
@@ -308,6 +396,105 @@ class QueryManager:
         q.rows = [
             [_json_value(v, t) for v, t in zip(row, dtypes)]
             for row in table.to_pylist()]
+
+    @contextlib.contextmanager
+    def _admission(self, q: QueryInfo, overrides: dict):
+        """Cluster memory governance (reference ClusterMemoryManager):
+        with a query-pool capacity configured, reserve the query's
+        plan-time device-memory estimate for its whole lifetime. An
+        over-capacity query BLOCKS (with a deadline) for running ones
+        to release; sustained exhaustion invokes the low-memory killer
+        against the largest reservation. With capacity 0 (default)
+        admission charges nothing."""
+        if not self.query_pool.capacity:
+            yield
+            return
+        from presto_tpu.memory import estimate_plan_memory
+        # the query's cancel token is installed for the admission
+        # planning pass too: this IS the query's only planning (the
+        # preplanned handoff below), so a reaper kill or client DELETE
+        # must abort it at the planning-seam checkpoints, not after
+        with self.engine.session.as_user(q.user, overrides), \
+                self.engine._cancel_scope(q.cancel_token):
+            # plan with the flavor the execution path will use so the
+            # one-shot preplanned handoff below replaces (not doubles)
+            # its planning pass; the handoff stays thread-local and is
+            # consumed under the SAME session scope on this thread
+            if self.cluster is not None:
+                plan, _ = self.engine.plan_sql(q.sql,
+                                               enable_latemat=False)
+            else:
+                plan, _ = self.engine.plan_sql(q.sql)
+            est, _per_node = estimate_plan_memory(plan, self.engine)
+        charge = max(int(est), 1)
+        with TRACER.span("memory-admission", bytes=charge,
+                         pool="query"):
+            self.query_pool.reserve(
+                q.query_id, charge,
+                block_s=self.limit_of(q, "memory_reserve_timeout_s"),
+                kill_after_s=self.limit_of(
+                    q, "low_memory_killer_delay_s"),
+                owner=q.cancel_token)
+        self.engine.offer_preplanned(q.sql, plan)
+        try:
+            yield
+        finally:
+            self.engine.clear_preplanned()
+            self.query_pool.free(q.query_id)
+
+    def limit_of(self, q: QueryInfo, name: str) -> float:
+        """A query's effective lifetime/memory limit: its own header
+        override first, then the shared engine session (the reaper and
+        admission read limits for queries submitted by OTHER threads,
+        where the thread-local override is not installed)."""
+        value = q.session_properties.get(name)
+        if value is None:
+            value = self.engine.session.get(name)
+        try:
+            return float(value or 0.0)
+        except (TypeError, ValueError):
+            return 0.0
+
+    def reap(self, q: QueryInfo, message: str, kind: str) -> None:
+        """Fail a query that exceeded a lifetime limit: terminal state
+        NOW (the client stops waiting), the cancel token killed so the
+        engine aborts at its next host-side seam, and the query's
+        worker fragment tasks DELETEd by query-id prefix."""
+        from presto_tpu.exec.cancel import TimeLimitExceeded
+        from presto_tpu.server.governance import REAPED
+        ticket = None
+        with self.lock:
+            if q.state not in ("QUEUED", "RUNNING"):
+                return
+            was_queued = q.state == "QUEUED"
+            q.state = "FAILED"
+            q.error = message
+            q.error_name = "EXCEEDED_TIME_LIMIT"
+            q.finished = time.monotonic()
+            _TRANSITIONS.inc(state="failed")
+            if was_queued:
+                ticket = self._tickets.pop(q.query_id, None)
+            token = q.cancel_token
+        REAPED.inc(kind=kind)
+        LOG.log("query_reaped", query_id=q.query_id, kind=kind,
+                error=message)
+        if token is not None:
+            token.kill(TimeLimitExceeded(message))
+        if ticket is not None:
+            group, start = ticket
+            group.cancel_queued(start)
+        if self.cluster is not None and not was_queued:
+            # stop the burn: workers drop this query's task buffers,
+            # fail producers blocked on them, and clear its spool (a
+            # QUEUED query never dispatched tasks — skip the fan-out,
+            # the reaper thread must not stall on dead workers for it)
+            self.cluster.cancel_query(q.query_id)
+
+    def close(self) -> None:
+        """Stop governance threads and the dispatch pool (server
+        shutdown; queries already running finish on their own)."""
+        self.reaper.stop()
+        self.pool.shutdown(wait=False)
 
     def get(self, qid: str) -> QueryInfo | None:
         # submit() inserts under the lock from dispatcher threads
@@ -411,6 +598,17 @@ class _Handler(JsonHandler):
             "presto_tpu_memory_capacity_bytes",
             "runtime memory pool capacity (0 = unbounded)").set(
             info["capacityBytes"], node="coordinator")
+        qinfo = self.manager.query_pool.info()
+        REGISTRY.gauge(
+            "presto_tpu_query_memory_reserved_bytes",
+            "admission-time query-level memory reservations "
+            "(cluster memory governance)").set(
+            qinfo["reservedBytes"], node="coordinator")
+        REGISTRY.gauge(
+            "presto_tpu_query_memory_capacity_bytes",
+            "query-level admission pool capacity "
+            "(0 = admission disabled)").set(
+            qinfo["capacityBytes"], node="coordinator")
         REGISTRY.gauge(
             "presto_tpu_compiled_programs",
             "entries in the compiled-program cache").set(
@@ -430,7 +628,8 @@ class _Handler(JsonHandler):
         }
         if q.state == "FAILED":
             out["error"] = {"message": q.error,
-                            "errorName": "GENERIC_INTERNAL_ERROR"}
+                            "errorName": (q.error_name
+                                          or "GENERIC_INTERNAL_ERROR")}
             return out
         if q.state == "CANCELED":
             out["error"] = {"message": "Query was canceled",
@@ -472,6 +671,13 @@ class _Handler(JsonHandler):
             length = int(self.headers.get("Content-Length", 0))
             sql = self.rfile.read(length).decode()
             q = self.manager.submit(sql, user, session_properties=props)
+            if q.error_name == "QUERY_QUEUE_FULL":
+                # fast 429-style shed (reference QUERY_QUEUE_FULL +
+                # Too Many Requests): the client backs off and
+                # retries later instead of polling a doomed query
+                self._send_json(self._query_results(q, 0), 429,
+                                extra_headers={"Retry-After": "1"})
+                return
             self._send_json(self._query_results(q, 0))
             return
         self._send_json({"error": "not found"}, 404)
@@ -690,11 +896,18 @@ class CoordinatorServer(HttpService):
 
     def __init__(self, engine, host: str = "127.0.0.1", port: int = 0,
                  resource_groups=None, authenticator=None,
-                 tls: tuple[str, str] | None = None, cluster=None):
+                 tls: tuple[str, str] | None = None, cluster=None,
+                 query_memory_bytes: int | None = None):
+        self.manager = QueryManager(
+            engine, resource_groups=resource_groups, cluster=cluster,
+            query_memory_bytes=query_memory_bytes)
         handler = type("BoundHandler", (_Handler,), {
-            "manager": QueryManager(engine,
-                                    resource_groups=resource_groups,
-                                    cluster=cluster),
+            "manager": self.manager,
             "authenticator": authenticator,
             "uri_scheme": "https" if tls is not None else "http"})
         super().__init__(handler, host, port, tls=tls)
+
+    def stop(self) -> None:
+        # governance threads (reaper) stop with the server
+        self.manager.close()
+        super().stop()

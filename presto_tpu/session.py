@@ -105,8 +105,36 @@ SYSTEM_SESSION_PROPERTIES: dict[str, tuple[Any, type, str]] = {
     "query_max_run_time": (0.0, float,
                            "wall-clock limit in seconds per query "
                            "(0 = unlimited), enforced at host-side "
-                           "checkpoints (reference QueryTracker "
+                           "checkpoints AND by the coordinator's "
+                           "reaper thread, which also cancels the "
+                           "query's in-flight worker tasks "
+                           "(reference QueryTracker "
                            "query.max-run-time)"),
+    "query_max_queued_time": (0.0, float,
+                              "max seconds a query may wait QUEUED "
+                              "for a resource-group slot before the "
+                              "reaper fails it loudly (0 = unlimited; "
+                              "reference query.max-queued-time)"),
+    "query_max_planning_time": (0.0, float,
+                                "max seconds the planner/optimizer "
+                                "may spend on one query before it "
+                                "fails loudly (0 = unlimited; "
+                                "reference query.max-planning-time)"),
+    "memory_reserve_timeout_s": (0.0, float,
+                                 "how long an over-capacity memory "
+                                 "reservation BLOCKS for other "
+                                 "queries to free pool bytes before "
+                                 "failing (0 = fail immediately, the "
+                                 "single-query behavior; reference "
+                                 "memory-blocked operator states)"),
+    "low_memory_killer_delay_s": (5.0, float,
+                                  "sustained pool exhaustion a "
+                                  "blocked reservation tolerates "
+                                  "before the low-memory killer "
+                                  "kills the query holding the "
+                                  "largest reservation (active only "
+                                  "while blocking; reference "
+                                  "low-memory-killer.delay)"),
     "scan_block_rows": (1 << 24, int,
                         "stream scans bigger than this in blocks of this "
                         "many rows through a partial-aggregate kernel "

@@ -385,6 +385,78 @@ def test_timeout_discipline_suppressible(tmp_path):
     assert run_lint([pkg], rules=["timeout-discipline"]) == []
 
 
+# -- pool discipline --------------------------------------------------------
+
+
+POOL_FIXTURE = """
+    def leaky(pool, data):
+        pool.reserve("q", 100)   # no free at all
+        return data
+
+    def freed_but_not_on_error(pool, data):
+        pool.reserve("q", 100)
+        out = transform(data)
+        pool.free("q")           # straight-line: skipped on raise
+        return out
+
+    def balanced(pool, data):
+        pool.reserve("q", 100)
+        try:
+            return transform(data)
+        finally:
+            pool.free("q")
+
+    def balanced_attr(self, data):
+        self.query_pool.reserve("q", 100)
+        try:
+            return transform(data)
+        finally:
+            self.query_pool.free("q")
+
+    def nested_owner(pool, items):
+        # the nested def's reserve is NOT covered by the outer
+        # finally: it runs later, on another thread
+        def job(item):
+            pool.reserve("q", item)
+            return item
+        try:
+            return [job(i) for i in items]
+        finally:
+            pool.free("q")
+
+    def not_a_pool(connection, data):
+        connection.reserve("q", 100)  # receiver is not a memory pool
+        return data
+"""
+
+
+def test_pool_discipline_requires_free_in_finally(tmp_path):
+    """Every MemoryPool.reserve call site must pair with a free on ALL
+    exit paths — i.e. inside a finally of the same function; a
+    straight-line free after the work is exactly the leak this rule
+    exists for."""
+    pkg = write_pkg(tmp_path,
+                    {"presto_tpu/server/broken.py": POOL_FIXTURE})
+    findings = run_lint([pkg], rules=["pool-discipline"])
+    assert len(findings) == 3, [f.format() for f in findings]
+    msgs = " | ".join(f.message for f in findings)
+    assert "leaky" in msgs
+    assert "freed_but_not_on_error" in msgs
+    assert "job" in msgs  # the nested def analyzed as its own scope
+    assert "balanced" not in msgs and "not_a_pool" not in msgs
+
+
+def test_pool_discipline_suppressible_for_caller_owned(tmp_path):
+    """Ownership transfers (caller frees) carry an explicit per-line
+    suppression — the segment-carrier pattern in exec/executor.py."""
+    pkg = write_pkg(tmp_path, {"presto_tpu/exec/broken.py": """
+        def materialize(pool, tag, out):
+            pool.reserve(tag, out.nbytes)  # lint: disable=pool-discipline
+            return out
+    """})
+    assert run_lint([pkg], rules=["pool-discipline"]) == []
+
+
 # -- dispatch exhaustiveness ------------------------------------------------
 
 DISPATCH_NODES = """

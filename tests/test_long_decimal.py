@@ -222,3 +222,119 @@ def test_explain_analyze_segments(eng):
     finally:
         EX.AGG_SPLIT_MIN_ROWS = saved
     assert "Final" in out and "rows:" in out and "Segment 0" in out
+
+
+# -- round / modulus over LONG decimals (ADVICE r5 high/medium) -------------
+
+
+def test_round_long_decimal_values(eng):
+    """round() must go through int128 on [n,2] limb arrays — the int64
+    path returned garbage like 1844674407370955038.1 (ADVICE r5)."""
+    rows = eng.execute(
+        "select round(cast('-123.45' as decimal(25,2)), 1), "
+        "round(cast('12345678901234567890123.456' as decimal(26,3)), "
+        "2), "
+        "round(cast('-99999999999999999999.995' as decimal(23,3)), 2), "
+        "round(cast('123.45' as decimal(25,2)), 3)")
+    assert rows[0][0] == Decimal("-123.5")  # half AWAY from zero
+    assert rows[0][1] == Decimal("12345678901234567890123.46")
+    assert rows[0][2] == Decimal("-100000000000000000000.00")
+    assert rows[0][3] == Decimal("123.45")  # digits >= scale: as-is
+
+
+def test_round_negative_digits(eng):
+    """round(x, -d) rounds to multiples of 10^d: the quotient counts
+    tens/hundreds and must scale back up (12 tens = 120, not 12)."""
+    rows = eng.execute(
+        "select round(cast('123.45' as decimal(25,2)), -1), "
+        "round(cast('12345678901234567890123.456' as decimal(26,3)), "
+        "-2), "
+        "round(cast('-155.00' as decimal(25,2)), -1), "
+        "round(cast('123.45' as decimal(10,2)), -1)")  # short path too
+    assert rows[0][0] == Decimal("120")
+    assert rows[0][1] == Decimal("12345678901234567890100")
+    assert rows[0][2] == Decimal("-160")  # half AWAY from zero
+    assert rows[0][3] == Decimal("120")
+
+
+def test_round_long_decimal_column(eng):
+    k, v, w, valid = eng._rows
+    rows = eng.execute("select round(cast(v as decimal(25,2)), 1) "
+                       "from t")
+    assert len(rows) == len(v)
+    for (got,), vi, ok in zip(rows, v, valid):
+        if not ok:
+            assert got is None
+            continue
+        want = (Decimal(int(vi)) / 100).quantize(
+            Decimal("0.1"), rounding=decimal.ROUND_HALF_UP)
+        assert got == want
+
+
+def test_modulus_long_decimal(eng):
+    """v % 100 over decimal(25,2) died mid-decode (opaque ValueError)
+    before the int128 remainder path (ADVICE r5 medium)."""
+    rows = eng.execute(
+        "select cast('-1234567890123456789012.75' as decimal(25,2)) "
+        "% 100, "
+        "cast('1234567890123456789012.75' as decimal(25,2)) "
+        "% cast('-7.5' as decimal(25,1)), "
+        "cast('5.00' as decimal(25,2)) % cast('0' as decimal(25,2))")
+    # sign of the DIVIDEND (SQL/reference trunc semantics; Python
+    # Decimal's % truncates the same way)
+    assert rows[0][0] == Decimal("-12.75")
+    assert rows[0][1] == (Decimal("1234567890123456789012.75")
+                          % Decimal("-7.5"))
+    assert rows[0][2] is None  # mod by zero -> NULL, not a crash
+
+
+def test_modulus_long_decimal_column(eng):
+    k, v, w, valid = eng._rows
+    rows = eng.execute(
+        "select cast(v as decimal(25,2)) % 100 from t")
+    assert len(rows) == len(v)
+    for (got,), vi, ok in zip(rows, v, valid):
+        if not ok:
+            assert got is None
+            continue
+        a = Decimal(int(vi)) / 100
+        want = a - int(a / 100) * 100  # truncated-division remainder
+        assert got == want, (a, got, want)
+
+
+def test_round_drop_past_limb_capacity_rounds_to_zero(eng):
+    """drop = scale - digits past the limb capacity cannot build a
+    10^drop divisor (int128 wrapped it into garbage like -10 for
+    round(decimal(38,38), -1)); |x| < 10^38 <= 0.5*10^drop there, so
+    every value half-up rounds to exactly zero."""
+    rows = eng.execute(
+        "select round(cast("
+        "'0.12345678901234567890123456789012345678' "
+        "as decimal(38,38)), -1), "
+        "round(cast("
+        "'-0.99999999999999999999999999999999999999' "
+        "as decimal(38,38)), -5), "
+        "round(cast('99.99' as decimal(10,2)), -20)")  # short path
+    assert rows[0][0] == Decimal("0")
+    assert rows[0][1] == Decimal("0")
+    assert rows[0][2] == Decimal("0")
+
+
+def test_decimal_modulus_alignment_overflow_fails_loudly(eng):
+    """decimal(38,0) % decimal(38,20) aligns to 58 digits — int128
+    wrapped that into a silently wrong remainder (0E-20 where the true
+    value is 2E-20); it must be rejected loudly at plan time."""
+    from presto_tpu.plan.planner import SemanticError
+    with pytest.raises(SemanticError, match="38"):
+        eng.execute(
+            "select cast('12345678901234567890' as decimal(38,0)) "
+            "% cast('0.00000000000000000007' as decimal(38,20))")
+
+
+def test_decimal_multiply_scale_overflow_fails_loudly(eng):
+    """scale(a)+scale(b) > 38 raised a SemanticError instead of
+    silently degrading to DOUBLE (ADVICE r5 planner.py:339)."""
+    from presto_tpu.plan.planner import SemanticError
+    with pytest.raises(SemanticError, match="38"):
+        eng.execute("select cast(1 as decimal(38,20)) "
+                    "* cast(1 as decimal(38,20))")
