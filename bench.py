@@ -45,6 +45,13 @@ obs compile histogram. The store persists across bench invocations,
 so repeat runs' "cold" measurements are warm too — which is what
 finally fits Q9 inside the budget.
 
+Q3/Q5 additionally measure a LITERAL VARIANT in the same (cold) child
+process — the same query with a shifted date / different region —
+reporting ``qNN_variant_warm_rows_per_sec`` and
+``qNN_variant_compiles``: with plan templates (presto_tpu/templates/)
+the variant hits the executable compiled for the original literals,
+so variant_compiles must be 0 and the variant wall is pure execute.
+
 ``bench.py --serve`` (also folded into the default run as serve_*
 detail keys, in its own subprocess) drives N concurrent HTTP clients
 through the real protocol against an in-process coordinator and
@@ -122,8 +129,32 @@ out = {
     "cache_hits_memory": int(hits.value(tier="memory"))}
 if times:  # reps=0 = warm-start probe: first_s is the measurement
     out["steady_s"] = min(times)
+variant = sys.argv[4] if len(sys.argv) > 4 else ""
+if variant:
+    # literal-variant warm measurement (plan templates): the same
+    # query shape with a different date/region, run in THIS process —
+    # variant_compiles must be 0 on a template hit (templates/)
+    old, new = variant.split("=>")
+    vplan, _ = engine.plan_sql(QUERIES[name].replace(old, new))
+    c0 = int(compiles.value())
+    t0 = time.perf_counter()
+    np.asarray(run_plan_live(engine, vplan))
+    out["variant_s"] = round(time.perf_counter() - t0, 3)
+    out["variant_compiles"] = int(compiles.value()) - c0
+    out["template_hits"] = int(REGISTRY.counter(
+        "presto_tpu_template_cache_hits_total").value())
+    out["template_misses"] = int(REGISTRY.counter(
+        "presto_tpu_template_cache_misses_total").value())
 print(json.dumps(out))
 """
+
+# literal-variant specs per query ("old=>new" textual swap): the
+# serving scenario the plan-template subsystem exists for — same query
+# shape, different constants
+VARIANTS = {
+    "q03": "date '1995-03-15'=>date '1995-03-22'",
+    "q05": "'ASIA'=>'EUROPE'",
+}
 
 
 def measure_query(name: str, sf: float, reps: int,
@@ -135,10 +166,14 @@ def measure_query(name: str, sf: float, reps: int,
     the AOT executables from the persistent store instead of
     compiling."""
     t0 = time.perf_counter()
+    argv = [sys.executable, "-c", _CHILD, name, str(sf), str(reps)]
+    if name in VARIANTS and reps > 0:
+        # variant rides the COLD child only: the warm-start probe
+        # (reps=0) measures the persistent cache, not templates
+        argv.append(VARIANTS[name])
     try:
         proc = subprocess.run(
-            [sys.executable, "-c", _CHILD, name, str(sf), str(reps)],
-            capture_output=True, text=True, timeout=timeout_s,
+            argv, capture_output=True, text=True, timeout=timeout_s,
             cwd=os.path.dirname(os.path.abspath(__file__)))
     except subprocess.TimeoutExpired:
         return {"error": "timed out"}
@@ -254,6 +289,10 @@ def run_serve_bench() -> dict:
         wall = time.perf_counter() - t_start
         all_lat = sorted(x for per in latencies for x in per)
         completed = len(all_lat)
+        # template hit/miss counters (templates/): the coordinator
+        # runs in-process, so the registry's totals cover exactly the
+        # queries this bench drove
+        from presto_tpu.obs.metrics import REGISTRY
         return {
             "serve_clients": nclients,
             "serve_seconds": round(wall, 1),
@@ -263,6 +302,10 @@ def run_serve_bench() -> dict:
             "serve_p50_ms": _quantile_ms(all_lat, 0.50),
             "serve_p99_ms": _quantile_ms(all_lat, 0.99),
             "serve_errors": sum(errors),
+            "serve_template_hits": int(REGISTRY.counter(
+                "presto_tpu_template_cache_hits_total").value()),
+            "serve_template_misses": int(REGISTRY.counter(
+                "presto_tpu_template_cache_misses_total").value()),
         }
     finally:
         srv.stop()
@@ -563,6 +606,17 @@ def main() -> None:
             "compile_s", round(r["first_s"] - r["steady_s"], 1))
         detail[f"{name}_execute_s"] = round(r["steady_s"], 2)
         detail[f"{name}_programs_compiled"] = r.get("programs_compiled")
+        if "variant_s" in r:
+            # literal-variant warm rerun inside the cold child: with
+            # plan templates on, variant_compiles MUST be 0 — the
+            # variant hit the executable compiled for the original
+            # literals (the ROADMAP item 2 serving scenario)
+            detail[f"{name}_variant_warm_rows_per_sec"] = round(
+                nrows / max(r["variant_s"], 1e-3))
+            detail[f"{name}_variant_compiles"] = r["variant_compiles"]
+            detail[f"{name}_template_hits"] = r.get("template_hits")
+            detail[f"{name}_template_misses"] = r.get(
+                "template_misses")
         base = detail.get(f"{name}_numpy_s")
         if base:
             detail[f"{name}_vs_baseline"] = round(

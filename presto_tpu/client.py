@@ -37,6 +37,10 @@ class Client:
         # replayed on every request via X-Trino-Session (the reference
         # client's session accumulation, StatementClientV1)
         self.session_properties: dict[str, object] = {}
+        # prepared statements accumulated from PREPARE/DEALLOCATE,
+        # replayed via X-Trino-Prepared-Statement (the reference's
+        # addedPreparedStatements round-trip)
+        self.prepared_statements: dict[str, str] = {}
 
     def _request(self, method: str, url: str, body: bytes | None = None):
         req = urllib.request.Request(url, data=body, method=method)
@@ -49,6 +53,11 @@ class Client:
             req.add_header("X-Trino-Session", ",".join(
                 f"{k}={quote(str(v))}"
                 for k, v in self.session_properties.items()))
+        if self.prepared_statements:
+            from urllib.parse import quote
+            req.add_header("X-Trino-Prepared-Statement", ",".join(
+                f"{quote(k)}={quote(v)}"
+                for k, v in self.prepared_statements.items()))
         if self.password is not None:
             import base64
             cred = base64.b64encode(
@@ -87,6 +96,11 @@ class Client:
                 columns = out["columns"]
             if out.get("setSession"):
                 self.session_properties.update(out["setSession"])
+            if out.get("addedPreparedStatements"):
+                self.prepared_statements.update(
+                    out["addedPreparedStatements"])
+            for name in out.get("deallocatedPreparedStatements") or ():
+                self.prepared_statements.pop(name, None)
             if out.get("warnings"):
                 self.warnings = out["warnings"]
             rows.extend(out.get("data", []))

@@ -38,6 +38,10 @@ class Engine:
             max_entries=int(self.session.get("program_cache_entries")
                             or 64))
         self._caps_memory: dict = {}
+        # plan templates: per-(template, segment) carrier-width memory
+        # (grow-only; exec/executor._segment_carriers) so literal
+        # variants keep stable downstream segment shapes
+        self._carrier_caps: dict = {}
         # host->device transfer cache: id(np array) -> (host ref, dev
         # array). The strong host ref pins the id; repeat executions of
         # a query (and bench steady state) reuse HBM-resident inputs
@@ -145,6 +149,13 @@ class Engine:
         W.push(WC := W.WarningCollector())
         try:
             stmt = rewrite_statement(parse_statement(sql), self)
+            if isinstance(stmt, A.ExecutePrepared):
+                # EXECUTE name USING ...: splice the literals into the
+                # stored text and run the result through the normal
+                # pipeline — the plan-template machinery keys every
+                # variant onto one compiled program (templates/)
+                sql = self._resolve_prepared(stmt)
+                stmt = rewrite_statement(parse_statement(sql), self)
             with self._cancel_scope(cancel_token):
                 if isinstance(stmt, A.QueryStatement):
                     return monitored(
@@ -171,6 +182,9 @@ class Engine:
         W.push(WC := W.WarningCollector())
         try:
             stmt = rewrite_statement(parse_statement(sql), self)
+            if isinstance(stmt, A.ExecutePrepared):
+                sql = self._resolve_prepared(stmt)
+                stmt = rewrite_statement(parse_statement(sql), self)
             if not isinstance(stmt, A.QueryStatement):
                 raise ValueError("execute_table expects a SELECT query")
             preplanned = self.take_preplanned(sql)
@@ -245,6 +259,12 @@ class Engine:
 
     def clear_preplanned(self) -> None:
         self._preplanned_tl.value = None
+
+    def _resolve_prepared(self, stmt) -> str:
+        """Executable SQL of an EXECUTE against this session's
+        prepared-statement registry."""
+        from presto_tpu.templates.prepared import resolve_execute
+        return resolve_execute(self.session.prepared_statements, stmt)
 
     def _planning_checkpoint(self, t0: float) -> None:
         """Planning-phase seam: observe cancellation (a reaped or
@@ -326,6 +346,10 @@ class Engine:
         with self._dev_cache_lock:
             self._dev_cache.clear()
             self._dev_cache_bytes = 0
+        # the template pad cache is id-keyed the same way and must not
+        # serve pre-DML padded copies of in-place-mutated arrays
+        from presto_tpu.templates.shapes import invalidate_pad_cache
+        invalidate_pad_cache(self)
 
     def _execute_statement_inner(self, stmt, mesh=None) -> list[tuple]:
         from presto_tpu.plan.printer import format_plan
@@ -375,6 +399,17 @@ class Engine:
         if isinstance(stmt, A.SetSession):
             value = _literal_value(stmt.value)
             self.session.set(stmt.name, value)
+            return []
+
+        if isinstance(stmt, A.Prepare):
+            self.session.prepared_statements[stmt.name] = stmt.sql
+            return []
+
+        if isinstance(stmt, A.Deallocate):
+            if self.session.prepared_statements.pop(stmt.name,
+                                                    None) is None:
+                raise ValueError(
+                    f"prepared statement not found: {stmt.name}")
             return []
 
         if isinstance(stmt, A.CreateTableAs):

@@ -403,7 +403,7 @@ class PlanInterpreter:
 
 def make_traced(scan_inputs: list[ScanInput], plan: N.PlanNode,
                 capacities: dict[int, int], session=None,
-                interp_factory=None):
+                interp_factory=None, params: list | None = None):
     """Build (traced_fn, flat_example_args, meta). ``traced_fn`` is a pure
     jittable function from flat scan arrays to
     (result columns, live mask, ok flags); ``meta`` is populated at trace
@@ -412,7 +412,14 @@ def make_traced(scan_inputs: list[ScanInput], plan: N.PlanNode,
     ``interp_factory`` substitutes a PlanInterpreter subclass; when the
     interpreter records ``row_counts`` (EXPLAIN ANALYZE's
     ProfilingInterpreter) the traced function returns them as a fourth
-    output and ``meta["count_nodes"]`` lists the node ids."""
+    output and ``meta["count_nodes"]`` lists the node ids.
+
+    ``params`` (plan templates): example physical values of the plan's
+    hoisted-literal parameter vector. The traced function then takes
+    them as TRAILING arguments after the scan arrays, the interpreter
+    walk runs under a TraceParams context resolving ir.Parameter
+    leaves, and ``meta["param_bindings"]`` records the dictionaries
+    VARCHAR parameters bound against (templates/runtime.py)."""
     flat_arrays = [
         scan.arrays[sym] for scan in scan_inputs for sym in scan.arrays]
     meta: dict[str, object] = {}
@@ -426,7 +433,14 @@ def make_traced(scan_inputs: list[ScanInput], plan: N.PlanNode,
             scans[id(scan.node)] = (scan, traced)
         interp = (interp_factory or PlanInterpreter)(
             scans, capacities, session, node_order)
-        out = interp.run(plan)
+        if params is not None:
+            from presto_tpu.templates import runtime as TR
+            tp = TR.TraceParams(list(it))
+            with TR.active(tp):
+                out = interp.run(plan)
+            meta["param_bindings"] = dict(tp.bindings)
+        else:
+            out = interp.run(plan)
         meta["out"] = [
             (sym, v.dtype, v.dictionary, v.valid is not None)
             for sym, v in out.cols.items()]
@@ -538,11 +552,26 @@ def prepare_plan(engine, plan: N.PlanNode, scan_inputs: list[ScanInput]):
     fronts a persistent AOT disk store (PRESTO_TPU_PROGRAM_CACHE_DIR),
     so a warm process — or another worker sharing the directory —
     deserializes the executable instead of paying lower+compile, and
-    the persisted capacity sidecar skips the overflow-retry ladder."""
+    the persisted capacity sidecar skips the overflow-retry ladder.
+
+    Plan templates (templates/): with session ``plan_templates`` on,
+    hoistable literals leave the plan before the key is computed — the
+    cache keys on the parameterized TEMPLATE (plus pow2-bucketed scan
+    shapes under ``template_shape_bucketing``), and this query's
+    literal values enter the compiled program as trailing device
+    scalars. A literal variant of an already-compiled query shape is a
+    cache hit: zero compiles."""
+    from presto_tpu import templates as TPL
     from presto_tpu.exec import progcache as PC
     fpr = PC.platform_fingerprint()
     cache = engine._program_cache
     cache.configure(engine.session)
+    tpl = None
+    if TPL.enabled(engine.session):
+        scan_inputs = TPL.bucket_scans(engine, scan_inputs)
+        tpl = TPL.parameterize(plan)
+        if tpl is not None:
+            plan = tpl.plan
     base_key, _ = _cache_key(engine, plan, scan_inputs, {})
     known_caps = engine._caps_memory.get(base_key)
     if known_caps is None:  # {} is a real answer: no overrides needed
@@ -554,13 +583,18 @@ def prepare_plan(engine, plan: N.PlanNode, scan_inputs: list[ScanInput]):
         checkpoint()
         caps_key = PC.bucket_capacities(capacities)
         entry = cache.lookup((base_key, caps_key), fpr)
+        if tpl is not None and _attempt == 0:
+            TPL.note_lookup(hit=entry is not None,
+                            params=len(tpl.params))
         flat_arrays = [
             engine.device_array(scan.arrays[sym])
             if getattr(scan, "cache_device", False) else scan.arrays[sym]
             for scan in scan_inputs for sym in scan.arrays]
+        pargs = tpl.example_args() if tpl is not None else []
         if entry is None:
             traced_fn, _host_arrays, meta = make_traced(
-                scan_inputs, plan, capacities, engine.session)
+                scan_inputs, plan, capacities, engine.session,
+                params=(pargs if tpl is not None else None))
             # compile-latency chaos point (ft/faults.py): lets the
             # chaos suite provoke slow compiles deterministically
             from presto_tpu.ft.faults import FAULTS
@@ -572,7 +606,7 @@ def prepare_plan(engine, plan: N.PlanNode, scan_inputs: list[ScanInput]):
             with TRACER.span("compile", attempt=_attempt,
                              root=type(plan).__name__):
                 compiled = jax.jit(traced_fn).lower(
-                    *flat_arrays).compile()
+                    *flat_arrays, *pargs).compile()
             compile_s = time.perf_counter() - _t0
             _COMPILES.inc()
             _COMPILE_SECONDS.observe(compile_s)
@@ -589,8 +623,13 @@ def prepare_plan(engine, plan: N.PlanNode, scan_inputs: list[ScanInput]):
         else:
             compiled, meta = entry
             cache_hit = True
+        if tpl is not None:
+            # bind THIS query's literal values (string parameters
+            # resolve through the dictionaries the trace recorded —
+            # carried in meta, so disk-tier hits bind too)
+            pargs = tpl.bind(meta.get("param_bindings"))
         with TRACER.span("execute", cache_hit=cache_hit):
-            res, live, oks = compiled(*flat_arrays)
+            res, live, oks = compiled(*flat_arrays, *pargs)
             # ONE host sync for every flag — also the point the async
             # dispatch actually finishes, so the span covers real
             # device time, not just call overhead
@@ -748,11 +787,25 @@ def _compact_kernel(live, data, cap: int):
 _compact_jit = jax.jit(_compact_kernel, static_argnames=("cap",))
 
 
-def device_outputs(meta, res, live):
+def device_outputs(meta, res, live, cap_floor: int | None = None):
     """Unpack one program's (meta, res, live) into segment-carrier form
     (arrays incl. $valid/__live__, dicts, types, n). Outputs compact to
     pow2(live count) when that at least halves the buffer, so later
-    segments never churn through dead padding."""
+    segments never churn through dead padding.
+
+    ``cap_floor`` (plan templates): None = legacy exact compaction;
+    an int (0 when no width is remembered yet) switches to templated
+    sizing. Carrier widths are DATA-dependent (pow2 of the live
+    count), so a literal variant whose intermediate crosses a pow2
+    boundary would shift every downstream segment's input shape and
+    miss the template cache. Templated sizing therefore sticks to the
+    remembered per-segment width whenever the live count FITS in it
+    (reusing the width exactly is what keeps downstream shapes — and
+    so the compiled programs — identical across variants), and only
+    when the count overflows the memory does it grow, with a 2x
+    margin (the RETRY_GROWTH idea applied to widths) so nearby
+    variants land in one bucket and outliers converge after a single
+    recompile."""
     arrays: dict = {}
     dicts: dict = {}
     types: dict = {}
@@ -770,7 +823,14 @@ def device_outputs(meta, res, live):
         types[sym] = dtype
     n = int(live.shape[0])
     cnt = int(np.asarray(jnp.sum(live)))
-    cap = max(128, next_pow2(max(cnt, 1)))
+    if cap_floor is None:
+        cap = max(128, next_pow2(max(cnt, 1)))
+    elif cap_floor and cnt <= cap_floor:
+        # a remembered width the count fits in: reuse it EXACTLY
+        # (0 = nothing remembered yet — must not compact to zero)
+        cap = int(cap_floor)
+    else:
+        cap = max(128, next_pow2(2 * max(cnt, 1)), int(cap_floor))
     if cap <= n // 2:
         arrays, live = _compact_jit(live, arrays, cap=cap)
         n = cap
@@ -779,13 +839,14 @@ def device_outputs(meta, res, live):
 
 
 def run_plan_device(engine, plan: N.PlanNode,
-                    scan_inputs: list["ScanInput"]):
+                    scan_inputs: list["ScanInput"],
+                    cap_floor: int | None = None):
     """Like run_plan but keeps results as DEVICE arrays (segment
     handoff); see device_outputs. Returns (arrays, dicts, types, n,
     per-node rows=None) — the runner contract of _segment_carriers."""
     _c, _f, meta, (res, live, _oks) = prepare_plan(
         engine, plan, scan_inputs)
-    return device_outputs(meta, res, live) + (None,)
+    return device_outputs(meta, res, live, cap_floor) + (None,)
 
 
 def _pool_wait(engine) -> tuple[float, float]:
@@ -824,16 +885,31 @@ def _segment_carriers(engine, plan: N.PlanNode, pool_tag: str,
     split that scans a same-wave carrier closes the wave — dependency
     order between waves is preserved exactly as the old serial loop.
 
-    ``runner(engine, mat, scans) -> (arrays, dicts, types, n,
-    node_rows)`` substitutes the per-segment executor (EXPLAIN ANALYZE
-    passes a profiling runner); ``observer(seg, mat, arrays, n,
-    wall_s, node_rows)`` fires per materialized segment, in segment
-    order."""
+    ``runner(engine, mat, scans, cap_floor=None) -> (arrays, dicts,
+    types, n, node_rows)`` substitutes the per-segment executor
+    (EXPLAIN ANALYZE passes a profiling runner); ``observer(seg, mat,
+    arrays, n, wall_s, node_rows)`` fires per materialized segment, in
+    segment order.
+
+    Carrier widths are remembered per (plan template, segment index)
+    in ``engine._carrier_caps`` and only grow: without the floor, a
+    literal variant whose intermediate crosses a pow2 compaction
+    boundary would shift every downstream segment's input shape and
+    recompile (see device_outputs)."""
+    from presto_tpu import templates as TPL
     from presto_tpu.exec import progcache as PC
     from presto_tpu.exec.streaming import _replace_node
+    from presto_tpu.plan.fingerprint import plan_fingerprint
 
     pool = getattr(engine, "memory_pool", None)
     run = runner or run_plan_device
+    tpl_mode = TPL.enabled(engine.session)
+    tpl0 = TPL.parameterize(plan) if tpl_mode else None
+    tfp = (tpl0.fingerprint() if tpl0 is not None
+           else plan_fingerprint(plan))
+    carrier_caps = getattr(engine, "_carrier_caps", None)
+    if carrier_caps is None:
+        carrier_caps = engine._carrier_caps = {}
     width = max(1, int(engine.session.get("parallel_compile_width")
                        or 1))
     if pool is not None and pool.capacity:
@@ -890,7 +966,9 @@ def _segment_carriers(engine, plan: N.PlanNode, pool_tag: str,
             with TRACER.attach(_ctx), \
                     TRACER.span("segment", index=seg + idx,
                                 wave_width=len(wave)):
-                out = run(engine, mat, scans)
+                floor = (carrier_caps.get((tfp, seg + idx), 0)
+                         if tpl_mode else None)
+                out = run(engine, mat, scans, cap_floor=floor)
             if pool is not None:
                 # reserve inside the job, as the serial loop did: an
                 # over-budget pipeline must raise MemoryLimitExceeded
@@ -914,6 +992,13 @@ def _segment_carriers(engine, plan: N.PlanNode, pool_tag: str,
                 observer(seg, mat, arrays, n, wall_s, node_rows)
             carriers[id(cnode)] = ScanInput(cnode, arrays, dicts,
                                             types, n)
+            # grow-only width memory (benign race: a lost update just
+            # costs one extra compile on some later variant)
+            prev = carrier_caps.get((tfp, seg))
+            if prev is None or n > prev:
+                if len(carrier_caps) > 512:
+                    carrier_caps.clear()
+                carrier_caps[(tfp, seg)] = n
             seg += 1
         # adopt the wave's fully-spliced tree: _replace_node rebuilds
         # every interior node, so re-splicing wave items 2..n into the

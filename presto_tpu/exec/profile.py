@@ -55,10 +55,20 @@ def _profiled_compile_run(engine, plan, scans):
     extra 80-150 s compile per rung. Returns
     (meta, res, live, counts, compile_s, run_s) of the successful
     attempt."""
+    from presto_tpu import templates as TPL
     from presto_tpu.exec import executor as EX
     from presto_tpu.exec import progcache as PC
 
-    base_key, _ = EX._cache_key(engine, plan, scans, {})
+    # seed capacities under the SAME key prepare_plan stores them:
+    # with templates on that is the parameterized plan over bucketed
+    # scan shapes (the profiling trace itself keeps literals baked)
+    kplan, kscans = plan, scans
+    if TPL.enabled(engine.session):
+        kscans = TPL.bucket_scans(engine, scans)
+        tpl = TPL.parameterize(plan)
+        if tpl is not None:
+            kplan = tpl.plan
+    base_key, _ = EX._cache_key(engine, kplan, kscans, {})
     known = engine._caps_memory.get(base_key)
     if known is None:
         known = engine._program_cache.load_caps(
@@ -86,14 +96,15 @@ def _profiled_compile_run(engine, plan, scans):
     raise RuntimeError("hash table capacity retry limit exceeded")
 
 
-def _profiled_runner(engine, mat, scans):
+def _profiled_runner(engine, mat, scans, cap_floor=None):
     """run_plan_device twin for segments: returns (arrays, dicts,
-    types, n, {node id: actual rows})."""
+    types, n, {node id: actual rows}). ``cap_floor`` keeps carrier
+    widths consistent with the production (templated) pipeline."""
     meta, res, live, counts, _c, _r = _profiled_compile_run(
         engine, mat, scans)
     node_rows = {nid: int(np.asarray(c))
                  for nid, c in zip(meta["count_nodes"], counts)}
-    return device_outputs(meta, res, live) + (node_rows,)
+    return device_outputs(meta, res, live, cap_floor) + (node_rows,)
 
 
 def _annotate(mat, node_rows: dict | None, engine) -> dict[int, str]:

@@ -296,6 +296,29 @@ def _recombine128(a, b, hi64):
     return I.pack(lo, hi)
 
 
+def _normalize_limbs(states: dict) -> dict:
+    """Carry-normalize LONG-decimal a/b partial sums back into the
+    32-bit limb domain (hi absorbs the carries, wrapping mod 2^64 —
+    the recombination is exact mod 2^128).
+
+    A partial state's ``a``/``b`` accumulate one 32-bit half per row,
+    so after N rows each holds up to N * (2^32 - 1): safe in int64 for
+    N < 2^31 rows, but the PARTIAL->FINAL merge re-SUMS those already-
+    large per-worker sums, so without normalization the merged total
+    wraps int64 once the rows covered by the merged states pass 2^31
+    (~2 x 10^9 — real at SF1000; ADVICE round 5). Normalized states
+    re-enter the per-row domain (a', b' < 2^32), making the merge sum
+    safe for up to 2^31 *states* instead of rows."""
+    packed = _recombine128(states["a"], states["b"], states["hi"])
+    u = packed[..., 0].astype(jnp.uint64)
+    return {
+        **states,
+        "a": (u & jnp.uint64(0xFFFFFFFF)).astype(jnp.int64),
+        "b": (u >> jnp.uint64(32)).astype(jnp.int64),
+        "hi": packed[..., 1],
+    }
+
+
 def prepare_arg(fn: str, data, arg_type: T.DataType | None):
     """Pre-convert the argument for aggregates that fold in a derived
     domain: variance family / geometric_mean / covariances unscale
@@ -670,6 +693,7 @@ def scan_merge(fn: str, states: dict, live, sg):
         return {"count": S.seg_sum(jnp.where(w, states["count"], 0), sg)}
     if fn in ("sum", "avg"):
         if "a" in states:  # LONG decimal limb states
+            states = _normalize_limbs(states)
             return {f: S.seg_sum(jnp.where(w, states[f], 0), sg)
                     for f in ("a", "b", "hi", "count")}
         zero = jnp.zeros((), states["sum"].dtype)
@@ -851,6 +875,7 @@ def merge(fn: str, states: dict, slots, capacity: int, live):
             jnp.where(w, states["count"], 0), slots, num_segments=capacity)}
     if fn in ("sum", "avg"):
         if "a" in states:  # LONG decimal limb states
+            states = _normalize_limbs(states)
             return {f: segred.segment_sum(
                 jnp.where(w, states[f], 0), slots,
                 num_segments=capacity)

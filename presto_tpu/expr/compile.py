@@ -193,11 +193,21 @@ def _align_strings(a: Val, b: Val) -> tuple[object, object]:
     """Return comparable code arrays for two string Vals.
 
     - same dictionary object: codes compare directly;
+    - template parameter vs column: the parameter's traced value IS a
+      code in the column's dictionary (resolved at bind time against
+      the dictionary recorded here; -1 = absent = matches nothing);
     - literal vs column: resolve through the column's dictionary;
     - different dictionaries: translate a's codes into b's code space via a
       host-computed mapping (-1 where a's string is absent from b's dict).
     Only valid for equality comparisons unless dictionaries are identical.
     """
+    from presto_tpu.templates.runtime import ParamDictionary
+    if isinstance(a.dictionary, ParamDictionary):
+        a.dictionary.bind(b.dictionary)
+        return a.data, b.data
+    if isinstance(b.dictionary, ParamDictionary):
+        b.dictionary.bind(a.dictionary)
+        return a.data, b.data
     if a.dictionary is b.dictionary:
         return a.data, b.data
     # map a's dict entries into b's code space
@@ -302,6 +312,20 @@ class ExprCompiler:
         # lambdas are not values: higher-order kernels read them from
         # e.args and bind the params themselves
         return Val(e.dtype, None)
+
+    def _c_parameter(self, e: "ir.Parameter") -> Val:
+        # hoisted literal (templates/): the value is a traced device
+        # scalar from the active params context, so literal variants
+        # of one plan template share a compiled program. VARCHAR
+        # parameters are dictionary codes; the marker dictionary makes
+        # _align_strings record which dictionary to resolve against.
+        from presto_tpu.templates.runtime import (ParamDictionary,
+                                                  current_params)
+        tp = current_params()
+        data = tp.traced(e.index)
+        if isinstance(e.dtype, T.VarcharType):
+            return Val(e.dtype, data, None, ParamDictionary(e.index, tp))
+        return Val(e.dtype, data)
 
 
 def _merge_dicts(a: Val, b: Val) -> tuple[Val, Val]:

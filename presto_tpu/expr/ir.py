@@ -43,6 +43,21 @@ class Literal(Expr):
 
 
 @dataclasses.dataclass(frozen=True)
+class Parameter(Expr):
+    """A hoisted literal (templates/analysis.py): position ``index`` of
+    the plan template's runtime parameter vector. Enters the traced
+    program as a device scalar argument, so literal variants of one
+    query shape share a compiled executable. Only ever present in
+    plans produced by templates.parameterize — the planner/optimizer
+    never emit it."""
+
+    index: int = 0
+
+    def __str__(self) -> str:
+        return f"?{self.index}"
+
+
+@dataclasses.dataclass(frozen=True)
 class Call(Expr):
     """Scalar function call, including operators (add, eq, and, or, like...).
     Function semantics live in expr/functions.py."""

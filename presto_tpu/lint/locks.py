@@ -44,6 +44,9 @@ LOCK_SCOPES = (
     # low-memory killer write tokens other threads observe)
     "presto_tpu/exec/cancel.py",
     "presto_tpu/ft/",
+    # plan-template pad caches are shared across concurrently
+    # compiling queries (templates/shapes.py)
+    "presto_tpu/templates/",
 )
 
 _LOCK_NAME_RE = re.compile(

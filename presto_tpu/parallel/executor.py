@@ -725,6 +725,17 @@ def execute_plan_distributed(engine, plan: N.PlanNode,
     from presto_tpu.plan.fingerprint import plan_fingerprint
 
     nshards = mesh.devices.size
+    # plan templates (templates/): hoist literals before the plan is
+    # fingerprinted so literal variants share the shard_map executable;
+    # this query's values ride as trailing REPLICATED scalar args.
+    # EXPLAIN ANALYZE (profile) bypasses the cache and keeps literals
+    # baked — its row-count outputs change the program anyway.
+    from presto_tpu import templates as TPL
+    tpl = None
+    if profile is None and TPL.enabled(engine.session):
+        tpl = TPL.parameterize(plan)
+        if tpl is not None:
+            plan = tpl.plan
     scan_inputs = collect_scans(plan, engine)
     node_order = preorder_index(plan)
 
@@ -769,6 +780,10 @@ def execute_plan_distributed(engine, plan: N.PlanNode,
         caps_key = PC.bucket_capacities(capacities)
         entry = (cache.lookup((base_key, caps_key), fpr)
                  if use_cache else None)
+        if tpl is not None and _attempt == 0:
+            TPL.note_lookup(hit=entry is not None,
+                            params=len(tpl.params))
+        pargs = tpl.example_args() if tpl is not None else []
         lowered = None
         if entry is not None:
             compiled, meta = entry
@@ -787,7 +802,14 @@ def execute_plan_distributed(engine, plan: N.PlanNode,
                 interp = ShardedInterpreter(scans, capacities, nshards,
                                             engine.session, node_order)
                 interp.collect_counts = profile is not None
-                out = interp.run(plan).dt
+                if tpl is not None:
+                    from presto_tpu.templates import runtime as TR
+                    tp = TR.TraceParams(list(it))
+                    with TR.active(tp):
+                        out = interp.run(plan).dt
+                    meta["param_bindings"] = dict(tp.bindings)
+                else:
+                    out = interp.run(plan).dt
                 meta["out"] = [
                     (sym, v.dtype, v.dictionary, v.valid is not None)
                     for sym, v in out.cols.items()]
@@ -806,22 +828,26 @@ def execute_plan_distributed(engine, plan: N.PlanNode,
 
             sharded = _shard_map(
                 traced_fn, mesh=mesh,
-                in_specs=tuple(P(AXIS) for _ in flat_arrays),
+                in_specs=(tuple(P(AXIS) for _ in flat_arrays)
+                          + tuple(P() for _ in pargs)),
                 out_specs=(P(), P(), P(), P()),
                 **_SHARD_MAP_NOCHECK)
             t0 = _time.perf_counter()
             with _TRACER.span("compile", devices=nshards,
                               distributed=True):
-                lowered = jax.jit(sharded).lower(*flat_arrays)
+                lowered = jax.jit(sharded).lower(*flat_arrays, *pargs)
                 compiled = lowered.compile()
             compile_s = _time.perf_counter() - t0
             _COMPILES.inc()
             _COMPILE_SECONDS.observe(compile_s)
+        if tpl is not None:
+            pargs = tpl.bind(meta.get("param_bindings"))
         t0 = _time.perf_counter()
         with _TRACER.span("execute", devices=nshards,
                           distributed=True):
             with mesh:
-                res, live, oks, node_counts = compiled(*flat_arrays)
+                res, live, oks, node_counts = compiled(
+                    *flat_arrays, *pargs)
             jax.block_until_ready(live)
         run_s = _time.perf_counter() - t0
         if all(bool(np.asarray(o)) for o in oks):

@@ -85,6 +85,13 @@ class QueryInfo:
     # subsequent requests (reference: X-Trino-Set-Session response
     # header + StatementClientV1 session accumulation)
     set_session: dict | None = None
+    # this request's prepared-statement registry from the
+    # X-Trino-Prepared-Statement header ({name: sql}); PREPARE /
+    # DEALLOCATE answer with added/deallocated entries the client
+    # accumulates, mirroring the set_session round-trip
+    prepared_statements: dict = dataclasses.field(default_factory=dict)
+    add_prepared: dict | None = None
+    remove_prepared: list | None = None
 
     def stats(self) -> dict:
         wall = ((self.finished or time.monotonic())
@@ -207,13 +214,15 @@ class QueryManager:
         self.reaper = QueryReaper(self).start()
 
     def submit(self, sql: str, user: str,
-               session_properties: dict | None = None) -> QueryInfo:
+               session_properties: dict | None = None,
+               prepared_statements: dict | None = None) -> QueryInfo:
         from presto_tpu.server.resource_groups import (
             NoMatchingGroupError, QueryQueueFullError)
 
         qid = f"{time.strftime('%Y%m%d_%H%M%S')}_{uuid.uuid4().hex[:5]}"
         q = QueryInfo(qid, sql, user,
-                      session_properties=session_properties or {})
+                      session_properties=session_properties or {},
+                      prepared_statements=prepared_statements or {})
         _TRANSITIONS.inc(state="queued")
         with self.lock:
             self.queries[qid] = q
@@ -337,7 +346,20 @@ class QueryManager:
         from presto_tpu.sql import ast as A
         from presto_tpu.sql.parser import parse_statement
 
-        stmt = parse_statement(q.sql)
+        sql = q.sql
+        stmt = parse_statement(sql)
+        if isinstance(stmt, A.ExecutePrepared):
+            # splice literals over the stored text's ? markers and run
+            # the result through the normal pipeline — every variant
+            # lands on the same plan template (templates/prepared.py).
+            # Resolution happens BEFORE the statement-kind guards
+            # below: a prepared `start transaction` (or nested
+            # PREPARE) must hit the same HTTP-protocol rejections a
+            # direct one does, not smuggle past them into the shared
+            # engine.
+            from presto_tpu.templates.prepared import resolve_execute
+            sql = resolve_execute(q.prepared_statements, stmt)
+            stmt = parse_statement(sql)
         if isinstance(stmt, (A.StartTransaction, A.CommitStatement,
                              A.RollbackStatement)):
             # the TransactionManager is process-global; over HTTP a
@@ -346,6 +368,22 @@ class QueryManager:
             # unsupported over HTTP for the same reason)
             raise ValueError(
                 "transactions are not supported over the HTTP protocol")
+        if isinstance(stmt, A.Prepare):
+            # never stored engine-side: the registry goes back to THIS
+            # client, which replays it via the
+            # X-Trino-Prepared-Statement header (the set_session model)
+            q.add_prepared = {stmt.name: stmt.sql}
+            q.columns = []
+            q.rows = []
+            return
+        if isinstance(stmt, A.Deallocate):
+            if stmt.name not in q.prepared_statements:
+                raise ValueError(
+                    f"prepared statement not found: {stmt.name}")
+            q.remove_prepared = [stmt.name]
+            q.columns = []
+            q.rows = []
+            return
         if isinstance(stmt, A.SetSession):
             # never mutates the shared engine session: the validated
             # property goes back to THIS client, which replays it via
@@ -361,7 +399,7 @@ class QueryManager:
         overrides = dict(q.session_properties)
         if not isinstance(stmt, A.QueryStatement):
             with self.engine.session.as_user(q.user, overrides):
-                rows = self.engine.execute(q.sql,
+                rows = self.engine.execute(sql,
                                            cancel_token=q.cancel_token)
             q.warnings = [w.to_dict() for w in
                           getattr(self.engine, "last_warnings", [])]
@@ -371,7 +409,7 @@ class QueryManager:
             q.rows = [[_json_value(v, T.VARCHAR) for v in row]
                       for row in rows]
             return
-        with self._admission(q, overrides):
+        with self._admission(q, overrides, sql):
             if self.cluster is not None:
                 # multi-host path: fragments ship to the cluster's
                 # HTTP workers under the protocol query id, so the
@@ -382,12 +420,12 @@ class QueryManager:
                 # completion.)
                 with self.engine.session.as_user(q.user, overrides):
                     table = self.cluster.execute_table(
-                        q.sql, query_id=q.query_id,
+                        sql, query_id=q.query_id,
                         cancel_token=q.cancel_token)
             else:
                 with self.engine.session.as_user(q.user, overrides):
                     table = self.engine.execute_table(
-                        q.sql, cancel_token=q.cancel_token)
+                        sql, cancel_token=q.cancel_token)
         q.warnings = [w.to_dict() for w in
                       getattr(self.engine, "last_warnings", [])]
         q.columns = [{"name": n, "type": str(c.dtype)}
@@ -398,7 +436,8 @@ class QueryManager:
             for row in table.to_pylist()]
 
     @contextlib.contextmanager
-    def _admission(self, q: QueryInfo, overrides: dict):
+    def _admission(self, q: QueryInfo, overrides: dict,
+                   sql: str | None = None):
         """Cluster memory governance (reference ClusterMemoryManager):
         with a query-pool capacity configured, reserve the query's
         plan-time device-memory estimate for its whole lifetime. An
@@ -406,6 +445,8 @@ class QueryManager:
         to release; sustained exhaustion invokes the low-memory killer
         against the largest reservation. With capacity 0 (default)
         admission charges nothing."""
+        if sql is None:
+            sql = q.sql
         if not self.query_pool.capacity:
             yield
             return
@@ -421,10 +462,10 @@ class QueryManager:
             # its planning pass; the handoff stays thread-local and is
             # consumed under the SAME session scope on this thread
             if self.cluster is not None:
-                plan, _ = self.engine.plan_sql(q.sql,
+                plan, _ = self.engine.plan_sql(sql,
                                                enable_latemat=False)
             else:
-                plan, _ = self.engine.plan_sql(q.sql)
+                plan, _ = self.engine.plan_sql(sql)
             est, _per_node = estimate_plan_memory(plan, self.engine)
         charge = max(int(est), 1)
         with TRACER.span("memory-admission", bytes=charge,
@@ -435,7 +476,7 @@ class QueryManager:
                 kill_after_s=self.limit_of(
                     q, "low_memory_killer_delay_s"),
                 owner=q.cancel_token)
-        self.engine.offer_preplanned(q.sql, plan)
+        self.engine.offer_preplanned(sql, plan)
         try:
             yield
         finally:
@@ -642,6 +683,10 @@ class _Handler(JsonHandler):
         if q.state == "FINISHED":
             if q.set_session:
                 out["setSession"] = q.set_session
+            if q.add_prepared:
+                out["addedPreparedStatements"] = q.add_prepared
+            if q.remove_prepared:
+                out["deallocatedPreparedStatements"] = q.remove_prepared
             if getattr(q, "warnings", None):
                 # reference protocol/QueryResults warnings field
                 out["warnings"] = q.warnings
@@ -670,7 +715,9 @@ class _Handler(JsonHandler):
                 return
             length = int(self.headers.get("Content-Length", 0))
             sql = self.rfile.read(length).decode()
-            q = self.manager.submit(sql, user, session_properties=props)
+            q = self.manager.submit(
+                sql, user, session_properties=props,
+                prepared_statements=self._prepared_statements())
             if q.error_name == "QUERY_QUEUE_FULL":
                 # fast 429-style shed (reference QUERY_QUEUE_FULL +
                 # Too Many Requests): the client backs off and
@@ -700,6 +747,23 @@ class _Handler(JsonHandler):
             props[name.strip()] = coerce_property(
                 name.strip(), unquote(value.strip()))
         return props
+
+    def _prepared_statements(self) -> dict:
+        """This request's prepared-statement registry from the
+        X-Trino-Prepared-Statement header (comma-separated
+        name=url-encoded-sql pairs, the reference protocol encoding)."""
+        from urllib.parse import unquote
+
+        header = self.headers.get("X-Trino-Prepared-Statement", "")
+        out = {}
+        for pair in header.split(","):
+            pair = pair.strip()
+            if not pair:
+                continue
+            name, sep, sql = pair.partition("=")
+            if sep:
+                out[unquote(name.strip())] = unquote(sql.strip())
+        return out
 
     def do_GET(self):  # noqa: N802
         parts = self.path.strip("/").split("/")

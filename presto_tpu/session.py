@@ -190,6 +190,23 @@ SYSTEM_SESSION_PROPERTIES: dict[str, tuple[Any, type, str]] = {
                           "re-fetch a dead producer's pages instead "
                           "of recomputing (ft/spool.py; no-op when "
                           "no spool directory is configured)"),
+    "plan_templates": (True, bool,
+                       "hoist comparison/arithmetic literals out of "
+                       "traced programs into runtime arguments and key "
+                       "the program cache on the parameterized plan "
+                       "template (templates/), so literal variants of "
+                       "one query shape share a compiled executable "
+                       "instead of recompiling (reference "
+                       "prepared-statement execution)"),
+    "template_shape_bucketing": (True, bool,
+                                 "pad host scan buffers to pow2 row "
+                                 "buckets (dead rows masked) so the "
+                                 "shape component of the template "
+                                 "cache key buckets the way "
+                                 "capacities already do "
+                                 "(templates/shapes.py); only "
+                                 "consulted when plan_templates is "
+                                 "on"),
     "task_request_timeout_s": (300.0, float,
                                "HTTP deadline for coordinator->worker "
                                "task POSTs (was hard-coded 300)"),
@@ -206,6 +223,12 @@ class Session:
     catalog: str = "tpch"
     default_user: str = "presto"
     properties: dict[str, Any] = dataclasses.field(default_factory=dict)
+    # PREPARE name FROM <sql> registry (templates/prepared.py; the
+    # reference keeps prepared statements in Session the same way —
+    # over HTTP the registry is per-client, replayed via the
+    # X-Trino-Prepared-Statement header instead of stored here)
+    prepared_statements: dict[str, str] = dataclasses.field(
+        default_factory=dict)
 
     @property
     def user(self) -> str:

@@ -220,6 +220,15 @@ class Star(Expression):
     qualifier: Optional[str] = None
 
 
+@dataclasses.dataclass(frozen=True)
+class ParameterMarker(Expression):
+    """A ``?`` parameter of a prepared statement (reference
+    sql/tree/Parameter.java). Only valid inside PREPARE'd text; EXECUTE
+    splices literals over the markers before planning
+    (templates/prepared.py), so the planner never sees one."""
+    position: int = 0
+
+
 # ---- relations ------------------------------------------------------------
 
 
@@ -455,3 +464,25 @@ class InsertStatement(Statement):
 class DropTable(Statement):
     table: tuple[str, ...] = ()
     if_exists: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class Prepare(Statement):
+    """PREPARE name FROM <statement> — stores the statement TEXT
+    (with ? markers) under a session-scoped name (reference
+    sql/tree/Prepare.java)."""
+    name: str = ""
+    sql: str = ""
+
+
+@dataclasses.dataclass(frozen=True)
+class ExecutePrepared(Statement):
+    """EXECUTE name [USING literal, ...]."""
+    name: str = ""
+    params: tuple[Expression, ...] = ()
+
+
+@dataclasses.dataclass(frozen=True)
+class Deallocate(Statement):
+    """DEALLOCATE PREPARE name."""
+    name: str = ""

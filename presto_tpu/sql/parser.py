@@ -24,7 +24,7 @@ _RESERVED_STOP = {
 
 
 def parse_statement(sql: str) -> A.Statement:
-    return Parser(tokenize(sql)).parse_statement()
+    return Parser(tokenize(sql), text=sql).parse_statement()
 
 
 def parse_expression(sql: str) -> A.Expression:
@@ -35,9 +35,12 @@ def parse_expression(sql: str) -> A.Expression:
 
 
 class Parser:
-    def __init__(self, tokens: list[Token]):
+    def __init__(self, tokens: list[Token], text: str | None = None):
         self.tokens = tokens
         self.i = 0
+        # original SQL text when available: PREPARE stores the
+        # prepared statement verbatim (token positions slice it)
+        self.text = text
 
     # -- token helpers ------------------------------------------------------
 
@@ -205,6 +208,38 @@ class Parser:
                     where = self.expression()
                 self.expect_eof()
                 return A.UpdateStatement(table, tuple(assigns), where)
+            if t.value == "prepare":
+                # PREPARE name FROM <statement> (with ? markers;
+                # validated for syntax here, planned at EXECUTE —
+                # reference sql/tree/Prepare semantics)
+                self.advance()
+                name = self.identifier()
+                self.expect_keyword("from")
+                start = self.peek().pos
+                if self.peek().kind == "eof":
+                    raise SqlSyntaxError(
+                        f"empty prepared statement at position {start}")
+                self.parse_statement()  # syntax check; consumes to EOF
+                sql = (self.text[start:] if self.text is not None
+                       else "").strip().rstrip(";").strip()
+                return A.Prepare(name, sql)
+            if t.value == "execute":
+                self.advance()
+                name = self.identifier()
+                params: tuple[A.Expression, ...] = ()
+                if self.accept_keyword("using"):
+                    exprs = [self.expression()]
+                    while self.accept_op(","):
+                        exprs.append(self.expression())
+                    params = tuple(exprs)
+                self.expect_eof()
+                return A.ExecutePrepared(name, params)
+            if t.value == "deallocate":
+                self.advance()
+                self.accept_keyword("prepare")
+                name = self.identifier()
+                self.expect_eof()
+                return A.Deallocate(name)
             if t.value == "drop":
                 self.advance()
                 self.expect_keyword("table")
@@ -812,6 +847,11 @@ class Parser:
         if t.kind == "string":
             self.advance()
             return A.StringLiteral(t.value)
+        if t.kind == "op" and t.value == "?":
+            # prepared-statement parameter marker: EXECUTE substitutes
+            # a literal at this position before planning
+            self.advance()
+            return A.ParameterMarker(t.pos)
         if t.kind == "op" and t.value == "(":
             self.advance()
             if self.at_keyword("select", "with"):

@@ -1,0 +1,139 @@
+"""Trace/execute-time machinery for parameterized plan templates.
+
+A parameterized plan (templates/analysis.py) carries ``ir.Parameter``
+leaves instead of hoistable literals. At trace time the expression
+compiler resolves each Parameter against the :class:`TraceParams`
+context installed around the interpreter walk — the parameter's traced
+value is a DEVICE argument of the jitted program, so a literal-variant
+replay reuses the compiled executable with a different scalar instead
+of recompiling (the Trino prepared-statement execution model,
+StatementClientV1, applied at the XLA artifact layer).
+
+VARCHAR parameters are special: the engine's string substrate is
+dictionary codes, so the traced value is an int32 code *in the
+dictionary of the column the parameter is compared against*. That
+dictionary is only discovered mid-trace (expr/compile._align_strings),
+so the compare path records a (parameter index -> dictionary) binding
+here; :func:`bind_values` resolves the actual string through the
+recorded dictionary at execute time (code -1 = absent = matches no
+row, exactly the baked-literal semantics). The bindings ride in the
+program-cache ``meta`` so disk-tier hits in a fresh process can still
+bind.
+
+State is strictly per-trace and confined to the tracing thread
+(``threading.local``): parallel segment compilation traces concurrent
+programs, each under its own installed context.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import numpy as np
+
+from presto_tpu import types as T
+
+_TLS = threading.local()
+
+
+class TemplateError(RuntimeError):
+    """A parameterized plan was traced without a params context, or a
+    parameter was used in a context the analysis should have rejected
+    — always an engine bug, never a user error."""
+
+
+class ParamDictionary:
+    """Stand-in dictionary of a hoisted VARCHAR literal during trace.
+
+    The compare path (expr/compile._align_strings) calls :meth:`bind`
+    with the dictionary of the other side, recording where the
+    parameter's runtime code must be resolved. Any other dictionary
+    operation on a parameter is a bug: the analysis only hoists VARCHAR
+    literals into eq/neq comparisons."""
+
+    __slots__ = ("index", "_params")
+
+    def __init__(self, index: int, params: "TraceParams"):
+        self.index = index
+        self._params = params
+
+    def bind(self, dictionary) -> None:
+        self._params.record_binding(self.index, dictionary)
+
+    def __getattr__(self, name):  # astype/__len__/searchsorted/...
+        raise TemplateError(
+            "VARCHAR template parameter used outside an eq/neq "
+            "comparison (templates/analysis.py must not hoist here)")
+
+
+class TraceParams:
+    """One trace's parameter values + recorded string bindings."""
+
+    def __init__(self, values: list):
+        self.values = list(values)
+        # parameter index -> host dictionary array the traced code
+        # indexes into (recorded by ParamDictionary.bind)
+        self.bindings: dict[int, object] = {}
+
+    def traced(self, index: int):
+        """The traced device value of parameter ``index``."""
+        return self.values[index]
+
+    def record_binding(self, index: int, dictionary) -> None:
+        prev = self.bindings.get(index)
+        if prev is not None and prev is not dictionary:
+            # one Parameter node occupies exactly one tree position, so
+            # two distinct dictionaries can only mean expression-level
+            # aliasing the analysis failed to split
+            raise TemplateError(
+                f"template parameter {index} compared against two "
+                f"different dictionaries")
+        self.bindings[index] = dictionary
+
+
+@contextlib.contextmanager
+def active(params: TraceParams):
+    """Install ``params`` for the duration of one interpreter trace."""
+    prev = getattr(_TLS, "params", None)
+    _TLS.params = params
+    try:
+        yield params
+    finally:
+        _TLS.params = prev
+
+
+def current_params() -> TraceParams:
+    params = getattr(_TLS, "params", None)
+    if params is None:
+        raise TemplateError(
+            "parameterized plan traced without a TraceParams context")
+    return params
+
+
+def _long_limbs(value: int) -> np.ndarray:
+    from presto_tpu.expr.compile import _lit128_np
+    return _lit128_np(int(value))
+
+
+def physical_value(dtype, value, dictionary=None) -> np.ndarray:
+    """Host physical encoding of one parameter value, matching what
+    expr/compile._c_literal would bake for the same literal."""
+    if isinstance(dtype, T.VarcharType):
+        if dictionary is None or value is None:
+            return np.int32(-1)  # matches no code
+        from presto_tpu.expr.compile import _lit_code
+        return np.int32(_lit_code(dictionary, str(value)))
+    if isinstance(dtype, T.DecimalType) and dtype.is_long:
+        return _long_limbs(value)
+    return np.asarray(value, dtype=dtype.physical_dtype)
+
+
+def bind_values(specs, bindings: dict | None) -> list:
+    """Physical argument vector for one execution: ``specs`` is the
+    template's ordered parameter list (templates/analysis.ParamSpec),
+    ``bindings`` the recorded string dictionaries (from trace meta;
+    None/missing entries bind to code -1)."""
+    bindings = bindings or {}
+    return [physical_value(s.dtype, s.value, bindings.get(i))
+            for i, s in enumerate(specs)]

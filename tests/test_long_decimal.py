@@ -338,3 +338,47 @@ def test_decimal_multiply_scale_overflow_fails_loudly(eng):
     with pytest.raises(SemanticError, match="38"):
         eng.execute("select cast(1 as decimal(38,20)) "
                     "* cast(1 as decimal(38,20))")
+
+
+def test_merge_normalizes_limb_carries_before_resum():
+    """PARTIAL->FINAL merge of LONG-decimal sum states: each partial's
+    a/b columns hold 32-bit-limb sums that can be close to int64 range
+    after ~2^31 rows; re-summing several such states wrapped int64
+    (ISSUE 7 satellite / ADVICE r5). merge() must carry-normalize each
+    state into the hi limb first, making the re-sum exact."""
+    import jax.numpy as jnp
+
+    from presto_tpu.expr import aggregates as AG
+
+    # two partial states for ONE group, each representing a huge
+    # per-worker sum: a/b near 2^62 (as after ~2^30 rows of values
+    # near 2^32) — their naive int64 re-sum wraps negative
+    a = np.array([3 << 61, 3 << 61], dtype=np.int64)
+    b = np.array([1 << 20, 1 << 20], dtype=np.int64)
+    hi = np.array([5, 7], dtype=np.int64)
+    count = np.array([1 << 30, 1 << 30], dtype=np.int64)
+    states = {"a": jnp.asarray(a), "b": jnp.asarray(b),
+              "hi": jnp.asarray(hi), "count": jnp.asarray(count)}
+    slots = jnp.zeros(2, dtype=jnp.int32)
+    live = jnp.ones(2, dtype=bool)
+
+    merged = AG.merge("sum", states, slots, capacity=1, live=live)
+    packed = np.asarray(AG._recombine128(
+        merged["a"], merged["b"], merged["hi"]))
+
+    def int128_of(lo_signed, hi_signed):
+        lo_u = int(lo_signed) & ((1 << 64) - 1)
+        return (int(hi_signed) << 64) + lo_u
+
+    def state_value(i):
+        lo = (int(a[i]) + (int(b[i]) << 32)) & ((1 << 64) - 1)
+        carry = (int(a[i]) + (int(b[i]) << 32)) >> 64
+        return ((int(hi[i]) + carry) << 64) + lo
+
+    want = state_value(0) + state_value(1)
+    got = int128_of(packed[0, 0], packed[0, 1])
+    assert got == want, (got, want)
+    assert int(np.asarray(merged["count"])[0]) == 2 << 30
+    # the un-normalized re-sum would have wrapped: prove the inputs
+    # were actually in the dangerous range
+    assert (int(a[0]) + int(a[1])) >= (1 << 63)  # would wrap int64
