@@ -104,9 +104,14 @@ class InformationSchemaConnector(_ReflectiveConnector):
 
 
 class SystemConnector(_ReflectiveConnector):
-    """Catalog `system`: runtime tables (reference connector/system
-    NodeSystemTable, QuerySystemTable, and a session-properties table
-    mirroring the jdbc/metadata ones)."""
+    """Catalog `system`: runtime tables (reference connector/system —
+    NodeSystemTable, QuerySystemTable, the task/optimizer-runtime
+    tables of the ``system.runtime`` schema, and a session-properties
+    table mirroring the jdbc/metadata ones). The stats-backed tables
+    (`tasks`, `operator_stats`, `plan_divergence`) read the live
+    obs/qstats recorders, so the engine can be debugged with itself
+    MID-FLIGHT: a running query's tasks are visible to a concurrent
+    ``SELECT * FROM system.tasks``."""
 
     name = "system"
 
@@ -114,13 +119,44 @@ class SystemConnector(_ReflectiveConnector):
         "nodes": {
             "node_id": T.VARCHAR, "http_uri": T.VARCHAR,
             "node_version": T.VARCHAR, "coordinator": T.VARCHAR,
-            "state": T.VARCHAR,
+            "state": T.VARCHAR, "active_tasks": T.BIGINT,
         },
         "queries": {
             "query_id": T.VARCHAR, "state": T.VARCHAR,
             "user": T.VARCHAR, "query": T.VARCHAR,
             "output_rows": T.BIGINT, "wall_ms": T.BIGINT,
             "error": T.VARCHAR,
+        },
+        "tasks": {
+            "query_id": T.VARCHAR, "stage": T.VARCHAR,
+            "task_id": T.VARCHAR, "node": T.VARCHAR,
+            "state": T.VARCHAR, "shard": T.BIGINT,
+            "input_rows": T.BIGINT, "output_rows": T.BIGINT,
+            "exchange_pages": T.BIGINT, "exchange_bytes": T.BIGINT,
+            "spooled_pages": T.BIGINT, "programs": T.BIGINT,
+            "compiles": T.BIGINT, "cache_hits": T.BIGINT,
+            "template_hits": T.BIGINT, "retries": T.BIGINT,
+            "compile_ms": T.BIGINT, "execute_ms": T.BIGINT,
+            "wall_ms": T.BIGINT, "peak_memory_bytes": T.BIGINT,
+        },
+        "operator_stats": {
+            "query_id": T.VARCHAR, "stage": T.VARCHAR,
+            "task_id": T.VARCHAR, "plan_node_id": T.VARCHAR,
+            "node_type": T.VARCHAR, "label": T.VARCHAR,
+            "input_rows": T.BIGINT, "output_rows": T.BIGINT,
+            "output_bytes": T.BIGINT, "est_rows": T.BIGINT,
+        },
+        "plan_divergence": {
+            "query_id": T.VARCHAR, "stage": T.VARCHAR,
+            "plan_node_id": T.VARCHAR, "node_type": T.VARCHAR,
+            "table_name": T.VARCHAR, "est_rows": T.BIGINT,
+            "actual_rows": T.BIGINT, "ratio": T.DOUBLE,
+        },
+        "query_history": {
+            "query_id": T.VARCHAR, "state": T.VARCHAR,
+            "user": T.VARCHAR, "query": T.VARCHAR,
+            "output_rows": T.BIGINT, "wall_ms": T.BIGINT,
+            "create_time": T.DOUBLE, "error": T.VARCHAR,
         },
         "session_properties": {
             "name": T.VARCHAR, "value": T.VARCHAR,
@@ -131,12 +167,34 @@ class SystemConnector(_ReflectiveConnector):
 
     def _rows(self, name: str) -> list[tuple]:
         if name == "nodes":
-            return [("local", "local://0", "presto-tpu", "true",
-                     "active")]
+            return self._node_rows()
         if name == "queries":
             return [(e.query_id, e.state, e.user, e.sql,
                      e.output_rows, int(e.elapsed_ms), e.error or "")
                     for e in self.engine.events.history]
+        if name == "tasks":
+            return self._task_rows()
+        if name == "operator_stats":
+            return self._operator_rows()
+        if name == "plan_divergence":
+            from presto_tpu.obs.qstats import DIVERGENCE
+            return [(r["query_id"], r["stage"], r["plan_node_id"],
+                     r["node_type"], r["table"], r["est_rows"],
+                     r["actual_rows"], float(r["ratio"]))
+                    for r in DIVERGENCE.records()]
+        if name == "query_history":
+            history = getattr(self.engine, "history", None)
+            if history is None:
+                return []
+            return [(str(r.get("query_id") or ""),
+                     str(r.get("state") or ""),
+                     str(r.get("user") or ""),
+                     str(r.get("query") or ""),
+                     int(r.get("output_rows") or 0),
+                     int(float(r.get("elapsed_ms") or 0)),
+                     float(r.get("create_time") or 0.0),
+                     str(r.get("error") or ""))
+                    for r in history.records()]
         if name == "session_properties":
             from presto_tpu.session import SYSTEM_SESSION_PROPERTIES
             return [(n, str(self.engine.session.get(n)), str(d),
@@ -144,3 +202,61 @@ class SystemConnector(_ReflectiveConnector):
                     for n, (d, t, desc) in sorted(
                         SYSTEM_SESSION_PROPERTIES.items())]
         raise KeyError(name)
+
+    def _node_rows(self) -> list[tuple]:
+        """Live cluster view: the coordinator plus every registered
+        worker's heartbeat-observed state (alive / draining / dead)
+        and active task count — wired to the same RemoteWorker state
+        `/v1/cluster` serves, instead of the old hardcoded single
+        local row (reference NodeSystemTable over the
+        InternalNodeManager)."""
+        rows = [("coordinator", "local://0", "presto-tpu", "true",
+                 "active", 0)]
+        cluster = getattr(self.engine, "_cluster_view", None)
+        if cluster is None:
+            return rows
+        for w in list(cluster.workers):
+            if not w.alive:
+                state = "dead"
+            elif w.state == "shutting_down":
+                state = "draining"
+            else:
+                state = "active"
+            rows.append((w.node_id or w.uri, w.uri, "presto-tpu",
+                         "false", state, int(w.active_tasks)))
+        return rows
+
+    def _stage_tasks(self):
+        """(query_id, stage, task dict) across every tracked query —
+        remote stages first, then the coordinator-local stage, exactly
+        the GET /v1/query/{id} tree flattened."""
+        from presto_tpu.obs.qstats import STORE
+        out = []
+        for rec in STORE.recorders():
+            snap = rec.snapshot()
+            for stage in snap["stages"]:
+                for t in stage["tasks"]:
+                    out.append((snap["queryId"], stage["stage"], t))
+        return out
+
+    def _task_rows(self) -> list[tuple]:
+        return [
+            (qid, stage, t["taskId"], t["node"], t["state"],
+             int(t["shard"]), int(t["inputRows"]),
+             int(t["outputRows"]), int(t["exchangePages"]),
+             int(t["exchangeBytes"]), int(t["spooledPages"]),
+             int(t["programs"]), int(t["compiles"]),
+             int(t["cacheHits"]), int(t["templateHits"]),
+             int(t["retries"]), int(t["compileMillis"]),
+             int(t["executeMillis"]), int(t["wallMillis"]),
+             int(t["peakMemoryBytes"]))
+            for qid, stage, t in self._stage_tasks()]
+
+    def _operator_rows(self) -> list[tuple]:
+        return [
+            (qid, stage, t["taskId"], str(op["planNodeId"]),
+             op["nodeType"], op["label"], int(op["inputRows"]),
+             int(op["outputRows"]), int(op["outputBytes"]),
+             int(op["estRows"]))
+            for qid, stage, t in self._stage_tasks()
+            for op in t["operators"]]

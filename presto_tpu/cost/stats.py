@@ -33,6 +33,36 @@ import dataclasses
 from presto_tpu.plan import nodes as N
 from presto_tpu.plan.stats import UNKNOWN_FILTER_COEFFICIENT, selectivity
 
+def predicate_shape(expr) -> str:
+    """Literal-normalized structural shape of a predicate expression
+    ("lte(l_shipdate, ?)"): the key the divergence ledger
+    (obs/qstats.py) aggregates observed selectivity under, so every
+    literal variant of one predicate shape — the plan-template notion
+    of sameness — pools into a single observation series. This is the
+    lookup key a future stats-feedback rule in this calculator will
+    consult (ROADMAP item 4); shipped observation-only."""
+    from presto_tpu.expr import ir
+
+    def walk(e) -> str:
+        if isinstance(e, (ir.Literal, ir.Parameter)):
+            return "?"
+        if isinstance(e, ir.ColumnRef):
+            return e.name
+        if isinstance(e, ir.Call):
+            return (f"{e.fn}("
+                    + ", ".join(walk(a) for a in e.args) + ")")
+        if isinstance(e, ir.Cast):
+            return f"cast({walk(e.arg)} as {e.dtype})"
+        if isinstance(e, ir.InList):
+            return f"{walk(e.arg)} in (?*{len(e.values)})"
+        if isinstance(e, ir.IsNull):
+            return f"{walk(e.arg)} is " \
+                   f"{'not ' if e.negated else ''}null"
+        return type(e).__name__.lower()
+
+    return walk(expr)
+
+
 # row count assumed for a relation with no usable connector statistics
 # (exchange carrier scans, unknown catalogs); estimates derived from it
 # are flagged non-confident

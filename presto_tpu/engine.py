@@ -84,6 +84,21 @@ class Engine:
         self._preplanned_tl = _threading.local()
         # query lifecycle events + history (events.py)
         self.events = EventListenerManager()
+        # persisted query history + divergence-ledger persistence
+        # (obs/qstats.py): finished-query profiles append to a bounded
+        # JSONL under PRESTO_TPU_HISTORY_DIR and survive restarts,
+        # backing system.query_history
+        import os as _os
+        self.history = None
+        hist_dir = _os.environ.get("PRESTO_TPU_HISTORY_DIR")
+        if hist_dir:
+            from presto_tpu.obs.qstats import DIVERGENCE, QueryHistory
+            try:
+                self.history = QueryHistory(hist_dir)
+                self.events.add_listener(self.history.on_event)
+                DIVERGENCE.attach_dir(hist_dir)
+            except OSError:
+                self.history = None  # unwritable dir: run without
         # engine-owned virtual catalogs (reference information_schema +
         # system connectors are engine-side, not plugins)
         self.catalogs["information_schema"] = \

@@ -106,33 +106,44 @@ class EventListenerManager:
 
 def monitored(engine, sql: str, run: Callable):
     """Run ``run()`` under query monitoring: emits created/completed
-    events, records history, and opens the query's root span (child
-    span when a trace — e.g. the HTTP server's — is already active).
-    Returns run()'s result."""
+    events, records history, opens the query's root span (child span
+    when a trace — e.g. the HTTP server's — is already active), and
+    opens the query's runtime-stats scope (obs/qstats.py; reused when
+    the HTTP layer already opened one under the protocol query id, so
+    the stats id and the trace id coincide). The completed event fires
+    INSIDE the stats scope: history listeners snapshot the finished
+    tree off the ambient recorder. Returns run()'s result."""
+    from presto_tpu.obs import qstats as QS
+
     mgr: EventListenerManager = engine.events
     qid = mgr.next_query_id()
     t0 = time.time()
     mgr.query_created(QueryCreatedEvent(qid, sql, engine.session.user, t0))
-    with TRACER.root_or_span(qid, "query", query_id=qid,
-                             user=engine.session.user,
-                             sql=sql[:200]) as sp:
+    with QS.query_or_current(qid, sql, engine.session.user) as qr, \
+            TRACER.root_or_span(qid, "query", query_id=qid,
+                                user=engine.session.user,
+                                sql=sql[:200]) as sp:
         try:
             result = run()
         except Exception as exc:
             if sp is not None:
                 sp.attrs["error"] = f"{type(exc).__name__}: {exc}"
+            qr.state = "FAILED"
+            qr.error = f"{type(exc).__name__}: {exc}"[:300]
             mgr.query_completed(QueryCompletedEvent(
                 qid, sql, engine.session.user, "FAILED", t0, time.time(),
                 0, error=f"{type(exc).__name__}: {exc}"))
             raise
-    if isinstance(result, list):
-        rows = len(result)
-    else:
-        mask = getattr(result, "mask", None)
-        if mask is not None:
-            rows = int(np.asarray(mask).sum())
+        if isinstance(result, list):
+            rows = len(result)
         else:
-            rows = getattr(result, "nrows", 0)
-    mgr.query_completed(QueryCompletedEvent(
-        qid, sql, engine.session.user, "FINISHED", t0, time.time(), rows))
+            mask = getattr(result, "mask", None)
+            if mask is not None:
+                rows = int(np.asarray(mask).sum())
+            else:
+                rows = getattr(result, "nrows", 0)
+        qr.output_rows = rows
+        mgr.query_completed(QueryCompletedEvent(
+            qid, sql, engine.session.user, "FINISHED", t0, time.time(),
+            rows))
     return result

@@ -167,6 +167,13 @@ class PlanInterpreter:
         self.ok_flags: list = []
         self.ok_keys: list[tuple] = []
         self.used_capacity: dict[tuple, int] = {}
+        # always-on runtime stats (obs/qstats.py): live rows out of
+        # EVERY plan node, keyed by stable preorder position so the
+        # counts survive replans and ride program-cache entries across
+        # process restarts. Collected on the normal cached/templated
+        # path — a handful of mask sums per program, no extra compiles.
+        self.collect_rows = True
+        self.row_counts: list[tuple[object, object]] = []
         # dynamic filtering: probe-key symbol -> (min, max) from the
         # already-traced build side; applied at the FIRST probe-subtree
         # node that outputs the symbol (i.e. the scan), the trace-time
@@ -181,6 +188,10 @@ class PlanInterpreter:
         dt = m(node)
         if self.dyn_filters:
             dt = self._apply_dyn_filters(dt)
+        if self.collect_rows:
+            self.row_counts.append(
+                (self.node_order.get(id(node), id(node)),
+                 jnp.sum(dt.live_mask().astype(jnp.int64))))
         return dt
 
     def _apply_dyn_filters(self, dt: DTable) -> DTable:
@@ -403,16 +414,24 @@ class PlanInterpreter:
 
 def make_traced(scan_inputs: list[ScanInput], plan: N.PlanNode,
                 capacities: dict[int, int], session=None,
-                interp_factory=None, params: list | None = None):
+                interp_factory=None, params: list | None = None,
+                collect_rows: bool = True):
     """Build (traced_fn, flat_example_args, meta). ``traced_fn`` is a pure
     jittable function from flat scan arrays to
-    (result columns, live mask, ok flags); ``meta`` is populated at trace
-    time with output schema and hash-capacity bookkeeping.
+    (result columns, live mask, ok flags, per-node row counts); ``meta``
+    is populated at trace time with output schema and hash-capacity
+    bookkeeping.
 
-    ``interp_factory`` substitutes a PlanInterpreter subclass; when the
-    interpreter records ``row_counts`` (EXPLAIN ANALYZE's
-    ProfilingInterpreter) the traced function returns them as a fourth
-    output and ``meta["count_nodes"]`` lists the node ids.
+    ``collect_rows`` (default on — the always-on stats tree): the
+    interpreter sums every node's live mask and the traced function
+    returns the counts stacked as ONE extra int array (one host
+    transfer for the whole plan, same trick as the ok flags), with
+    ``meta["count_nodes"]`` listing the stable preorder node positions.
+    ``collect_rows=False`` keeps the legacy 3-output contract for
+    callers that replay one program over many partitions (spill,
+    block streaming) where per-node totals would be misattributed.
+
+    ``interp_factory`` substitutes a PlanInterpreter subclass.
 
     ``params`` (plan templates): example physical values of the plan's
     hoisted-literal parameter vector. The traced function then takes
@@ -433,6 +452,7 @@ def make_traced(scan_inputs: list[ScanInput], plan: N.PlanNode,
             scans[id(scan.node)] = (scan, traced)
         interp = (interp_factory or PlanInterpreter)(
             scans, capacities, session, node_order)
+        interp.collect_rows = collect_rows
         if params is not None:
             from presto_tpu.templates import runtime as TR
             tp = TR.TraceParams(list(it))
@@ -461,11 +481,12 @@ def make_traced(scan_inputs: list[ScanInput], plan: N.PlanNode,
         # tunneled device), a (k,) bool array costs one total
         oks = (jnp.stack(interp.ok_flags) if interp.ok_flags
                else jnp.zeros((0,), dtype=bool))
-        row_counts = getattr(interp, "row_counts", None)
-        if row_counts is not None:
-            meta["count_nodes"] = [nid for nid, _ in row_counts]
+        if interp.row_counts:
+            # stacked like the ok flags: one (k,) array costs one host
+            # round-trip for the whole plan's actuals
+            meta["count_nodes"] = [key for key, _ in interp.row_counts]
             return (tuple(res), out.live_mask(), oks,
-                    tuple(c for _, c in row_counts))
+                    jnp.stack([c for _, c in interp.row_counts]))
         return tuple(res), out.live_mask(), oks
 
     return traced_fn, flat_arrays, meta
@@ -535,8 +556,13 @@ def _cache_key(engine, plan, scan_inputs, capacities):
 
 def prepare_plan(engine, plan: N.PlanNode, scan_inputs: list[ScanInput]):
     """Resolve hash-table capacities and return
-    (compiled, flat_arrays, meta, (res, live, oks)) for a plan, reusing
-    the engine's compiled-program cache.
+    (compiled, flat_arrays, meta, (res, live, oks, counts)) for a
+    plan, reusing the engine's compiled-program cache. ``counts`` is
+    the stacked per-node live-row array every program now returns
+    (``meta["count_nodes"]`` aligns it with stable preorder
+    positions) — the raw material of the always-on runtime stats tree
+    (obs/qstats.py), recorded here so EVERY execution path (segments,
+    workers, warm cache hits, template hits) feeds the same tree.
 
     The cache is the analog of the reference's compiled-artifact caches
     (gen/PageFunctionCompiler.java:101): programs key on
@@ -563,9 +589,14 @@ def prepare_plan(engine, plan: N.PlanNode, scan_inputs: list[ScanInput]):
     cache hit: zero compiles."""
     from presto_tpu import templates as TPL
     from presto_tpu.exec import progcache as PC
+    from presto_tpu.obs import qstats as QS
     fpr = PC.platform_fingerprint()
     cache = engine._program_cache
     cache.configure(engine.session)
+    # the pre-template plan, literals intact: the stats recorder
+    # estimates rows on it (the CBO cannot cost Parameter leaves); the
+    # tree shape is identical so preorder positions line up
+    orig_plan = plan
     tpl = None
     if TPL.enabled(engine.session):
         scan_inputs = TPL.bucket_scans(engine, scan_inputs)
@@ -608,6 +639,7 @@ def prepare_plan(engine, plan: N.PlanNode, scan_inputs: list[ScanInput]):
                 compiled = jax.jit(traced_fn).lower(
                     *flat_arrays, *pargs).compile()
             compile_s = time.perf_counter() - _t0
+            last_compile_s = compile_s
             _COMPILES.inc()
             _COMPILE_SECONDS.observe(compile_s)
             if os.environ.get("PRESTO_TPU_LOG_COMPILES"):
@@ -623,24 +655,41 @@ def prepare_plan(engine, plan: N.PlanNode, scan_inputs: list[ScanInput]):
         else:
             compiled, meta = entry
             cache_hit = True
+            last_compile_s = 0.0
         if tpl is not None:
             # bind THIS query's literal values (string parameters
             # resolve through the dictionaries the trace recorded —
             # carried in meta, so disk-tier hits bind too)
             pargs = tpl.bind(meta.get("param_bindings"))
+        _t1 = time.perf_counter()
         with TRACER.span("execute", cache_hit=cache_hit):
-            res, live, oks = compiled(*flat_arrays, *pargs)
+            outs = compiled(*flat_arrays, *pargs)
+            # stale-format disk entries cannot reach here (the program
+            # format version rides the platform fingerprint), but a
+            # defensive unpack keeps a 3-output program non-fatal
+            if len(outs) == 4:
+                res, live, oks, counts = outs
+            else:
+                (res, live, oks), counts = outs, None
             # ONE host sync for every flag — also the point the async
             # dispatch actually finishes, so the span covers real
             # device time, not just call overhead
             oks_np = np.asarray(oks)
+        execute_s = time.perf_counter() - _t1
         if oks_np.all():
             if not cache_hit:
                 cache.insert((base_key, caps_key), compiled, meta, fpr)
             if engine._caps_memory.get(base_key) != capacities:
                 cache.store_caps(base_key, capacities, fpr)
             engine._caps_memory[base_key] = dict(capacities)
-            return compiled, flat_arrays, meta, (res, live, oks)
+            # fold this program into the ambient stats tree (no-op
+            # outside a task/query recording scope)
+            QS.record_program(
+                engine, orig_plan, meta, counts, last_compile_s,
+                execute_s, cache_hit, template=tpl is not None,
+                template_hit=tpl is not None and cache_hit)
+            return compiled, flat_arrays, meta, (res, live, oks,
+                                                 counts)
         if not cache_hit:
             # a failed rung's program is dead weight in the bounded
             # LRU: future runs jump straight to the successful caps
@@ -844,7 +893,7 @@ def run_plan_device(engine, plan: N.PlanNode,
     """Like run_plan but keeps results as DEVICE arrays (segment
     handoff); see device_outputs. Returns (arrays, dicts, types, n,
     per-node rows=None) — the runner contract of _segment_carriers."""
-    _c, _f, meta, (res, live, _oks) = prepare_plan(
+    _c, _f, meta, (res, live, _oks, _counts) = prepare_plan(
         engine, plan, scan_inputs)
     return device_outputs(meta, res, live, cap_floor) + (None,)
 
@@ -950,17 +999,23 @@ def _segment_carriers(engine, plan: N.PlanNode, pool_tag: str,
         # property overrides), and the trace context (spans otherwise
         # vanish for every parallel-compiled segment)
         from presto_tpu.exec import cancel as _cancel
+        from presto_tpu.obs import qstats as _qs
         from presto_tpu.obs import trace as _ot
         from presto_tpu.session import (current_override,
                                         install_override)
         _tok = _cancel.current()
         _ov = current_override()
         _ctx = _ot.current_context()
+        _task_rec = _qs.current_task()
 
         def _materialize(item):
             idx, mat = item
             _cancel.install(_tok)
             install_override(_ov)
+            # the ambient stats recorder rides along too: segment
+            # programs compiled on pool threads must land in the same
+            # task's operator list
+            _qs.install_task(_task_rec)
             scans = _collect_with_carriers(mat, engine, carriers)
             _t0 = time.perf_counter()
             with TRACER.attach(_ctx), \
@@ -1127,7 +1182,7 @@ def run_plan_live(engine, plan: N.PlanNode):
     try:
         plan, carriers = _segment_carriers(engine, plan, tag)
         scans = _collect_with_carriers(plan, engine, carriers)
-        _c, _f, _meta, (_res, live, _oks) = prepare_plan(
+        _c, _f, _meta, (_res, live, _oks, _counts) = prepare_plan(
             engine, plan, scans)
         return live
     finally:
@@ -1185,8 +1240,8 @@ def run_plan(engine, plan: N.PlanNode,
             if isinstance(a, np.ndarray)),
             block_s=block_s, kill_after_s=kill_s, owner=owner)
     try:
-        _compiled, _flat, meta, (res, live, _oks) = prepare_plan(
-            engine, plan, scan_inputs)
+        _compiled, _flat, meta, (res, live, _oks, _counts) = \
+            prepare_plan(engine, plan, scan_inputs)
         if pool is not None:
             # device-side shape math only — no transfer
             pool.reserve(tag, sum(int(r.nbytes) for r in res),

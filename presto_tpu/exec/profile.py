@@ -25,36 +25,37 @@ import jax.numpy as jnp
 import numpy as np
 
 from presto_tpu.cost import row_estimates
-from presto_tpu.exec.executor import (PlanInterpreter, collect_scans,
-                                      device_outputs, make_traced)
+from presto_tpu.exec.executor import (collect_scans, device_outputs,
+                                      make_traced, preorder_index)
 from presto_tpu.obs.trace import TRACER
 from presto_tpu.plan import nodes as N
 from presto_tpu.plan.printer import format_plan
 
 
-class ProfilingInterpreter(PlanInterpreter):
-    def __init__(self, scans, capacities, session=None,
-                 node_order=None):
-        super().__init__(scans, capacities, session, node_order)
-        self.row_counts: list[tuple[int, object]] = []
-
-    def run(self, node: N.PlanNode):
-        dt = super().run(node)
-        self.row_counts.append(
-            (id(node), jnp.sum(dt.live_mask().astype(jnp.int64))))
-        return dt
+def _rows_by_node_id(plan, meta, counts) -> dict[int, int]:
+    """Per-node actual rows keyed by id(node) of THIS plan's objects.
+    ``meta["count_nodes"]`` keys are stable preorder positions (they
+    ride program-cache entries across replans and restarts); EXPLAIN
+    ANALYZE's printer annotations key by object id, so invert the
+    preorder walk."""
+    inv = {pos: nid for nid, pos in preorder_index(plan).items()}
+    counts_np = np.asarray(counts)
+    return {inv.get(key, key): int(c)
+            for key, c in zip(meta["count_nodes"], counts_np)}
 
 
 def _profiled_compile_run(engine, plan, scans):
-    """Shared EXPLAIN ANALYZE ladder: trace under a
-    ProfilingInterpreter, compile OUTSIDE the program cache (the extra
-    row-count outputs must not shadow production entries), and retry
-    on hash-table overflow. The capacity vector is SEEDED from what
-    prepare_plan already learned for this plan (memory or the caps
-    sidecar), so profiling does not replay the overflow ladder with an
-    extra 80-150 s compile per rung. Returns
-    (meta, res, live, counts, compile_s, run_s) of the successful
-    attempt."""
+    """Shared EXPLAIN ANALYZE ladder: trace, compile OUTSIDE the
+    program cache (so the profile's compile/execute walls are really
+    measured, not amortized over prior queries), and retry on
+    hash-table overflow. Per-node actual rows need no special
+    interpreter anymore — every traced program carries them
+    (PlanInterpreter.row_counts, the always-on stats contract). The
+    capacity vector is SEEDED from what prepare_plan already learned
+    for this plan (memory or the caps sidecar), so profiling does not
+    replay the overflow ladder with an extra 80-150 s compile per
+    rung. Returns (meta, res, live, counts, compile_s, run_s) of the
+    successful attempt."""
     from presto_tpu import templates as TPL
     from presto_tpu.exec import executor as EX
     from presto_tpu.exec import progcache as PC
@@ -76,8 +77,7 @@ def _profiled_compile_run(engine, plan, scans):
     capacities: dict[tuple, int] = dict(known)
     for _attempt in range(10):
         traced_fn, flat, meta = make_traced(
-            scans, plan, capacities, engine.session,
-            interp_factory=ProfilingInterpreter)
+            scans, plan, capacities, engine.session)
         t0 = time.perf_counter()
         with TRACER.span("compile", analyze=True):
             compiled = jax.jit(traced_fn).lower(*flat).compile()
@@ -102,8 +102,7 @@ def _profiled_runner(engine, mat, scans, cap_floor=None):
     widths consistent with the production (templated) pipeline."""
     meta, res, live, counts, _c, _r = _profiled_compile_run(
         engine, mat, scans)
-    node_rows = {nid: int(np.asarray(c))
-                 for nid, c in zip(meta["count_nodes"], counts)}
+    node_rows = _rows_by_node_id(mat, meta, counts)
     return device_outputs(meta, res, live, cap_floor) + (node_rows,)
 
 
@@ -176,8 +175,7 @@ def _explain_one_program(engine, plan: N.PlanNode,
     # estimated-vs-actual rows per node: estimation bugs show up in
     # one place (reference PlanPrinter's EXPLAIN ANALYZE estimate
     # columns)
-    for nid, c in zip(meta["count_nodes"], counts):
-        actual = int(np.asarray(c))
+    for nid, actual in _rows_by_node_id(plan, meta, counts).items():
         est = estimated.get(nid)
         annotations[nid] = (f"rows: {actual}" if est is None
                             else f"rows: {actual} (est {est})")
@@ -195,10 +193,16 @@ def explain_analyze_distributed(engine, plan: N.PlanNode, mesh) -> str:
     profile: dict = {}
     execute_plan_distributed(engine, plan, mesh, profile=profile)
     estimated = row_estimates(plan, engine)
-    annotations = {
-        nid: (f"rows: {rows} [{dist}]" if estimated.get(nid) is None
-              else f"rows: {rows} (est {estimated[nid]}) [{dist}]")
-        for nid, (rows, dist) in profile["node_rows"].items()}
+    # profile["node_rows"] keys are stable preorder positions (the
+    # program-cache-stable stats keys); the printer wants object ids
+    inv = {pos: nid for nid, pos in preorder_index(plan).items()}
+    annotations = {}
+    for pos, (rows, dist) in profile["node_rows"].items():
+        nid = inv.get(pos, pos)
+        est = estimated.get(nid)
+        annotations[nid] = (
+            f"rows: {rows} [{dist}]" if est is None
+            else f"rows: {rows} (est {est}) [{dist}]")
     header = (f"Distributed plan over {mesh.devices.size} devices "
               f"(compile {profile['compile_s'] * 1e3:.1f} ms, "
               f"execute {profile['run_s'] * 1e3:.1f} ms)\n")

@@ -184,18 +184,27 @@ def scan_dictionary_key(scan_inputs) -> tuple:
         for sym, d in scan.dictionaries.items() if d is not None)
 
 
+# traced-program output-format version: participates in the platform
+# fingerprint so persisted entries from an engine with a different
+# output contract (e.g. before the always-on per-node row counts
+# became a fourth program output) miss instead of mis-unpacking
+PROGRAM_FORMAT = "rows1"
+
+
 @functools.lru_cache(maxsize=32)
 def platform_fingerprint(mesh_shape: tuple | None = None) -> tuple:
     """What a serialized executable is only valid for: jax/jaxlib
-    versions, backend kind, device kind and count, x64 mode, and (for
-    shard_map programs) the mesh shape."""
+    versions, backend kind, device kind and count, x64 mode, the
+    engine's traced-program output format, and (for shard_map
+    programs) the mesh shape."""
     import jax
     import jaxlib
     devs = jax.devices()
     return (jax.__version__, jaxlib.__version__,
             jax.default_backend(), len(devs),
             getattr(devs[0], "device_kind", "?"),
-            bool(jax.config.jax_enable_x64), mesh_shape)
+            bool(jax.config.jax_enable_x64), PROGRAM_FORMAT,
+            mesh_shape)
 
 
 def entry_digest(key, fingerprint) -> str:

@@ -156,8 +156,11 @@ def _run_partitions(engine, jp: N.Join, part_inputs: list) -> list[Table]:
     capacities: dict[tuple, int] = {}
     for _attempt in range(10):
         pinput0, binput0 = part_inputs[0]
+        # collect_rows off: one program replays over many partitions,
+        # so per-node totals would misattribute to the first partition
         traced_fn, _flat, meta = make_traced(
-            [pinput0, binput0], jp, capacities, engine.session)
+            [pinput0, binput0], jp, capacities, engine.session,
+            collect_rows=False)
         compiled = jax.jit(traced_fn)
         from presto_tpu.exec.cancel import checkpoint
         results = []
@@ -272,8 +275,10 @@ def _run_partition_plans(engine, root: N.PlanNode,
     capacities: dict[tuple, int] = {}
     for _attempt in range(10):
         inputs0 = part_inputs[0]
+        # collect_rows off: see _run_partitions (per-partition replay)
         traced_fn, _flat, meta = make_traced(
-            list(inputs0), root, capacities, engine.session)
+            list(inputs0), root, capacities, engine.session,
+            collect_rows=False)
         compiled = jax.jit(traced_fn)
         results = []
         overflow = False

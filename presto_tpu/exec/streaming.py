@@ -121,8 +121,12 @@ def try_execute_streamed(engine, plan: N.PlanNode):
                 block_scan = ScanInput(scan.node, arrays,
                                        scan.dictionaries, scan.types,
                                        block)
+                # collect_rows off: the block program replays per
+                # block; run_plan over the concatenated partials (the
+                # final program) still records its stats normally
                 traced_fn, _flat, meta = make_traced(
-                    [block_scan], partial, capacities, engine.session)
+                    [block_scan], partial, capacities, engine.session,
+                    collect_rows=False)
                 compiled = jax.jit(traced_fn)
             res, live, oks = compiled(
                 *[arrays[sym] for sym in scan.arrays], arrays["__live__"])

@@ -22,6 +22,10 @@ pipeline, sql/planner/sanity/PlanSanityChecker.java):
   ``urlopen``/``_urlopen`` call site must pass an explicit
   ``timeout=`` — an internal HTTP call without a deadline turns one
   dead peer into a hung thread the failure detector cannot see.
+- **span discipline** (``lint/spans.py``): every ``obs.trace`` span
+  must be opened via ``with`` (or ``ExitStack.enter_context``) — a
+  hand-entered span leaks both an unfinished span and the ambient
+  trace context on any exception before close.
 
 Run ``python -m presto_tpu.lint presto_tpu/`` (exits nonzero on
 findings); suppress a single line with ``# lint: disable=rule-name``
@@ -38,5 +42,6 @@ from presto_tpu.lint import dispatch as _dispatch  # noqa: E402,F401
 from presto_tpu.lint import metrics as _metrics  # noqa: E402,F401
 from presto_tpu.lint import timeouts as _timeouts  # noqa: E402,F401
 from presto_tpu.lint import pools as _pools  # noqa: E402,F401
+from presto_tpu.lint import spans as _spans  # noqa: E402,F401
 
 __all__ = ["Finding", "Project", "available_rules", "run_lint"]

@@ -289,6 +289,13 @@ class MemoryPool:
             "(memory.MemoryPool)").inc(pool=self.name)
         LOG.log("memory_killed", pool=self.name, victim=victim,
                 held_bytes=self.by_tag.get(victim, 0), reason=reason)
+        # query-pool victims are tagged by protocol query id == trace
+        # id: mark the kill on that query's timeline (create=False —
+        # operator-pool tags are uuids, which must not spawn junk
+        # traces)
+        from presto_tpu.obs.trace import TRACER
+        TRACER.instant_for(victim, "low-memory-kill", pool=self.name,
+                           held_bytes=self.by_tag.get(victim, 0))
         exc = MemoryKilledError(
             f"query {victim} killed by the low-memory killer "
             f"({self.by_tag.get(victim, 0)} bytes held, the largest "

@@ -15,6 +15,13 @@ OpenTelemetry spans threaded through task RPC in later Trino):
   (``GET /v1/query/{id}/trace``).
 - ``obs.jsonlog``  — opt-in structured JSON line logging
   (``PRESTO_TPU_LOG=stderr|stdout|<path>``), trace-id stamped.
+- ``obs.qstats``   — always-on Query->Stage->Task->Operator runtime
+  statistics tree collected on the normal cached/templated execution
+  path, the on-disk query history (``PRESTO_TPU_HISTORY_DIR``), and
+  the estimated-vs-actual divergence ledger backing
+  ``system.plan_divergence``.
+- ``obs.procstats`` — process self-telemetry gauges (RSS, threads,
+  uptime) refreshed at ``/metrics`` scrape time on both server roles.
 """
 
 from presto_tpu.obs.metrics import (MetricError, MetricsRegistry,

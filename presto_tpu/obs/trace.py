@@ -176,6 +176,29 @@ class Tracer:
             with self.span(name, **attrs) as s:
                 yield s
 
+    def instant_for(self, trace_id: str, name: str,
+                    create: bool = False, **attrs) -> None:
+        """Zero-duration marker recorded into an EXPLICIT trace.
+        Governance events happen on threads with no ambient trace
+        context — the reaper sweep, the low-memory killer, 429/503
+        shed decisions — yet belong on the query's timeline; the query
+        id IS the trace id, so they can address it directly. With
+        ``create`` False the marker only lands on traces that already
+        exist (the memory killer's victim tag is a query id only for
+        the query-level pool); True records unconditionally (a shed
+        query's trace may consist of nothing but its shed marker)."""
+        with self._lock:
+            exists = trace_id in self._traces
+        if not exists and not create:
+            return
+        attrs = dict(attrs)
+        attrs["instant"] = True
+        if "node" not in attrs and _NODE.get() is not None:
+            attrs["node"] = _NODE.get()
+        now = time.time()
+        self._record(Span(trace_id, _new_span_id(), None, name, attrs,
+                          now, now))
+
     def add_span(self, name: str, t0: float, t1: float,
                  **attrs) -> None:
         """Record an already-finished interval under the ambient
@@ -245,6 +268,16 @@ class Tracer:
             args["span_id"] = s.span_id
             if s.parent_id is not None:
                 args["parent_id"] = s.parent_id
+            if s.attrs.get("instant"):
+                # governance markers (reaper/low-memory kills, shed
+                # decisions) render as global instant events so the
+                # incident is visible ON the timeline, not just in
+                # counters
+                events.append({
+                    "name": s.name, "cat": "query", "ph": "i",
+                    "s": "g", "ts": int(s.t0 * 1e6),
+                    "pid": pid, "tid": 0, "args": args})
+                continue
             if s.t1 is None:
                 args["in_progress"] = True
             events.append({

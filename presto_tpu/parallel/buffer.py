@@ -73,6 +73,11 @@ class OutputBuffer:
         """Append one page; blocks while the buffer is over capacity
         (backpressure). Raises TaskFailed if the buffer was aborted or
         no consumer made progress for IDLE_ABORT_S."""
+        # per-task page accounting (obs/qstats.py): the producer
+        # thread IS the task thread, so the ambient recorder
+        # attributes emitted (and spooled) pages to this task
+        from presto_tpu.obs import qstats as QS
+        QS.note_emitted_page(len(blob), spooled=self.spool is not None)
         if self.spool is not None:
             # durable copy first: a producer dying between spool and
             # buffer leaves a retryable page, never a phantom one

@@ -35,6 +35,7 @@ from presto_tpu import types as T
 from presto_tpu.exec import cancel as CANCEL
 from presto_tpu.ft import retry as FTR
 from presto_tpu.ft.faults import FAULTS
+from presto_tpu.obs import qstats as QS
 from presto_tpu.obs import trace as OT
 from presto_tpu.obs.metrics import REGISTRY
 from presto_tpu.plan import nodes as N
@@ -67,6 +68,10 @@ class RemoteWorker:
                               else _auth.default_secret())
         self.failure_ratio = 0.0  # exponential decay of ping failures
         self.state = "active"  # last lifecycle state seen by ping()
+        # live-node view captured by ping() for system.nodes: the
+        # worker's self-reported id and running/admitted task count
+        self.node_id: str | None = None
+        self.active_tasks = 0
         self.lock = threading.Lock()
 
     def _auth_headers(self) -> dict:
@@ -154,6 +159,23 @@ class RemoteWorker:
                 raise TaskError(out["error"])
             return out
 
+    def fetch_task_stats(self, prefix: str,
+                         timeout: float = 5.0) -> list[dict]:
+        """TaskStats snapshots for every task on this worker whose id
+        starts with ``prefix`` (one GET per worker assembles a whole
+        query's StageStats). Best-effort: stats collection must never
+        fail or stall a query."""
+        req = urllib.request.Request(
+            f"{self.uri}/v1/task/{prefix}/stats",
+            headers=self._auth_headers())
+        try:
+            with _urlopen(req, timeout=timeout) as resp:
+                out = json.loads(resp.read())
+            tasks = out.get("tasks")
+            return tasks if isinstance(tasks, list) else []
+        except Exception:  # noqa: BLE001 - best-effort observability
+            return []
+
     def delete_task(self, prefix: str, timeout: float = 10.0) -> None:
         req = urllib.request.Request(
             f"{self.uri}/v1/task/{prefix}", method="DELETE",
@@ -175,11 +197,18 @@ class RemoteWorker:
         try:
             with _urlopen(urllib.request.Request(
                     f"{self.uri}/v1/status"), timeout=timeout) as resp:
-                st = str(json.loads(resp.read()).get("state") or "")
+                payload = json.loads(resp.read())
+                st = str(payload.get("state") or "")
         except Exception:  # noqa: BLE001 - any failure counts
             return False
         with self.lock:
             self.state = st
+            self.node_id = str(payload.get("nodeId")
+                               or self.node_id or "")
+            try:
+                self.active_tasks = int(payload.get("activeTasks") or 0)
+            except (TypeError, ValueError):
+                self.active_tasks = 0
         return st in ("active", "shutting_down")
 
 
@@ -242,6 +271,10 @@ class ClusterCoordinator:
             self.workers, heartbeat_interval_s,
             ping_timeout=self._ping_timeout)
         self.last_distribution: dict | None = None
+        # live cluster view for the engine's system.nodes table
+        # (connectors/information_schema.py reads worker uri/state/
+        # active-task counts off this handle)
+        engine._cluster_view = self
 
     def add_worker(self, uri: str) -> None:
         self.workers.append(RemoteWorker(uri))
@@ -389,6 +422,7 @@ class ClusterCoordinator:
                     0, int(session.get("query_retry_attempts")))
                 delays = FTR.backoff_from_session(session,
                                                   max_retries)
+                qr = QS.current_query()
                 ws = workers
                 retries = 0
                 while True:
@@ -414,6 +448,8 @@ class ClusterCoordinator:
                                 and (shrank or transient) \
                                 and not deadline.expired:
                             _QUERY_RETRIES.inc()
+                            if qr is not None:
+                                qr.note_query_retry()
                             delay = delays.delay_s(retries)
                             with OT.TRACER.span(
                                     "query-retry", attempt=retries,
@@ -458,7 +494,8 @@ class ClusterCoordinator:
             return local("no workers" if not workers
                          else "plan shape not distributable")
         agg, _scan = found
-        return self._execute_partial_fragments(plan, agg, workers)
+        return self._execute_partial_fragments(plan, agg, workers,
+                                               query_id=query_id)
 
     def _run_stage(self, workers: list[RemoteWorker],
                    payloads: list[dict]) -> list:
@@ -496,6 +533,48 @@ class ClusterCoordinator:
         with ThreadPoolExecutor(max_workers=len(workers)) as pool:
             return list(pool.map(run_one, range(len(workers))))
 
+    def _collect_stage_stats(self, workers: list[RemoteWorker],
+                             qid: str,
+                             sources_of: dict | None = None) -> None:
+        """Pull every worker's TaskStats for this query (one GET per
+        worker, best-effort) and register the rolled-up StageStats on
+        the ambient QueryRecorder — the coordinator-side assembly of
+        the Query->Stage->Task->Operator tree (reference
+        SqlQueryExecution's stage-info rollup). Runs BEFORE the
+        cleanup DELETE fan-out (which clears worker-side stats) and
+        never raises. The GETs fan out in parallel under ONE short
+        bound and skip dead nodes: a crashed worker is exactly the
+        failure-path case this runs on, and it must not stall query
+        completion by a connect timeout per node (same reasoning as
+        cancel_query's parallel DELETE fan-out)."""
+        qr = QS.current_query()
+        if qr is None:
+            return
+        try:
+            tasks: list[dict] = []
+            lock = threading.Lock()
+
+            def fetch(w: RemoteWorker) -> None:
+                got = w.fetch_task_stats(qid, timeout=3.0)
+                with lock:
+                    tasks.extend(got)
+
+            threads = [
+                threading.Thread(target=fetch, args=(w,), daemon=True,
+                                 name="presto-tpu-stats-fetch")
+                for w in workers if w.alive]
+            for t in threads:
+                t.start()
+            deadline = time.monotonic() + 3.0
+            for t in threads:
+                t.join(timeout=max(0.0, deadline - time.monotonic()))
+            with lock:
+                got_all = list(tasks)
+            if got_all:
+                qr.add_stages(QS.build_stages(got_all, sources_of))
+        except Exception:  # noqa: BLE001 - stats never fail the query
+            pass
+
     def _finish_with_partials(self, plan, agg, boundary,
                               buffers: list[bytes], meta: dict):
         """Coordinator completion: concatenate worker partial-aggregate
@@ -512,6 +591,10 @@ class ClusterCoordinator:
         parts = [bytes_to_columns(b) for b in buffers]
         cols = concat_columns([p[0] for p in parts])
         total = sum(p[1] for p in parts)
+        # coordinator-stage input accounting: the stats tree's final
+        # conservation link (last worker stage's output rows == the
+        # coordinator's gathered partial rows)
+        QS.add_input_rows("__partials__", total)
         if agg is not None:
             ctypes = DC.replace(agg,
                                 step=N.AggStep.PARTIAL).output_types()
@@ -538,12 +621,14 @@ class ClusterCoordinator:
         self.last_distribution = {**meta, "partial_rows": total}
         return run_plan(self.engine, plan2, [carrier_input])
 
-    def _execute_partial_fragments(self, plan, agg, workers):
+    def _execute_partial_fragments(self, plan, agg, workers,
+                                   query_id: str | None = None):
         """Scan->aggregate plans ship the PARTIAL fragment (serialized
         plan IR, not SQL — the worker no longer re-plans) as one split
         per worker with binary columnar results; failed splits fail
         over to survivors (elastic recovery)."""
         import dataclasses as DC
+        import uuid
 
         from presto_tpu.exec.executor import ScanInput, run_plan
         from presto_tpu.exec.streaming import _replace_node
@@ -556,9 +641,16 @@ class ClusterCoordinator:
         types = partial.output_types()
         nshards = len(workers)
         frag = fragment_to_dict(partial)
-        payloads = [{"fragment": frag, "shard": i, "nshards": nshards}
+        # task ids exist purely so worker TaskStats attribute to this
+        # query (binary inline results carry no stats payload)
+        qid = query_id or uuid.uuid4().hex[:8]
+        payloads = [{"fragment": frag, "shard": i, "nshards": nshards,
+                     "task_id": f"{qid}.partial.{i}"}
                     for i in range(nshards)]
-        results = self._dispatch_splits(payloads, workers)
+        try:
+            results = self._dispatch_splits(payloads, workers)
+        finally:
+            self._collect_stage_stats(workers, qid, {})
 
         parts = [bytes_to_columns(b) for b in results]
         cols = concat_columns([p[0] for p in parts])
@@ -606,6 +698,10 @@ class ClusterCoordinator:
         nparts_of: dict[str, int] = {}
         readers_of = g.consumer_readers(W)
 
+        sources_of = {
+            st.name: {t: {"stage": p, "mode": m}
+                      for t, (p, m) in st.sources.items()}
+            for st in g.stages}
         try:
             inline: list | None = None
             for st in g.stages:
@@ -666,6 +762,7 @@ class ClusterCoordinator:
                 {"nshards": W, "mode": "fragments",
                  "stages": len(g.stages)})
         finally:
+            self._collect_stage_stats(workers, qid, sources_of)
             for w in workers:
                 try:
                     w.delete_task(qid)
@@ -714,6 +811,7 @@ class ClusterCoordinator:
         # dispatch pool threads inherit neither contextvars nor the
         # thread-local cancel token; capture it for their checkpoints
         tok = CANCEL.current()
+        qr = QS.current_query()  # retry accounting from pool threads
 
         readers_of = g.consumer_readers(W)
         stage_by_name = {st.name: st for st in g.stages}
@@ -849,6 +947,8 @@ class ClusterCoordinator:
                         f"{n + 1} attempts: {err}")
                 deadline.check(f"task {st.name}.{shard}")
                 _TASK_RETRIES.inc()
+                if qr is not None:
+                    qr.note_task_retry()
                 with state_lock:
                     retries[0] += 1
                 delay = task_backoff.delay_s(n)
@@ -859,6 +959,10 @@ class ClusterCoordinator:
                               f"{str(err)[:200]}"):
                     time.sleep(delay)
 
+        sources_of = {
+            st.name: {t: {"stage": p, "mode": m}
+                      for t, (p, m) in st.sources.items()}
+            for st in g.stages}
         try:
             inline: list | None = None
             for st in g.stages:
@@ -883,6 +987,7 @@ class ClusterCoordinator:
                  "stages": len(g.stages), "retry_policy": "TASK",
                  "task_retries": task_retries})
         finally:
+            self._collect_stage_stats(workers, qid, sources_of)
             for w in workers:
                 try:
                     w.delete_task(qid)
@@ -978,6 +1083,11 @@ class ClusterCoordinator:
                  "stages": len(fragged.scan_stages)
                  + len(fragged.join_stages)})
         finally:
+            self._collect_stage_stats(workers, qid, {
+                js.name: {
+                    "probe": {"stage": js.probe_name, "mode": "part"},
+                    "build": {"stage": js.build_name, "mode": "part"}}
+                for js in fragged.join_stages})
             for w in workers:
                 try:
                     w.delete_task(qid)
@@ -995,6 +1105,7 @@ class ClusterCoordinator:
         timeout = self._task_timeout()
         failover = self._retry_policy() != "NONE"
         tok = CANCEL.current()  # nor the cancel token
+        qr = QS.current_query()  # nor the stats recorder
 
         def run_one(i: int) -> dict:
             if tok is not None:
@@ -1012,6 +1123,8 @@ class ClusterCoordinator:
                 tried += 1
                 if tried > 1:
                     _TASK_RETRIES.inc()
+                    if qr is not None:
+                        qr.note_task_retry()
                 try:
                     with OT.TRACER.attach(ctx):
                         out = w.post_task_any(payloads[i],

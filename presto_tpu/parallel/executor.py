@@ -117,10 +117,13 @@ class ShardedInterpreter:
         # pruning is consistent across shards
         self.dyn_filters: dict[str, tuple] = {}
         self._df_applied: set[str] = set()
-        # EXPLAIN ANALYZE: (node id, live-row count, distribution) per
-        # plan node, populated when collect_counts is set
-        self.collect_counts = False
-        self.row_counts: list[tuple[int, object, str]] = []
+        # always-on runtime stats (obs/qstats.py): (stable preorder
+        # position, mesh-global live-row count, distribution) per plan
+        # node — part of EVERY compiled shard_map program, so the
+        # cached/templated distributed path reports actuals too (one
+        # psum per node; EXPLAIN ANALYZE reads the same outputs)
+        self.collect_counts = True
+        self.row_counts: list[tuple[object, object, str]] = []
 
     # -- plumbing shared with the local interpreter -------------------------
 
@@ -177,7 +180,7 @@ class ShardedInterpreter:
             if out.dist == REPLICATED:
                 total = total // self.nshards
             self.row_counts.append(
-                (id(node), total,
+                (self.node_order.get(id(node), id(node)), total,
                  "sharded" if out.dist == SHARDED else "replicated"))
         return out
 
@@ -731,6 +734,7 @@ def execute_plan_distributed(engine, plan: N.PlanNode,
     # EXPLAIN ANALYZE (profile) bypasses the cache and keeps literals
     # baked — its row-count outputs change the program anyway.
     from presto_tpu import templates as TPL
+    orig_plan = plan  # pre-template plan for the stats recorder
     tpl = None
     if profile is None and TPL.enabled(engine.session):
         tpl = TPL.parameterize(plan)
@@ -785,6 +789,7 @@ def execute_plan_distributed(engine, plan: N.PlanNode,
                             params=len(tpl.params))
         pargs = tpl.example_args() if tpl is not None else []
         lowered = None
+        cache_hit = entry is not None
         if entry is not None:
             compiled, meta = entry
             compile_s = 0.0
@@ -801,7 +806,6 @@ def execute_plan_distributed(engine, plan: N.PlanNode,
                     scans[id(scan.node)] = (scan, per_scan[i])
                 interp = ShardedInterpreter(scans, capacities, nshards,
                                             engine.session, node_order)
-                interp.collect_counts = profile is not None
                 if tpl is not None:
                     from presto_tpu.templates import runtime as TR
                     tp = TR.TraceParams(list(it))
@@ -822,7 +826,11 @@ def execute_plan_distributed(engine, plan: N.PlanNode,
                     res.append(v.data)
                     res.append(v.valid if v.valid is not None
                                else jnp.ones((out.n,), dtype=bool))
-                counts = tuple(c for _, c, _ in interp.row_counts)
+                # stacked: one replicated (k,) array, one host fetch
+                counts = (jnp.stack([c for _, c, _ in
+                                     interp.row_counts])
+                          if interp.row_counts
+                          else jnp.zeros((0,), dtype=jnp.int32))
                 return (tuple(res), out.live_mask(),
                         tuple(interp.ok_flags), counts)
 
@@ -875,12 +883,21 @@ def execute_plan_distributed(engine, plan: N.PlanNode,
     engine.last_dist_hlo = meta.get("hlo") or (
         lowered.as_text() if lowered is not None else "")
     engine.last_dist_meta = {"used_capacity": dict(meta["used_capacity"])}
+    # fold into the ambient stats tree (obs/qstats.py): the distributed
+    # path reports per-node mesh-global actuals on cache/template hits
+    # exactly like cold compiles
+    from presto_tpu.obs import qstats as QS
+    QS.record_program(engine, orig_plan, meta, node_counts, compile_s,
+                      run_s, cache_hit=cache_hit,
+                      template=tpl is not None,
+                      template_hit=tpl is not None and cache_hit)
     if profile is not None:
+        counts_np = np.asarray(node_counts)
         profile["compile_s"] = compile_s
         profile["run_s"] = run_s
         profile["node_rows"] = {
-            nid: (int(np.asarray(c)), dist)
-            for (nid, dist), c in zip(meta["count_nodes"], node_counts)}
+            pos: (int(c), dist)
+            for (pos, dist), c in zip(meta["count_nodes"], counts_np)}
 
     live_np = np.asarray(live)
     cols: dict[str, Column] = {}

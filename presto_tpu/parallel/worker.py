@@ -29,6 +29,7 @@ import numpy as np
 
 from presto_tpu.ft import retry as FTR
 from presto_tpu.ft.faults import FAULTS
+from presto_tpu.obs import qstats as QS
 from presto_tpu.obs import trace as OT
 from presto_tpu.obs.jsonlog import LOG
 from presto_tpu.obs.metrics import REGISTRY
@@ -217,6 +218,10 @@ def _fetch_pages(ref: dict, timeout: float = 240.0,
             if nxt == token and complete:
                 nbytes = sum(len(p) for p in pages)
                 _FETCH_BYTES.inc(nbytes)
+                # per-task exchange accounting (obs/qstats.py): the
+                # fetch runs on the task's thread, so the ambient
+                # recorder attributes pulled pages to this task
+                QS.note_exchange(len(pages), nbytes)
                 if sp is not None:
                     sp.attrs["pages"] = len(pages)
                     sp.attrs["bytes"] = nbytes
@@ -264,6 +269,10 @@ def execute_fragment_task(engine, req: dict, store: dict,
             cols = concat_columns([p[0] for p in parts]) \
                 if parts else {}
             nrows = sum(p[1] for p in parts)
+            # per-source input rows: the stage-rollup consistency
+            # check (producer output rows == consumer input rows for
+            # partitioned sources) reads these
+            QS.add_input_rows(tname, nrows)
             conn.add(tname, cols, nrows)
 
     with (engine_lock if engine_lock is not None
@@ -277,6 +286,7 @@ def execute_fragment_task(engine, req: dict, store: dict,
 
     part = req.get("partition")
     if part is None and not req.get("store"):
+        QS.set_output_rows(int(live.sum()))
         return columns_to_bytes(cols)
 
     # buffered output: pages of ~PAGE_BYTES each stream into the
@@ -294,6 +304,7 @@ def execute_fragment_task(engine, req: dict, store: dict,
             _emit_pages(buf, p, slice_columns(cols, sel),
                         int(sel.sum()))
     buf.set_complete()
+    QS.set_output_rows(sum(buf.rows()))
     return {"rows": buf.rows()}
 
 
@@ -393,6 +404,11 @@ class WorkerServer(HttpService):
                               else _auth.default_secret())
         self.buffers: dict[str, object] = {}  # task -> OutputBuffer
         self.task_state: dict[str, dict] = {}
+        # task id -> TaskStats snapshot (obs/qstats.py), served at
+        # GET /v1/task/{id}/stats (exact id or prefix — the
+        # coordinator pulls a whole query's tasks with one GET per
+        # worker); bounded, cleared by prefix DELETE
+        self.task_stats: dict[str, dict] = {}
         self._engines: dict[tuple, object] = {}
         self._lock = threading.Lock()
         # fragment tasks mutate the cached engine's __exchange__
@@ -460,6 +476,9 @@ class WorkerServer(HttpService):
                 if self.path == "/metrics":
                     # worker-side gauges refresh at scrape time; the
                     # text body is the process-wide shared registry
+                    from presto_tpu.obs.procstats import (
+                        update_process_gauges)
+                    update_process_gauges(node=outer.node_id)
                     with outer._lock:
                         engines = list(outer._engines.values())
                     pools = [e.memory_pool.info() for e in engines]
@@ -505,9 +524,11 @@ class WorkerServer(HttpService):
                     # iterate a mutating dict
                     with outer._lock:
                         engines = list(outer._engines.values())
+                        active = outer._active_tasks
                     pools = [e.memory_pool.info() for e in engines]
                     self._send_json({
                         "nodeId": outer.node_id, "state": outer.state,
+                        "activeTasks": active,
                         "memory": {
                             "reservedBytes": sum(
                                 p["reservedBytes"] for p in pools),
@@ -567,6 +588,15 @@ class WorkerServer(HttpService):
                         return
                     self._send_json(st)
                     return
+                if (len(parts) == 4 and parts[:2] == ["v1", "task"]
+                        and parts[3] == "stats"):
+                    # TaskStats by exact id or id prefix (a query's
+                    # task ids share its query-id prefix, so the
+                    # coordinator assembles StageStats with one GET
+                    # per worker — reference TaskResource task info)
+                    self._send_json(
+                        {"tasks": outer.stats_for(parts[2])})
+                    return
                 self._send_json({"error": "not found"}, 404)
 
             def do_DELETE(self):  # noqa: N802
@@ -588,6 +618,10 @@ class WorkerServer(HttpService):
                     for tid in list(outer.task_state):
                         if tid.startswith(prefix):
                             outer.task_state.pop(tid, None)
+                    with outer._lock:
+                        for tid in list(outer.task_stats):
+                            if tid.startswith(prefix):
+                                outer.task_stats.pop(tid, None)
                     if outer.spool is not None:
                         outer.spool.delete_prefix(prefix)
                     self._send_json({})
@@ -648,6 +682,7 @@ class WorkerServer(HttpService):
                 if not outer.accepting_tasks():
                     # draining: 503 is classified transient, so a
                     # retrying coordinator re-dispatches elsewhere
+                    outer.shed_instant(self.headers, req, "drain")
                     self._send_json(
                         {"error": f"worker {outer.node_id} is "
                                   "shutting down"}, 503,
@@ -660,6 +695,8 @@ class WorkerServer(HttpService):
                     # instead of hammering this one
                     _TASKS_SHED.inc(site="worker-task-queue",
                                     node=outer.node_id)
+                    outer.shed_instant(self.headers, req,
+                                       "worker-task-queue")
                     self._send_json(
                         {"error": f"worker {outer.node_id} task "
                                   f"queue is full "
@@ -723,6 +760,7 @@ class WorkerServer(HttpService):
                                           tid=tid, ctx=ctx):
                                 # re-attach the propagated context:
                                 # this thread inherits no contextvars
+                                rec = None
                                 try:
                                     with OT.TRACER.attach(
                                             ctx, node=outer.node_id), \
@@ -730,7 +768,12 @@ class WorkerServer(HttpService):
                                             "worker-task",
                                             task_id=tid,
                                             kind="fragment",
-                                            mode="async"):
+                                            mode="async"), \
+                                        QS.task(
+                                            str(tid or ""),
+                                            node=outer.node_id,
+                                            shard=int(req.get(
+                                                "shard", 0))) as rec:
                                         out = execute_fragment_task(
                                             engine, req,
                                             outer.buffers,
@@ -754,6 +797,8 @@ class WorkerServer(HttpService):
                                         "state": "failed",
                                         "error": repr(exc)[:500]}
                                 finally:
+                                    if rec is not None:
+                                        outer.store_task_stats(rec)
                                     # the async thread owns the task
                                     # slot claimed at intake
                                     outer.end_task()
@@ -779,29 +824,56 @@ class WorkerServer(HttpService):
                             self._send_json({"taskId": tid,
                                              "state": "running"})
                             return
-                        with OT.TRACER.attach(ctx,
-                                              node=outer.node_id), \
-                                OT.TRACER.span(
-                                    "worker-task",
-                                    task_id=str(tid or ""),
-                                    kind="fragment",
-                                    shard=int(req.get("shard", 0))):
-                            out = execute_fragment_task(
-                                engine, req, outer.buffers,
-                                secret=outer.shared_secret,
-                                engine_lock=outer._task_lock)
+                        rec = None
+                        try:
+                            with OT.TRACER.attach(
+                                    ctx, node=outer.node_id), \
+                                    OT.TRACER.span(
+                                        "worker-task",
+                                        task_id=str(tid or ""),
+                                        kind="fragment",
+                                        shard=int(req.get(
+                                            "shard", 0))), \
+                                    QS.task(
+                                        str(tid or ""),
+                                        node=outer.node_id,
+                                        shard=int(req.get(
+                                            "shard", 0))) as rec:
+                                out = execute_fragment_task(
+                                    engine, req, outer.buffers,
+                                    secret=outer.shared_secret,
+                                    engine_lock=outer._task_lock)
+                        finally:
+                            if rec is not None:
+                                outer.store_task_stats(rec)
                         if isinstance(out, bytes):
                             self._send_bytes(out)
                         else:
-                            self._send_json(out)
+                            # TaskStats ride the task result
+                            # (reference TaskInfo in the update
+                            # response); binary results are covered
+                            # by GET /v1/task/{id}/stats
+                            self._send_json(
+                                {**out, "stats": rec.snapshot()})
                         return
-                    with OT.TRACER.attach(ctx, node=outer.node_id), \
-                            OT.TRACER.span(
-                                "worker-task", kind="partial",
-                                shard=int(req["shard"])):
-                        out = execute_partial_task(
-                            engine_factory, req["sql"],
-                            int(req["shard"]), int(req["nshards"]))
+                    rec = None
+                    try:
+                        with OT.TRACER.attach(ctx,
+                                              node=outer.node_id), \
+                                OT.TRACER.span(
+                                    "worker-task", kind="partial",
+                                    shard=int(req["shard"])), \
+                                QS.task(
+                                    str(req.get("task_id") or ""),
+                                    node=outer.node_id,
+                                    shard=int(req["shard"])) as rec:
+                            out = execute_partial_task(
+                                engine_factory, req["sql"],
+                                int(req["shard"]), int(req["nshards"]))
+                            QS.set_output_rows(int(out["nrows"]))
+                    finally:
+                        if rec is not None and req.get("task_id"):
+                            outer.store_task_stats(rec)
                     self._send_json(out)
                 except Exception as e:  # noqa: BLE001 - to coordinator
                     _TASK_FAILURES.inc(node=outer.node_id)
@@ -850,6 +922,37 @@ class WorkerServer(HttpService):
             self._active_tasks -= 1
             depth = self._active_tasks
         _TASK_DEPTH.set(depth, node=self.node_id)
+
+    # -- runtime task statistics (obs/qstats.py) --------------------------
+
+    MAX_TASK_STATS = 512
+
+    def store_task_stats(self, rec) -> None:
+        """Keep a finished task's TaskStats snapshot for the stats
+        endpoint (bounded FIFO; dicts preserve insertion order)."""
+        snap = rec.snapshot()
+        with self._lock:
+            self.task_stats.pop(rec.task_id, None)
+            self.task_stats[rec.task_id] = snap
+            while len(self.task_stats) > self.MAX_TASK_STATS:
+                self.task_stats.pop(next(iter(self.task_stats)))
+
+    def stats_for(self, prefix: str) -> list[dict]:
+        with self._lock:
+            return [s for t, s in self.task_stats.items()
+                    if t.startswith(prefix)]
+
+    def shed_instant(self, headers, req: dict, site: str) -> None:
+        """Mark a shed decision on the owning query's trace timeline
+        (the trace id rides the task POST's X-Presto-TPU-Trace header)
+        so PR 6's overload protections show up on the query timeline,
+        not only in counters."""
+        ctx = OT.parse_context(headers.get(OT.TRACE_HEADER))
+        if ctx is not None:
+            OT.TRACER.instant_for(
+                ctx[0], "task-shed", create=True, site=site,
+                node=self.node_id,
+                task_id=str(req.get("task_id") or ""))
 
     def spool_page(self, task_id: str, partition: int, token: int):
         """(blob, next, complete) from the spool, or None when the

@@ -29,6 +29,7 @@ from concurrent.futures import ThreadPoolExecutor
 import numpy as np
 
 from presto_tpu import types as T
+from presto_tpu.obs import qstats as QS
 from presto_tpu.obs.jsonlog import LOG
 from presto_tpu.obs.metrics import REGISTRY
 from presto_tpu.obs.trace import TRACER
@@ -251,6 +252,11 @@ class QueryManager:
         except (QueryQueueFullError, NoMatchingGroupError) as e:
             if isinstance(e, QueryQueueFullError):
                 _SHED.inc(site="coordinator-queue-full")
+                # a shed query's timeline is just this marker — but it
+                # makes /v1/query/{id}/trace answer "why did my query
+                # never run" (reference QUERY_QUEUE_FULL + Web UI)
+                TRACER.instant_for(qid, "query-shed", create=True,
+                                   site="coordinator-queue-full")
             with self.lock:
                 # a concurrent cancel() may have won: CANCELED sticks
                 if q.state != "CANCELED":
@@ -280,10 +286,14 @@ class QueryManager:
             _TRANSITIONS.inc(state="running")
             # the trace id IS the protocol query id: the root span of
             # everything this query does on any node; GET
-            # /v1/query/{id}/trace exports the tree
-            with TRACER.trace(q.query_id, "query", user=q.user,
-                              sql=q.sql[:200],
-                              node="coordinator") as root:
+            # /v1/query/{id}/trace exports the tree. The runtime-stats
+            # scope (obs/qstats.py) opens under the same id, so
+            # GET /v1/query/{id} serves the Query->Stage->Task->
+            # Operator tree keyed the way clients know the query.
+            with QS.query(q.query_id, q.sql, q.user) as qrec, \
+                    TRACER.trace(q.query_id, "query", user=q.user,
+                                 sql=q.sql[:200],
+                                 node="coordinator") as root:
                 TRACER.add_span("admission", q.created_wall,
                                 time.time())
                 # terminal transitions only fire from RUNNING: the
@@ -330,6 +340,12 @@ class QueryManager:
                             _TRANSITIONS.inc(state="failed")
                 finally:
                     q.finished = time.monotonic()
+                    # sync the protocol-level terminal state into the
+                    # stats tree before its scope closes (the reaper
+                    # may have set FAILED; the recorder must agree)
+                    qrec.state = q.state
+                    qrec.error = q.error
+                    qrec.output_rows = len(q.rows or [])
             LOG.log("query", query_id=q.query_id, user=q.user,
                     state=q.state,
                     elapsed_ms=round((q.finished - q.started) * 1e3, 3),
@@ -519,6 +535,12 @@ class QueryManager:
         REAPED.inc(kind=kind)
         LOG.log("query_reaped", query_id=q.query_id, kind=kind,
                 error=message)
+        # mark the kill on the query's trace timeline (the reaper
+        # thread has no ambient trace context; the query id IS the
+        # trace id — create covers queries reaped while still QUEUED,
+        # whose trace would otherwise not exist yet)
+        TRACER.instant_for(q.query_id, "reaper-kill", create=True,
+                           kind=kind, error=message[:200])
         if token is not None:
             token.kill(TimeLimitExceeded(message))
         if ticket is not None:
@@ -624,6 +646,8 @@ class _Handler(JsonHandler):
         shared MetricsRegistry at the event sites; snapshot-derived
         gauges refresh here at scrape time, then the whole registry
         renders (the worker's /metrics renders the same registry)."""
+        from presto_tpu.obs.procstats import update_process_gauges
+        update_process_gauges(node="coordinator")
         qs = self.manager.snapshot()
         for state in ("QUEUED", "RUNNING", "FINISHED", "FAILED",
                       "CANCELED"):
@@ -794,7 +818,9 @@ class _Handler(JsonHandler):
                 # stopping the process)
                 out["workers"] = [
                     {"uri": w.uri, "alive": w.alive,
-                     "schedulable": w.schedulable}
+                     "schedulable": w.schedulable,
+                     "state": w.state, "nodeId": w.node_id,
+                     "activeTasks": w.active_tasks}
                     for w in cluster.workers]
             self._send_json(out)
             return
@@ -860,10 +886,17 @@ class _Handler(JsonHandler):
             if q is None or not self._can_view(user, q):
                 self._send_json({"error": "unknown query"}, 404)
                 return
-            self._send_json({
+            out = {
                 "queryId": q.query_id, "state": q.state, "query": q.sql,
                 "user": q.user, "stats": q.stats(),
-                "error": q.error})
+                "error": q.error}
+            rec = QS.STORE.get(q.query_id)
+            if rec is not None:
+                # the full Query->Stage->Task->Operator runtime tree
+                # (reference QueryResource's QueryInfo with stage/task
+                # stats), live mid-flight and final after completion
+                out["queryStats"] = rec.snapshot()
+            self._send_json(out)
             return
         if len(parts) == 5 and parts[:3] == ["v1", "statement",
                                              "executing"]:

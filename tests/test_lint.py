@@ -385,6 +385,55 @@ def test_timeout_discipline_suppressible(tmp_path):
     assert run_lint([pkg], rules=["timeout-discipline"]) == []
 
 
+# -- span discipline --------------------------------------------------------
+
+
+def test_span_discipline_flags_orphaned_tracer_entries(tmp_path):
+    """Tracer contextmanagers opened by hand leak the open span AND
+    the ambient context on any exception before close; every opening
+    call must be a `with` item (or enter_context argument)."""
+    pkg = write_pkg(tmp_path, {"presto_tpu/exec/broken.py": """
+        from presto_tpu.obs.trace import TRACER
+        from presto_tpu.obs import trace as OT
+
+        def leaky(plan):
+            cm = TRACER.span("compile")      # orphaned handle
+            cm.__enter__()
+            return run(plan)
+
+        def leaky_attach(ctx):
+            OT.TRACER.attach(ctx).__enter__()  # orphaned attach
+
+        def fine(plan):
+            with TRACER.span("compile"):
+                return run(plan)
+
+        def fine_multi(ctx):
+            with OT.TRACER.attach(ctx), OT.TRACER.span("task"):
+                return 1
+
+        def fine_stack(stack, ctx):
+            stack.enter_context(TRACER.attach(ctx))
+
+        def unrelated(m):
+            return m.span()  # regex Match.span: not a tracer
+    """})
+    findings = run_lint([pkg], rules=["span-discipline"])
+    assert len(findings) == 2, [f.format() for f in findings]
+    assert {f.line for f in findings} == {6, 11}
+    assert all("with" in f.message for f in findings)
+
+
+def test_span_discipline_suppressible(tmp_path):
+    pkg = write_pkg(tmp_path, {"presto_tpu/exec/broken.py": """
+        from presto_tpu.obs.trace import TRACER
+
+        def manual():
+            return TRACER.span("x")  # lint: disable=span-discipline
+    """})
+    assert run_lint([pkg], rules=["span-discipline"]) == []
+
+
 # -- pool discipline --------------------------------------------------------
 
 
