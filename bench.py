@@ -32,8 +32,11 @@ and 4 exhausted the budget before ever measuring a join at SF10
 reorderer (presto_tpu/cost/) exists for — gets a RESERVED budget slice
 ahead of lower-priority q06: five consecutive rounds reported it
 "skipped: bench time budget exhausted" because everything before it
-consumed the budget; now q03/q05 may not eat into its reserve and q06
-runs last on whatever remains.
+consumed the budget; now q01's child timeout AND q03/q05 may not eat
+into its reserve, q06 runs last on whatever remains, and if the
+reserve is starved anyway (datagen overrun, timeout floors) the run
+reports ``q09_reserve_starved`` (seconds missing) instead of hiding
+the gap behind the generic skip message.
 
 Each query reports cold AND warm: after the cold compile+run, the
 query reruns in a fresh process against the persistent AOT program
@@ -506,9 +509,21 @@ def main() -> None:
     nrows = lineitem.nrows
     detail["datagen_s"] = round(time.perf_counter() - t0, 1)
 
-    # headline: Q1 through the full SQL frontend
+    # Q9's reserved slice (PRESTO_TPU_BENCH_Q9_RESERVE_S): read BEFORE
+    # anything timed so every earlier measurement's timeout can be
+    # shaped around it — five rounds in a row q09 was "skipped: bench
+    # time budget exhausted" because q01 (whose child timeout ignored
+    # the reserve) and the join queries ate the whole budget first
+    q9_reserve = float(os.environ.get("PRESTO_TPU_BENCH_Q9_RESERVE_S",
+                                      "150"))
+
+    # headline: Q1 through the full SQL frontend. Its child timeout
+    # excludes Q9's reserve too — BENCH_r05's q01 alone burned ~200 s
+    # of compile+measure, and the old `left - 120` cap let it spend
+    # straight into the slice the joins loop was supposed to protect
     left = budget - (time.perf_counter() - t_start)
-    r = measure_query("q01", sf, reps, max(left - 120, 120))
+    r = measure_query("q01", sf, reps,
+                      max(left - q9_reserve - 120, 120))
     if "error" in r:
         # a broken headline is still a bench result; report zero rather
         # than crash the driver
@@ -541,10 +556,6 @@ def main() -> None:
     # line is a valid result; on success the final line below (with
     # details) replaces it
     print(json.dumps(headline), flush=True)
-
-    # Q9's reserved slice (see the joins loop below)
-    q9_reserve = float(os.environ.get("PRESTO_TPU_BENCH_Q9_RESERVE_S",
-                                      "150"))
 
     # NumPy join baselines (host-side, cheap)
     try:
@@ -594,6 +605,14 @@ def main() -> None:
         left = budget - (time.perf_counter() - t_start)
         if name in ("q03", "q05"):
             left -= q9_reserve  # keep q09's slice untouchable
+        if name == "q09" and left < q9_reserve:
+            # the reserve was eaten anyway (datagen overrun, a slow
+            # q01 floor, numpy baselines): FAIL THE RESERVE LOUDLY —
+            # a silent generic skip is how five rounds went by with
+            # q09 never measured; the starved marker names the gap so
+            # the budget regression is attributable, and q09 still
+            # runs on whatever remains if it plausibly can
+            detail["q09_reserve_starved"] = round(q9_reserve - left, 1)
         if left <= 60:
             detail[f"{name}_skipped"] = "bench time budget exhausted"
             continue

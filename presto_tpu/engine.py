@@ -602,7 +602,10 @@ def _table_to_host(table: Table, engine=None):
     if writers > 1 and len(items) > 1:
         from concurrent.futures import ThreadPoolExecutor
         with ThreadPoolExecutor(max_workers=writers) as pool:
-            results = list(pool.map(convert, items))
+            # context-free by design: convert() is pure host-side
+            # numpy decode — no spans, checkpoints, stats, or session
+            # reads happen on the writer threads
+            results = list(pool.map(convert, items))  # lint: disable=handoff
     else:
         writers = 1
         results = [convert(i) for i in items]

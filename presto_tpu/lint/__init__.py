@@ -26,10 +26,22 @@ pipeline, sql/planner/sanity/PlanSanityChecker.java):
   must be opened via ``with`` (or ``ExitStack.enter_context``) — a
   hand-entered span leaks both an unfinished span and the ambient
   trace context on any exception before close.
+- **field-level locksets** (``lint/races.py``): the Eraser-style
+  refinement of lock discipline — every field's read/write sites must
+  agree on WHICH lock guards it; written-under-A-read-under-B races
+  are invisible to the boolean rule.
+- **ambient-context handoff** (``lint/handoff.py``): thread-spawn
+  sites in modules using ambient contextvars/thread-locals (trace
+  context, cancel token, stats recorder, session override) must hand
+  the state over explicitly or document why the thread is
+  context-free.
 
 Run ``python -m presto_tpu.lint presto_tpu/`` (exits nonzero on
-findings); suppress a single line with ``# lint: disable=rule-name``
-plus a comment saying why.
+findings; ``--changed`` scopes reporting to files changed since HEAD
+for pre-commit runs); suppress a single line with
+``# lint: disable=rule-name`` plus a comment saying why. Stale
+suppressions — disables that no longer suppress anything — are
+reported as ``stale-suppression`` findings by the runner itself.
 """
 
 from presto_tpu.lint.core import (Finding, Project, available_rules,
@@ -43,5 +55,7 @@ from presto_tpu.lint import metrics as _metrics  # noqa: E402,F401
 from presto_tpu.lint import timeouts as _timeouts  # noqa: E402,F401
 from presto_tpu.lint import pools as _pools  # noqa: E402,F401
 from presto_tpu.lint import spans as _spans  # noqa: E402,F401
+from presto_tpu.lint import races as _races  # noqa: E402,F401
+from presto_tpu.lint import handoff as _handoff  # noqa: E402,F401
 
 __all__ = ["Finding", "Project", "available_rules", "run_lint"]

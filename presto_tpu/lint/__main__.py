@@ -8,16 +8,50 @@ from __future__ import annotations
 
 import argparse
 import json
+import subprocess
 import sys
+from pathlib import Path
 
 from presto_tpu.lint import available_rules, run_lint
+
+
+def _changed_files(paths: list[str]) -> set[Path]:
+    """Resolved paths of ``.py`` files touched since HEAD (worktree
+    diff, staged diff, and untracked files) in the git repo containing
+    the first analyzed path. Raises ValueError outside a repo."""
+    anchor = Path(paths[0]).resolve()
+    anchor_dir = anchor if anchor.is_dir() else anchor.parent
+    try:
+        root = subprocess.run(
+            ["git", "-C", str(anchor_dir), "rev-parse",
+             "--show-toplevel"],
+            capture_output=True, text=True, check=True,
+            timeout=30).stdout.strip()
+        diff = subprocess.run(
+            ["git", "-C", root, "diff", "--name-only", "HEAD", "--"],
+            capture_output=True, text=True, check=True, timeout=30)
+        untracked = subprocess.run(
+            ["git", "-C", root, "ls-files", "--others",
+             "--exclude-standard"],
+            capture_output=True, text=True, check=True, timeout=30)
+    except (subprocess.CalledProcessError, OSError,
+            subprocess.TimeoutExpired) as e:
+        detail = getattr(e, "stderr", "") or str(e)
+        raise ValueError(
+            f"--changed needs a git checkout: {detail.strip()}") from e
+    out: set[Path] = set()
+    for line in (diff.stdout + untracked.stdout).splitlines():
+        if line.strip().endswith(".py"):
+            out.add((Path(root) / line.strip()).resolve())
+    return out
 
 
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m presto_tpu.lint",
         description="Engine-specific static analysis: tracer hygiene, "
-                    "lock discipline, plan-dispatch exhaustiveness.")
+                    "lock discipline, lockset/handoff concurrency "
+                    "rules, plan-dispatch exhaustiveness.")
     parser.add_argument("paths", nargs="*", default=["presto_tpu"],
                         help="files or directories to analyze "
                              "(default: presto_tpu)")
@@ -26,13 +60,44 @@ def main(argv: list[str] | None = None) -> int:
                              f"(available: {', '.join(available_rules())})")
     parser.add_argument("--json", action="store_true", dest="as_json",
                         help="machine-readable JSON findings on stdout")
+    parser.add_argument("--changed", action="store_true",
+                        help="report only findings in files changed "
+                             "since HEAD (worktree + staged + "
+                             "untracked) — the fast pre-commit mode; "
+                             "analysis still covers the whole tree so "
+                             "cross-file rules stay sound")
     args = parser.parse_args(argv)
 
     rules = None
     if args.rules:
         rules = [r.strip() for r in args.rules.split(",") if r.strip()]
+    only_files = None
     try:
-        findings = run_lint(args.paths, rules)
+        if args.changed:
+            only_files = _changed_files(args.paths)
+            if not only_files:
+                # validate paths and rule names even on the fast
+                # exit: a pre-commit hook with a typo'd --rules or
+                # path must fail loudly on EVERY run, not only once
+                # the worktree is dirty
+                missing = [p for p in args.paths
+                           if not Path(p).exists()]
+                if missing:
+                    raise ValueError(f"paths do not exist: {missing}")
+                if rules:
+                    unknown = [r for r in rules
+                               if r not in available_rules()]
+                    if unknown:
+                        raise ValueError(
+                            f"unknown lint rules: {unknown} "
+                            f"(available: {available_rules()})")
+                if not args.as_json:
+                    print("no changed .py files; nothing to lint",
+                          file=sys.stderr)
+                else:
+                    print("[]")
+                return 0
+        findings = run_lint(args.paths, rules, only_files=only_files)
     except ValueError as e:
         print(str(e), file=sys.stderr)
         return 2

@@ -23,7 +23,10 @@ from typing import Callable, Iterable
 
 PACKAGE = "presto_tpu"
 
-# ``# lint: disable=rule-a,rule-b`` or ``# lint: disable`` (every rule)
+# comment syntax: a '#' then ``lint: disable=rule-a,rule-b``, or the
+# bare ``disable`` form covering every rule (phrased here without the
+# leading hash so the stale-suppression check does not read THIS
+# comment as a suppression)
 _SUPPRESS_RE = re.compile(
     r"#\s*lint:\s*disable(?:=([A-Za-z0-9_,\- ]+))?")
 
@@ -45,7 +48,10 @@ class Finding:
 
 
 class SourceModule:
-    """One parsed source file plus its suppression table."""
+    """One parsed source file plus its suppression table and the
+    shared walk/alias caches every rule reads instead of re-walking
+    the tree (one full ``ast.walk`` per rule per module dominated
+    lint runtime before these)."""
 
     def __init__(self, path: Path, relpath: str, text: str):
         self.path = path
@@ -55,13 +61,42 @@ class SourceModule:
         # line -> set of suppressed rule names, or None meaning all
         self.suppressions: dict[int, set[str] | None] = {}
         self._scan_suppressions(text)
+        self._walk_cache: list[ast.AST] | None = None
+        self._call_cache: list[ast.Call] | None = None
+        self._alias_cache: dict[str, str] | None = None
 
     @property
     def modname(self) -> str:
         return self.relpath[:-3].replace("/", ".")
 
+    def walk(self) -> list[ast.AST]:
+        """Every node of the module tree, walked ONCE and cached for
+        the project's lifetime — rules iterate this flat list instead
+        of paying their own ``ast.walk`` pass."""
+        if self._walk_cache is None:
+            self._walk_cache = list(ast.walk(self.tree))
+        return self._walk_cache
+
+    def calls(self) -> list[ast.Call]:
+        """Just the Call nodes of the shared walk — most per-call
+        rules (timeouts, spans, metric names, spawn sites) scan only
+        these, a ~10x smaller list than the full walk."""
+        if self._call_cache is None:
+            self._call_cache = [n for n in self.walk()
+                                if isinstance(n, ast.Call)]
+        return self._call_cache
+
+    @property
+    def aliases(self) -> dict[str, str]:
+        """Cached :func:`import_aliases` for this module (computed
+        off the shared walk, not a private re-walk)."""
+        if self._alias_cache is None:
+            self._alias_cache = import_aliases(self.tree,
+                                               nodes=self.walk())
+        return self._alias_cache
+
     def _scan_suppressions(self, text: str) -> None:
-        # tokenize (not line regex) so a '# lint: disable' inside a
+        # tokenize (not line regex) so a lint-disable marker inside a
         # string literal is not treated as a suppression
         import io
         if "lint:" not in text:  # tokenizing every file is ~1/3 of
@@ -163,10 +198,69 @@ def available_rules() -> list[str]:
     return sorted(_RULES)
 
 
+# pseudo-rule emitted by run_lint itself for suppression comments that
+# no longer suppress anything (it needs every real rule's output, so
+# it cannot live in the registry)
+STALE_RULE = "stale-suppression"
+
+
+def _stale_suppressions(project: Project, selected: list[str],
+                        used: dict[tuple[str, int], set[str]],
+                        blanket_used: set[tuple[str, int]]
+                        ) -> list[Finding]:
+    """Suppression comments that excused nothing this run — the code
+    they covered was fixed or deleted, and a stale disable would
+    silently swallow the NEXT real finding on that line. Only rules
+    that actually ran are judged (a ``--rules`` subset run cannot
+    call another rule's suppression stale); blanket ``disable``
+    comments are judged only on full runs for the same reason.
+    Unknown rule names are always stale — a typo'd suppression
+    suppresses nothing while looking like it does."""
+    known = set(available_rules()) | {STALE_RULE}
+    ran = set(selected)
+    full_run = ran == set(available_rules())
+    out: list[Finding] = []
+    for mod in project.modules:
+        for line, names in sorted(mod.suppressions.items()):
+            key = (mod.relpath, line)
+            stale: list[str] = []
+            if names is None:
+                if full_run and key not in blanket_used:
+                    out.append(Finding(
+                        STALE_RULE, mod.relpath, line, 0,
+                        "blanket '# lint: disable' suppresses no "
+                        "finding on this line; delete it (a stale "
+                        "disable hides the next real finding here)"))
+                continue
+            for name in sorted(names):
+                if name == STALE_RULE:
+                    continue  # judged by its own mechanism below
+                if name not in known:
+                    out.append(Finding(
+                        STALE_RULE, mod.relpath, line, 0,
+                        f"suppression names unknown rule {name!r} "
+                        f"(available: {', '.join(available_rules())})"
+                        " — it suppresses nothing"))
+                elif name in ran and name not in used.get(key, ()):
+                    stale.append(name)
+            if stale:
+                out.append(Finding(
+                    STALE_RULE, mod.relpath, line, 0,
+                    f"'# lint: disable={','.join(stale)}' no longer "
+                    "suppresses any finding; the code it excused was "
+                    "fixed or moved — delete the stale suppression"))
+    return out
+
+
 def run_lint(paths: Iterable[str | Path],
-             rules: Iterable[str] | None = None) -> list[Finding]:
+             rules: Iterable[str] | None = None,
+             only_files: set[Path] | None = None) -> list[Finding]:
     """Run the selected rules (default: all) over ``paths``; returns
-    unsuppressed findings sorted by location."""
+    unsuppressed findings — plus ``stale-suppression`` findings for
+    disable comments that excused nothing — sorted by location.
+    ``only_files`` (resolved paths) restricts REPORTING to those
+    files while the analysis still sees the whole tree (the CLI's
+    ``--changed`` mode: cross-file rules stay sound)."""
     import presto_tpu.lint  # noqa: F401 - ensure rules registered
     paths = list(paths)
     missing = [str(p) for p in paths if not Path(p).exists()]
@@ -183,12 +277,35 @@ def run_lint(paths: Iterable[str | Path],
         raise ValueError(f"unknown lint rules: {unknown} "
                          f"(available: {available_rules()})")
     findings: list[Finding] = []
+    used: dict[tuple[str, int], set[str]] = {}
+    blanket_used: set[tuple[str, int]] = set()
     for name in selected:
         for f in _RULES[name](project):
             mod = project.by_relpath.get(f.path)
             if mod is not None and mod.suppressed(f.line, f.rule):
+                names = mod.suppressions.get(f.line, set())
+                if names is None:
+                    blanket_used.add((f.path, f.line))
+                else:
+                    used.setdefault((f.path, f.line),
+                                    set()).add(f.rule)
                 continue
             findings.append(f)
+    for f in _stale_suppressions(project, selected, used,
+                                 blanket_used):
+        mod = project.by_relpath.get(f.path)
+        if mod is not None:
+            names = mod.suppressions.get(f.line)
+            # only an EXPLICIT disable=stale-suppression silences a
+            # staleness report — the blanket being reported as stale
+            # must not vouch for itself
+            if names is not None and STALE_RULE in names:
+                continue
+        findings.append(f)
+    if only_files is not None:
+        findings = [f for f in findings
+                    if (m := project.by_relpath.get(f.path)) is not None
+                    and m.path.resolve() in only_files]
     return sorted(findings, key=lambda f: (f.path, f.line, f.col,
                                            f.rule))
 
@@ -225,10 +342,13 @@ def walk_functions(tree: ast.AST):
     yield from visit(tree, ())
 
 
-def import_aliases(tree: ast.AST) -> dict[str, str]:
-    """Local name -> imported dotted module/object path."""
+def import_aliases(tree: ast.AST,
+                   nodes: Iterable[ast.AST] | None = None
+                   ) -> dict[str, str]:
+    """Local name -> imported dotted module/object path. Pass a
+    pre-walked node list via ``nodes`` to skip the tree walk."""
     out: dict[str, str] = {}
-    for node in ast.walk(tree):
+    for node in (nodes if nodes is not None else ast.walk(tree)):
         if isinstance(node, ast.Import):
             for a in node.names:
                 out[a.asname or a.name.split(".")[0]] = (

@@ -124,7 +124,7 @@ def _exemptions(mod: SourceModule) -> dict[str, tuple[str, int]]:
 def _handled_isinstance(mod: SourceModule,
                         registry: dict[str, int]) -> set[str]:
     handled: set[str] = set()
-    for node in ast.walk(mod.tree):
+    for node in mod.walk():
         if not (isinstance(node, ast.Call)
                 and isinstance(node.func, ast.Name)
                 and node.func.id == "isinstance"
@@ -144,7 +144,7 @@ def _handled_isinstance(mod: SourceModule,
 def _handled_register(mod: SourceModule, registry: dict[str, int],
                       fn_name: str) -> set[str]:
     handled: set[str] = set()
-    for node in ast.walk(mod.tree):
+    for node in mod.walk():
         if isinstance(node, ast.Call) and \
                 qual_name(node.func) is not None and \
                 qual_name(node.func).rsplit(".", 1)[-1] == fn_name:
@@ -163,7 +163,7 @@ def _handled_method_prefix(mod: SourceModule,
     """(handled names, anchor line of the dispatching class)."""
     by_lower = {name.lower(): name for name in registry}
     best: tuple[set[str], int] = (set(), 1)
-    for node in ast.walk(mod.tree):
+    for node in mod.walk():
         if not isinstance(node, ast.ClassDef):
             continue
         handled: set[str] = set()
@@ -193,7 +193,7 @@ def _check_generic(mod: SourceModule) -> list[str]:
     walks_fields = any(
         isinstance(n, ast.Call) and qual_name(n.func) in
         ("dataclasses.fields", "fields")
-        for n in ast.walk(mod.tree))
+        for n in mod.walk())
     problems = []
     if not walks_fields:
         problems.append(

@@ -39,14 +39,20 @@ LOCK_SCOPES = (
     "presto_tpu/memory.py",
     "presto_tpu/obs/",
     "presto_tpu/events.py",
-    "presto_tpu/exec/progcache.py",
-    # cross-thread cancellation/kill state (the reaper and the
-    # low-memory killer write tokens other threads observe)
-    "presto_tpu/exec/cancel.py",
+    # exec/ as a whole: parallel segment compilation, the program
+    # cache, spill/stream replays and cancellation state all run on
+    # pool threads now — "single-threaded per query" stopped being
+    # true when parallel_compile_width landed
+    "presto_tpu/exec/",
     "presto_tpu/ft/",
     # plan-template pad caches are shared across concurrently
     # compiling queries (templates/shapes.py)
     "presto_tpu/templates/",
+    # the engine object is shared by every concurrently-admitted
+    # query (device-pin cache, carrier caps, preplanned handoff)
+    "presto_tpu/engine.py",
+    # per-thread session overrides + the shared property dict
+    "presto_tpu/session.py",
 )
 
 _LOCK_NAME_RE = re.compile(
@@ -71,21 +77,64 @@ def _is_lock_expr(node: ast.AST) -> bool:
     return False
 
 
+def _lock_name(node: ast.AST) -> str:
+    """Canonical name of a lock expression: the final name segment of
+    its dotted path (``self._lock`` -> ``_lock``; ``mgr.lock``,
+    ``self._manager.lock`` and the manager's own ``self.lock`` all ->
+    ``lock``). Receiver chains are deliberately dropped: the same lock
+    reaches different methods through different spellings (aliases,
+    peer handles, the owning object itself), and a spelling-sensitive
+    name would report those as disjoint locks. Two DIFFERENT locks
+    sharing a final name therefore pool — a false negative, which is
+    the safe direction for a rule enforced at zero findings; distinct
+    locks in this codebase carry distinct attribute names."""
+    from presto_tpu.lint.core import qual_name
+    q = qual_name(node)
+    if q is not None:
+        return q.rsplit(".", 1)[-1]
+    for sub in ast.walk(node):
+        name = None
+        if isinstance(sub, ast.Attribute):
+            name = sub.attr
+        elif isinstance(sub, ast.Name):
+            name = sub.id
+        if name is not None and _LOCK_NAME_RE.search(name):
+            return name
+    return "<lock>"
+
+
+# access kinds: a whole-reference assignment is atomic in CPython (the
+# publish side of the snapshot-copy idiom); a mutation (augmented
+# assignment, subscript store, del, mutator method) is not
+KIND_ASSIGN = "assign"
+KIND_MUTATE = "mutate"
+KIND_READ = "read"
+
+
 @dataclasses.dataclass
 class _Access:
     attr: str
     is_write: bool
-    locked: bool  # lexically, at the access site
+    locks: frozenset  # canonical lock names held lexically at the site
     unit: "_Unit"
     line: int
     col: int
+    kind: str = KIND_READ
+
+    @property
+    def locked(self) -> bool:
+        return bool(self.locks)
 
 
 @dataclasses.dataclass
 class _CallSite:
     callee: str  # bare method name
-    locked: bool  # lexically
+    locks: frozenset  # canonical lock names held lexically
     unit: "_Unit"
+
+    @property
+    def locked(self) -> bool:
+        return bool(self.locks)
 
 
 class _Unit:
@@ -123,32 +172,37 @@ class _UnitVisitor(ast.NodeVisitor):
     def __init__(self, unit: _Unit, collector: "_ClassAnalysis"):
         self.unit = unit
         self.collector = collector
-        self.lock_depth = 0
+        self._lock_stack: list[str] = []
         # attribute nodes already recorded as writes/mutations, so the
         # generic visit_Attribute pass doesn't double-report them
         self._claimed: set[int] = set()
 
     @property
-    def locked(self) -> bool:
-        return self.lock_depth > 0
+    def locks(self) -> frozenset:
+        return frozenset(self._lock_stack)
 
-    def _record(self, attr: str, is_write: bool, node: ast.AST) -> None:
+    @property
+    def locked(self) -> bool:
+        return bool(self._lock_stack)
+
+    def _record(self, attr: str, is_write: bool, node: ast.AST,
+                kind: str = KIND_READ) -> None:
         self.unit.accesses.append(_Access(
-            attr, is_write, self.locked, self.unit,
-            node.lineno, node.col_offset))
+            attr, is_write, self.locks, self.unit,
+            node.lineno, node.col_offset, kind))
 
     # -- structure ---------------------------------------------------------
 
     def visit_With(self, node: ast.With) -> None:
-        is_lock = any(_is_lock_expr(i.context_expr) for i in node.items)
+        held = [_lock_name(i.context_expr) for i in node.items
+                if _is_lock_expr(i.context_expr)]
         for i in node.items:
             self.visit(i.context_expr)
-        if is_lock:
-            self.lock_depth += 1
+        self._lock_stack.extend(held)
         for stmt in node.body:
             self.visit(stmt)
-        if is_lock:
-            self.lock_depth -= 1
+        if held:
+            del self._lock_stack[-len(held):]
 
     def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
         self.collector.add_nested(self.unit, node)
@@ -163,19 +217,31 @@ class _UnitVisitor(ast.NodeVisitor):
 
     # -- accesses ----------------------------------------------------------
 
-    def _claim_write_targets(self, target: ast.AST) -> None:
+    def _claim_write_targets(self, target: ast.AST,
+                             kind: str = KIND_ASSIGN) -> None:
+        # a store through a subscript mutates the held object; only a
+        # direct ``self.attr = ...`` atomically swaps the reference
+        if isinstance(target, ast.Subscript):
+            kind = KIND_MUTATE
         attr = _root_self_attr(target, self.unit.self_names)
         if attr is not None:
-            self._record(attr, True, target)
+            self._record(attr, True, target, kind)
             for sub in ast.walk(target):
                 self._claimed.add(id(sub))
         else:
-            # tuple targets etc.
             for child in ast.iter_child_nodes(target):
                 if isinstance(child, (ast.Tuple, ast.List,
-                                      ast.Starred, ast.Attribute,
-                                      ast.Subscript)):
-                    self._claim_write_targets(child)
+                                      ast.Starred)):
+                    # tuple unpacking: each element is its own
+                    # direct target, same kind
+                    self._claim_write_targets(child, kind)
+                elif isinstance(child, (ast.Attribute,
+                                        ast.Subscript)):
+                    # a store THROUGH an attribute chain
+                    # (self.snap.field = v) mutates the object the
+                    # field holds — it must void the atomic-publish
+                    # exemption exactly like a subscript store
+                    self._claim_write_targets(child, KIND_MUTATE)
 
     def visit_Assign(self, node: ast.Assign) -> None:
         for t in node.targets:
@@ -196,12 +262,13 @@ class _UnitVisitor(ast.NodeVisitor):
         self.generic_visit(node)
 
     def visit_AugAssign(self, node: ast.AugAssign) -> None:
-        self._claim_write_targets(node.target)
+        # read-modify-write: never atomic, whatever the target shape
+        self._claim_write_targets(node.target, KIND_MUTATE)
         self.generic_visit(node)
 
     def visit_Delete(self, node: ast.Delete) -> None:
         for t in node.targets:
-            self._claim_write_targets(t)
+            self._claim_write_targets(t, KIND_MUTATE)
         self.generic_visit(node)
 
     def visit_Call(self, node: ast.Call) -> None:
@@ -210,14 +277,14 @@ class _UnitVisitor(ast.NodeVisitor):
                 attr = _root_self_attr(node.func.value,
                                        self.unit.self_names)
                 if attr is not None:
-                    self._record(attr, True, node)
+                    self._record(attr, True, node, KIND_MUTATE)
                     for sub in ast.walk(node.func.value):
                         self._claimed.add(id(sub))
             self.unit.call_sites.append(_CallSite(
-                node.func.attr, self.locked, self.unit))
+                node.func.attr, self.locks, self.unit))
         elif isinstance(node.func, ast.Name):
             self.unit.call_sites.append(_CallSite(
-                node.func.id, self.locked, self.unit))
+                node.func.id, self.locks, self.unit))
         self.generic_visit(node)
 
     def visit_Attribute(self, node: ast.Attribute) -> None:
@@ -285,11 +352,13 @@ class _ClassAnalysis:
             self._visit_unit(unit)
 
 
-def _locked_methods(all_units: list[_Unit]) -> set[tuple[str, str]]:
-    """Least-fixpoint set of (class, method) treated as lock-held:
-    a method joins only once every observed external call site (by
-    bare name, within the module) provably holds a lock — lexically or
-    by sitting in an already-lock-held method.
+def _entry_locksets(all_units: list[_Unit]
+                    ) -> dict[tuple[str, str], frozenset]:
+    """Least-fixpoint map (class, method) -> set of locks provably
+    held at ENTRY: the intersection, over every observed external call
+    site (by bare name, within the module), of the locks held at that
+    site — lexically plus the caller's own inferred entry lockset.
+    A method with no provable common lock maps to the empty set.
 
     Only private methods (leading underscore) qualify — a public method
     is an API entry point and must take its own lock — and a method
@@ -323,35 +392,53 @@ def _locked_methods(all_units: list[_Unit]) -> set[tuple[str, str]]:
                   and not u.name.startswith("__")
                   and any(cs.unit is not u
                           for cs in relevant_sites(*key))}
-    # LEAST fixpoint, seeded from lexically-locked call sites: a method
-    # joins only once every external call site provably holds the lock.
-    # (A greatest fixpoint would let mutually-recursive helpers — e.g.
-    # a thread body referenced via Thread(target=self._loop), so the
-    # only observed calls are inside the cycle — vouch for each other
-    # and silently suppress real races.) Call sites inside the method
-    # itself are ignored: self-recursion preserves whatever lock state
-    # the external entries established.
-    locked: set[tuple[str, str]] = set()
+    # LEAST fixpoint, seeded from lexically-held locks at call sites:
+    # entry locksets start EMPTY and only grow as callers' own entry
+    # locksets are established. (A greatest fixpoint would let
+    # mutually-recursive helpers — e.g. a thread body referenced via
+    # Thread(target=self._loop), so the only observed calls are inside
+    # the cycle — vouch for each other and silently suppress real
+    # races.) Call sites inside the method itself are ignored:
+    # self-recursion preserves whatever lock state the external
+    # entries established.
+    entry: dict[tuple[str, str], frozenset] = \
+        {key: frozenset() for key in candidates}
+
+    def site_locks(cs: _CallSite) -> frozenset:
+        held = cs.locks
+        if cs.unit.is_method:
+            held = held | entry.get(
+                (cs.unit.cls_name, cs.unit.name), frozenset())
+        return held
+
     changed = True
     while changed:
         changed = False
-        for key in candidates - locked:
+        for key in candidates:
             own = method_unit[key]
             external = [cs for cs in relevant_sites(*key)
                         if cs.unit is not own]
-            if external and all(
-                    cs.locked or (cs.unit.is_method
-                                  and (cs.unit.cls_name,
-                                       cs.unit.name) in locked)
-                    for cs in external):
-                locked.add(key)
+            if not external:
+                continue
+            common = frozenset.intersection(
+                *[site_locks(cs) for cs in external])
+            if common != entry[key]:
+                entry[key] = common
                 changed = True
-    return locked
+    return entry
 
 
-@rule("lock-discipline")
-def lock_discipline(project: Project) -> list[Finding]:
-    findings: list[Finding] = []
+def class_analyses(project: Project) -> dict[str, tuple]:
+    """Per-class access/lockset analyses, shared by lock-discipline
+    and the lockset rule (races.py): computing them twice per run
+    doubled the cost of the most expensive rule family. Cached ON the
+    project instance so the data dies with the run — a module-level
+    cache would pin the last run's parsed package (ASTs plus walk
+    caches, several MB) for the life of the process."""
+    cached = getattr(project, "_locks_class_analyses", None)
+    if cached is not None:
+        return cached
+    out: dict[str, tuple] = {}
     for mod in project.in_scope(LOCK_SCOPES):
         analyses: list[_ClassAnalysis] = []
         for node in mod.tree.body:
@@ -360,10 +447,20 @@ def lock_discipline(project: Project) -> list[Finding]:
                 a.run()
                 analyses.append(a)
         all_units = [u for a in analyses for u in a.units]
-        locked = _locked_methods(all_units)
+        out[mod.relpath] = (mod, analyses,
+                            _entry_locksets(all_units))
+    project._locks_class_analyses = out
+    return out
+
+
+@rule("lock-discipline")
+def lock_discipline(project: Project) -> list[Finding]:
+    findings: list[Finding] = []
+    for mod, analyses, entry in class_analyses(project).values():
 
         def unit_locked(u: _Unit) -> bool:
-            return u.is_method and (u.cls_name, u.name) in locked
+            return u.is_method and bool(
+                entry.get((u.cls_name, u.name)))
 
         for a in analyses:
             guarded: dict[str, int] = {}  # attr -> a guarded-write line

@@ -41,9 +41,8 @@ def metric_name(project: Project) -> list[Finding]:
     # duplicate-kind detection
     seen: dict[str, tuple[str, str]] = {}
     for mod in project.modules:
-        for node in ast.walk(mod.tree):
-            if not isinstance(node, ast.Call) or \
-                    not isinstance(node.func, ast.Attribute):
+        for node in mod.calls():
+            if not isinstance(node.func, ast.Attribute):
                 continue
             attr = node.func.attr
             if attr in _REGISTER_METHODS:

@@ -86,10 +86,16 @@ def pool_discipline(project: Project) -> list[Finding]:
     for mod in project.modules:
         if mod.relpath.endswith("presto_tpu/memory.py"):
             continue  # the MemoryPool implementation itself
-        # ast.walk yields every function (nested included) exactly
-        # once; _scan_function skips nested bodies, so each function
-        # is analyzed as its own innermost scope
-        for fn in ast.walk(mod.tree):
+        # cheap pre-filter: no .reserve() call anywhere -> nothing to
+        # pair, skip the per-function scope scan entirely
+        if not any(isinstance(c.func, ast.Attribute)
+                   and c.func.attr == "reserve"
+                   for c in mod.calls()):
+            continue
+        # the shared walk yields every function (nested included)
+        # exactly once; _scan_function skips nested bodies, so each
+        # function is analyzed as its own innermost scope
+        for fn in mod.walk():
             if not isinstance(fn, (ast.FunctionDef,
                                    ast.AsyncFunctionDef)):
                 continue

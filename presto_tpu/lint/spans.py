@@ -35,7 +35,7 @@ def span_discipline(project: Project) -> list[Finding]:
     findings: list[Finding] = []
     for mod in project.modules:
         managed: set[int] = set()
-        for node in ast.walk(mod.tree):
+        for node in mod.walk():
             if isinstance(node, (ast.With, ast.AsyncWith)):
                 for item in node.items:
                     managed.add(id(item.context_expr))
@@ -44,9 +44,8 @@ def span_discipline(project: Project) -> list[Finding]:
                 if name and name.rsplit(".", 1)[-1] \
                         == "enter_context" and node.args:
                     managed.add(id(node.args[0]))
-        for node in ast.walk(mod.tree):
-            if not isinstance(node, ast.Call) \
-                    or not isinstance(node.func, ast.Attribute):
+        for node in mod.calls():
+            if not isinstance(node.func, ast.Attribute):
                 continue
             if node.func.attr not in _METHODS:
                 continue

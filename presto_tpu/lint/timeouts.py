@@ -28,9 +28,7 @@ _TARGETS = ("urlopen", "_urlopen")
 def timeout_discipline(project: Project) -> list[Finding]:
     findings: list[Finding] = []
     for mod in project.modules:
-        for node in ast.walk(mod.tree):
-            if not isinstance(node, ast.Call):
-                continue
+        for node in mod.calls():
             name = qual_name(node.func)
             if name is None:
                 continue

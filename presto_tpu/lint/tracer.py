@@ -33,8 +33,7 @@ import ast
 from typing import Iterator
 
 from presto_tpu.lint.core import (Finding, Project, SourceModule,
-                                  import_aliases, qual_name, rule,
-                                  walk_functions)
+                                  qual_name, rule, walk_functions)
 
 # directories whose functions run (transitively) under jax tracing
 TRACE_SCOPES = (
@@ -213,7 +212,7 @@ def _find_roots(mods: list[SourceModule], units: dict[tuple, _FnUnit],
     for mod in mods:
         aliases = alias_cache[mod.relpath]
         registry_decos = _registry_decorators(mod)
-        for node in ast.walk(mod.tree):
+        for node in mod.walk():
             if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 for dec in node.decorator_list:
                     target = dec.func if isinstance(dec, ast.Call) \
@@ -460,10 +459,11 @@ def _run_family(project: Project, keep: set[str]) -> list[Finding]:
     else:
         mods = project.in_scope(TRACE_SCOPES)
         units = _collect_units(mods)
-        # one alias table per module, shared by root finding,
-        # reachability, and the per-function checks: recomputing walks
-        # the whole module AST each time and dominates lint runtime
-        alias_cache = {m.relpath: import_aliases(m.tree) for m in mods}
+        # one alias table per module (core.py caches it), shared by
+        # root finding, reachability, and the per-function checks:
+        # recomputing walks the whole module AST each time and
+        # dominates lint runtime
+        alias_cache = {m.relpath: m.aliases for m in mods}
         roots, statics = _find_roots(mods, units, alias_cache)
         reach = _reachable(mods, units, roots, alias_cache)
         cached = []

@@ -228,7 +228,10 @@ class HeartbeatFailureDetector:
         self._thread: threading.Thread | None = None
 
     def start(self) -> None:
-        self._thread = threading.Thread(target=self._loop, daemon=True,
+        # context-free by design: the health sweeper outlives every
+        # query and pings on its own behalf — no trace/token/recorder
+        # belongs to it
+        self._thread = threading.Thread(target=self._loop, daemon=True,  # lint: disable=handoff
                                         name="presto-tpu-heartbeat")
         self._thread.start()
 
@@ -341,7 +344,10 @@ class ClusterCoordinator:
         stall every other query's lifetime enforcement behind serial
         10s connect timeouts."""
         threads = [
-            threading.Thread(
+            # context-free by design: best-effort cleanup DELETEs for
+            # a query that is already dead — there is no live trace,
+            # token, or recorder to hand over from the reaper thread
+            threading.Thread(  # lint: disable=handoff
                 target=w.delete_task, args=(query_id,),
                 kwargs={"timeout": 5.0}, daemon=True,
                 name=f"presto-tpu-cancel-{query_id}")

@@ -231,7 +231,11 @@ class QueryManager:
             group = self.resource_groups.select(user, sql)
 
             def start():
-                self.pool.submit(self._run, q, group)
+                # context-free by design: _run is the query ENTRY
+                # point — it opens the root trace and stats scopes
+                # itself (there is no ambient context to inherit; the
+                # submitting HTTP handler thread has none either)
+                self.pool.submit(self._run, q, group)  # lint: disable=handoff
 
             with self.lock:
                 self._tickets[qid] = (group, start)

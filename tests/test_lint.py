@@ -222,11 +222,13 @@ def test_lock_discipline_sees_outer_alias_in_nested_class(tmp_path):
     assert "do_GET" in findings[0].message
 
 
-def test_lock_discipline_scope_excludes_exec(tmp_path):
-    """Lock scope is parallel/, server/, memory.py — the same class in
-    exec/ is not checked (single-threaded per query there)."""
+def test_lock_discipline_scope_excludes_sql(tmp_path):
+    """Lock scopes cover the threaded subsystems (parallel/, server/,
+    exec/, obs/, ft/, templates/, memory.py, engine.py, session.py) —
+    the same class in the single-threaded SQL frontend is not
+    checked."""
     pkg = write_pkg(tmp_path,
-                    {"presto_tpu/exec/whatever.py": LOCK_FIXTURE})
+                    {"presto_tpu/sql/whatever.py": LOCK_FIXTURE})
     assert run_lint([pkg]) == []
 
 
@@ -342,6 +344,312 @@ def test_tracer_plain_wrapping_decorator_is_not_a_root(tmp_path):
     findings = run_lint([pkg])
     assert len(findings) == 1, [f.format() for f in findings]
     assert "kernel" in findings[0].message
+
+
+# -- field-level locksets (lockset) -----------------------------------------
+
+LOCKSET_FIXTURE = """
+    import threading
+
+    class Mixed:
+        def __init__(self):
+            self._a_lock = threading.Lock()
+            self._b_lock = threading.Lock()
+            self.state = 0        # written under BOTH locks: mixed
+            self.cache = {}       # mutated under A, read under B
+            self.snap = {}        # atomic whole-ref publish: blessed
+            self.published = ()   # init-only publication: exempt
+
+        def wa(self):
+            with self._a_lock:
+                self.state = 1
+
+        def wb(self):
+            with self._b_lock:
+                self.state = 2
+
+        def mut(self):
+            with self._a_lock:
+                self.cache["k"] = 1
+
+        def read_wrong_lock(self):
+            with self._b_lock:
+                return self.cache.get("k")
+
+        def publish(self):
+            with self._a_lock:
+                self.snap = dict(self.cache)
+
+        def read_snapshot(self):
+            with self._b_lock:
+                return self.snap  # atomic-swapped reference read
+
+        def read_published(self):
+            return self.published  # init-only: immutable after publish
+"""
+
+
+def test_lockset_mixed_and_disjoint_locks(tmp_path):
+    """The two defect classes lock-discipline cannot see: a field
+    written under two different locks, and a field written under lock
+    A but read under disjoint lock B — both sites 'hold a lock', yet
+    they do not exclude each other."""
+    pkg = write_pkg(tmp_path,
+                    {"presto_tpu/parallel/broken.py": LOCKSET_FIXTURE})
+    findings = run_lint([pkg], rules=["lockset"])
+    assert len(findings) == 2, [f.format() for f in findings]
+    msgs = " | ".join(f.message for f in findings)
+    assert "Mixed.state" in msgs and "mixed locksets" in msgs
+    assert "Mixed.cache" in msgs and "read_wrong_lock" in msgs
+    # the blessed idioms stay silent: atomic whole-reference publish
+    # read under an unrelated lock, and init-only publication
+    assert "snap" not in msgs and "published" not in msgs
+
+
+def test_lockset_helper_entry_lockset_inferred(tmp_path):
+    """locks.py's locked-helper inference feeds the lockset rule: a
+    private helper whose every call site holds lock A carries {A} as
+    its entry lockset, so its accesses agree with A-guarded writes —
+    but a reader under lock B is still disjoint."""
+    pkg = write_pkg(tmp_path, {"presto_tpu/server/broken.py": """
+        import threading
+
+        class Svc:
+            def __init__(self):
+                self._a_lock = threading.Lock()
+                self._b_lock = threading.Lock()
+                self.items = {}
+
+            def put(self, k, v):
+                with self._a_lock:
+                    self.items[k] = v
+                    self._compact()
+
+            def drop(self, k):
+                with self._a_lock:
+                    self.items.pop(k, None)
+                    self._compact()
+
+            def _compact(self):
+                self.items.clear()  # entry lockset {_a_lock}: fine
+
+            def peek_wrong(self):
+                with self._b_lock:
+                    return self.items.get(None)  # disjoint: flagged
+    """})
+    findings = run_lint([pkg], rules=["lockset"])
+    assert len(findings) == 1, [f.format() for f in findings]
+    assert "peek_wrong" in findings[0].message
+    assert "_b_lock" in findings[0].message
+
+
+def test_lockset_attribute_store_voids_atomic_publish(tmp_path):
+    """`self.snap.field = v` mutates the published object — it must
+    void the atomic-swap exemption exactly like a subscript store, or
+    disjoint-lock readers of the mutated object pass silently."""
+    pkg = write_pkg(tmp_path, {"presto_tpu/parallel/broken.py": """
+        import threading
+
+        class Pub:
+            def __init__(self):
+                self._a_lock = threading.Lock()
+                self._b_lock = threading.Lock()
+                self.snap = object()
+
+            def publish(self):
+                with self._a_lock:
+                    self.snap = object()
+
+            def poke(self):
+                with self._a_lock:
+                    self.snap.field = 5  # mutation, not a swap
+
+            def read_other_lock(self):
+                with self._b_lock:
+                    return self.snap  # NOT exempt: snap is mutated
+    """})
+    findings = run_lint([pkg], rules=["lockset"])
+    assert len(findings) == 1, [f.format() for f in findings]
+    assert "read_other_lock" in findings[0].message
+
+
+def test_lockset_suppressible_with_justification(tmp_path):
+    pkg = write_pkg(tmp_path, {"presto_tpu/parallel/broken.py": """
+        import threading
+
+        class Grower:
+            def __init__(self):
+                self._a_lock = threading.Lock()
+                self._b_lock = threading.Lock()
+                self.hits = 0
+
+            def wa(self):
+                with self._a_lock:
+                    self.hits += 1
+
+            def wb(self):
+                # benign racy counter: a lost update only skews a
+                # diagnostic number
+                with self._b_lock:
+                    self.hits += 1  # lint: disable=lockset
+    """})
+    assert run_lint([pkg], rules=["lockset"]) == []
+
+
+def test_lockset_scope_matches_lock_scopes(tmp_path):
+    """exec/ and engine.py are in scope now (parallel segment
+    compilation shares them across threads); sql/ stays out."""
+    pkg = write_pkg(tmp_path,
+                    {"presto_tpu/exec/broken.py": LOCKSET_FIXTURE,
+                     "presto_tpu/sql/broken.py": LOCKSET_FIXTURE})
+    findings = run_lint([pkg], rules=["lockset"])
+    assert {f.path for f in findings} == {"presto_tpu/exec/broken.py"}
+
+
+# -- ambient-context thread handoff (handoff) --------------------------------
+
+HANDOFF_FIXTURE = """
+    import threading
+    from concurrent.futures import ThreadPoolExecutor
+
+    from presto_tpu.exec import cancel as CANCEL
+    from presto_tpu.obs.trace import TRACER, current_context
+
+    def traced_work(plan):
+        with TRACER.span("work"):
+            return plan
+
+    def leaky_thread(plan):
+        # drops TRACER context AND the cancel token
+        t = threading.Thread(target=traced_work, args=(plan,))
+        t.start()
+        return t
+
+    def leaky_pool(plans):
+        with ThreadPoolExecutor(max_workers=2) as pool:
+            return list(pool.map(traced_work, plans))
+
+    def careful_thread(plan):
+        ctx = current_context()
+        tok = CANCEL.current()
+
+        def work():
+            CANCEL.install(tok)
+            with TRACER.attach(ctx):
+                return traced_work(plan)
+
+        threading.Thread(target=work).start()
+
+    def fresh_scope_thread(tid):
+        def work():
+            with TRACER.trace(tid, "task"):
+                return tid
+
+        threading.Thread(target=work).start()
+
+    def suppressed_sweeper():
+        # daemon metrics scraper: deliberately context-free
+        threading.Thread(target=print, daemon=True).start()  # lint: disable=handoff
+"""
+
+
+def test_handoff_flags_context_dropping_spawns(tmp_path):
+    pkg = write_pkg(tmp_path,
+                    {"presto_tpu/parallel/broken.py": HANDOFF_FIXTURE})
+    findings = run_lint([pkg], rules=["handoff"])
+    assert len(findings) == 2, [f.format() for f in findings]
+    msgs = " | ".join(f.message for f in findings)
+    assert "threading.Thread" in msgs and "pool.map" in msgs
+    assert all("ambient" in f.message for f in findings)
+    # explicit capture+attach, fresh-scope establishment, and the
+    # justified suppression all pass
+    lines = {f.line for f in findings}
+    src = textwrap.dedent(HANDOFF_FIXTURE)
+    for fn in ("careful_thread", "fresh_scope_thread",
+               "suppressed_sweeper"):
+        start = src.count("\n", 0, src.index(f"def {fn}")) + 1
+        assert all(not (start <= ln <= start + 8) for ln in lines), fn
+
+
+def test_handoff_ignores_ambient_free_modules(tmp_path):
+    """A module that never touches ambient context cannot drop it:
+    its threads are out of scope by construction."""
+    pkg = write_pkg(tmp_path, {"presto_tpu/server/clean.py": """
+        import threading
+
+        def serve(httpd):
+            threading.Thread(target=httpd.serve_forever,
+                             daemon=True).start()
+    """})
+    assert run_lint([pkg], rules=["handoff"]) == []
+
+
+def test_handoff_sees_module_level_executor_attr(tmp_path):
+    """The QueryManager shape: the pool is constructed in __init__,
+    submit happens in another method — the attribute name links them."""
+    pkg = write_pkg(tmp_path, {"presto_tpu/server/broken.py": """
+        from concurrent.futures import ThreadPoolExecutor
+        from presto_tpu.obs.trace import TRACER
+
+        class Manager:
+            def __init__(self):
+                self.pool = ThreadPoolExecutor(max_workers=4)
+
+            def submit(self, q):
+                with TRACER.span("submit"):
+                    self.pool.submit(print, q)
+    """})
+    findings = run_lint([pkg], rules=["handoff"])
+    assert len(findings) == 1, [f.format() for f in findings]
+    assert "pool.submit" in findings[0].message
+
+
+# -- stale suppressions ------------------------------------------------------
+
+
+def test_stale_suppression_reported(tmp_path):
+    """A disable comment whose finding was fixed must not outlive the
+    code it excused — it would silently swallow the NEXT finding."""
+    pkg = write_pkg(tmp_path, {"presto_tpu/exec/fine.py": """
+        import urllib.request
+
+        def fine(req):
+            return urllib.request.urlopen(req, timeout=5)  # lint: disable=timeout-discipline
+    """})
+    findings = run_lint([pkg])
+    assert [f.rule for f in findings] == ["stale-suppression"]
+    assert "timeout-discipline" in findings[0].message
+
+
+def test_stale_suppression_respects_rule_subset(tmp_path):
+    """A --rules subset run cannot judge another rule's suppression:
+    the timeout-discipline disable is only stale when that rule ran."""
+    pkg = write_pkg(tmp_path, {"presto_tpu/exec/fine.py": """
+        x = 1  # lint: disable=timeout-discipline
+    """})
+    assert run_lint([pkg], rules=["span-discipline"]) == []
+    stale = run_lint([pkg], rules=["timeout-discipline"])
+    assert [f.rule for f in stale] == ["stale-suppression"]
+
+
+def test_stale_blanket_suppression_full_run_only(tmp_path):
+    pkg = write_pkg(tmp_path, {"presto_tpu/exec/fine.py": """
+        x = 1  # lint: disable
+    """})
+    assert run_lint([pkg], rules=["timeout-discipline"]) == []
+    full = run_lint([pkg])
+    assert [f.rule for f in full] == ["stale-suppression"]
+    assert "blanket" in full[0].message
+
+
+def test_used_suppression_not_stale(tmp_path):
+    pkg = write_pkg(tmp_path, {"presto_tpu/exec/broken.py": """
+        import urllib.request
+
+        def bad(req):
+            return urllib.request.urlopen(req)  # lint: disable=timeout-discipline
+    """})
+    assert run_lint([pkg]) == []
 
 
 # -- timeout discipline -----------------------------------------------------
@@ -625,6 +933,9 @@ def test_per_line_suppression(tmp_path):
 
 
 def test_suppression_is_rule_specific(tmp_path):
+    """A suppression for rule A does not cover rule B's finding on
+    the same line — and naming a nonexistent rule is itself reported
+    (the typo'd disable suppresses nothing while looking load-bearing)."""
     pkg = write_pkg(tmp_path, {"presto_tpu/exec/broken.py": """
         import jax
         import jax.numpy as jnp
@@ -635,7 +946,10 @@ def test_suppression_is_rule_specific(tmp_path):
                 return x
             return x
     """})
-    assert rules_of(run_lint([pkg])) == {"tracer-branch"}
+    findings = run_lint([pkg])
+    assert rules_of(findings) == {"tracer-branch", "stale-suppression"}
+    stale = [f for f in findings if f.rule == "stale-suppression"]
+    assert "unknown rule 'some-other-rule'" in stale[0].message
 
 
 def test_cli_exit_codes_and_json(tmp_path, capsys):
@@ -660,6 +974,82 @@ def test_cli_rule_subset(tmp_path):
     })
     only_locks = run_lint([pkg], rules=["lock-discipline"])
     assert rules_of(only_locks) == {"lock-discipline"}
+
+
+def _git(cwd, *args):
+    import subprocess
+    subprocess.run(
+        ["git", "-c", "user.email=t@t", "-c", "user.name=t", *args],
+        cwd=cwd, check=True, capture_output=True, text=True)
+
+
+def test_changed_mode_scopes_reporting_to_changed_files(tmp_path,
+                                                        capsys):
+    """--changed (the pre-commit mode) reports only findings in files
+    touched since HEAD — committed-clean files stay quiet even when
+    they carry findings, because the full-tree gate still owns them."""
+    pkg = write_pkg(tmp_path, {
+        "presto_tpu/exec/committed.py": """
+            import urllib.request
+
+            def bad(req):
+                return urllib.request.urlopen(req)
+        """,
+    })
+    _git(tmp_path, "init", "-q")
+    _git(tmp_path, "add", "-A")
+    _git(tmp_path, "commit", "-qm", "seed")
+    write_pkg(tmp_path, {"presto_tpu/exec/fresh.py": """
+        import urllib.request
+
+        def also_bad(req):
+            return urllib.request.urlopen(req)
+    """})
+    assert lint_main([str(pkg), "--changed", "--json",
+                      "--rules", "timeout-discipline"]) == 1
+    payload = json.loads(capsys.readouterr().out)
+    assert {f["path"] for f in payload} == \
+        {"presto_tpu/exec/fresh.py"}
+    # a clean worktree lints clean instantly
+    _git(tmp_path, "add", "-A")
+    _git(tmp_path, "commit", "-qm", "more")
+    assert lint_main([str(pkg), "--changed"]) == 0
+    assert "no changed" in capsys.readouterr().err
+    # ...but the fast exit still validates its inputs: a typo'd rule
+    # in a pre-commit hook must fail on every run, not only when the
+    # worktree happens to be dirty
+    assert lint_main([str(pkg), "--changed",
+                      "--rules", "definitely-not-a-rule"]) == 2
+    assert "unknown lint rules" in capsys.readouterr().err
+
+
+def test_changed_mode_outside_git_is_usage_error(tmp_path, capsys):
+    """Outside a git checkout --changed errors loudly (exit 2): a
+    silent 'clean' from a misconfigured pre-commit hook would defeat
+    the gate."""
+    import subprocess
+    probe = subprocess.run(
+        ["git", "-C", str(tmp_path), "rev-parse", "--show-toplevel"],
+        capture_output=True, text=True)
+    if probe.returncode == 0:  # tmp dir landed inside some repo
+        pytest.skip("tmp_path is inside a git repo")
+    pkg = write_pkg(tmp_path,
+                    {"presto_tpu/exec/nothing.py": "x = 1\n"})
+    assert lint_main([str(pkg), "--changed"]) == 2
+    assert "git" in capsys.readouterr().err
+
+
+def test_full_suite_wall_time_budget():
+    """One shared parsed-AST project model serves every rule: the
+    whole-package run must stay inside an interactive budget (locally
+    ~3 s; the bound leaves headroom for a loaded CI container but
+    catches the per-rule re-walk regression class, which tripled it)."""
+    import time
+    t0 = time.perf_counter()
+    findings = run_lint([REPO / "presto_tpu"])
+    wall = time.perf_counter() - t0
+    assert findings == []
+    assert wall < 12.0, f"full lint suite took {wall:.1f}s"
 
 
 def test_subtree_run_still_checks_dispatch_against_real_registry():
