@@ -63,6 +63,13 @@ the concurrent-serving scale metric. Knobs:
 PRESTO_TPU_BENCH_SERVE_CLIENTS (4), PRESTO_TPU_BENCH_SERVE_S (20),
 PRESTO_TPU_BENCH_SERVE_SF (0.01).
 
+``PRESTO_TPU_BENCH_SKEW=zipf:<s>`` additionally measures q05/q09
+against a Zipf(s)-skewed datagen variant (lineitem part/supplier FKs
+and orders custkeys follow bounded Zipf over the key space),
+reporting ``qNN_skew_rows_per_sec`` and ``qNN_skew_vs_uniform`` — the
+skew-aware join work (cost/skew.py hybrid distribution + salting,
+MultiJoin) is graded on that ratio staying near 1.
+
 Env knobs: PRESTO_TPU_BENCH_SF (default 10), PRESTO_TPU_BENCH_REPS (2),
 PRESTO_TPU_BENCH_BUDGET_S (default 600), PRESTO_TPU_BENCH_Q9_RESERVE_S
 (default 150 — Q9's guaranteed slice), PRESTO_TPU_TPCH_CACHE (default
@@ -106,7 +113,10 @@ name = sys.argv[1]
 sf = float(sys.argv[2])
 reps = int(sys.argv[3])
 engine = Engine()
-engine.register_catalog("tpch", TpchConnector(scale=sf))
+# skew mode (PRESTO_TPU_BENCH_SKEW): the parent arms this for the
+# dedicated q05/q09 skew measurements only
+engine.register_catalog("tpch", TpchConnector(
+    scale=sf, skew=os.environ.get("PRESTO_TPU_BENCH_SKEW_ACTIVE") or None))
 plan, _ = engine.plan_sql(QUERIES[name])
 compiles = REGISTRY.counter("presto_tpu_programs_compiled_total")
 compile_hist = REGISTRY.histogram("presto_tpu_compile_seconds")
@@ -161,22 +171,28 @@ VARIANTS = {
 
 
 def measure_query(name: str, sf: float, reps: int,
-                  timeout_s: float) -> dict:
+                  timeout_s: float, skew: str | None = None) -> dict:
     """One query's (first, steady) walls + compile attribution and
     program-cache counters, isolated in a subprocess. With
     PRESTO_TPU_PROGRAM_CACHE_DIR set (bench default) a SECOND call for
     the same query measures the warm start: the fresh process loads
     the AOT executables from the persistent store instead of
-    compiling."""
+    compiling. ``skew`` ("zipf:<s>") points the child at the
+    Zipf-skewed datagen variant (PRESTO_TPU_BENCH_SKEW mode)."""
     t0 = time.perf_counter()
     argv = [sys.executable, "-c", _CHILD, name, str(sf), str(reps)]
-    if name in VARIANTS and reps > 0:
+    if name in VARIANTS and reps > 0 and not skew:
         # variant rides the COLD child only: the warm-start probe
         # (reps=0) measures the persistent cache, not templates
         argv.append(VARIANTS[name])
+    env = dict(os.environ)
+    env.pop("PRESTO_TPU_BENCH_SKEW_ACTIVE", None)
+    if skew:
+        env["PRESTO_TPU_BENCH_SKEW_ACTIVE"] = skew
     try:
         proc = subprocess.run(
             argv, capture_output=True, text=True, timeout=timeout_s,
+            env=env,
             cwd=os.path.dirname(os.path.abspath(__file__)))
     except subprocess.TimeoutExpired:
         return {"error": "timed out"}
@@ -640,6 +656,43 @@ def main() -> None:
         if base:
             detail[f"{name}_vs_baseline"] = round(
                 base / r["steady_s"], 2)
+
+    # Zipf-skew measurements (PRESTO_TPU_BENCH_SKEW=zipf:<s>): q05/q09
+    # rerun against the Zipf-skewed datagen variant, so skew
+    # regressions — one hot key collapsing the all_to_all onto a
+    # single shard, capacity-overflow retry ladders — become visible
+    # the way cold-compile ones did. The skew-aware join paths
+    # (cost/skew.py hybrid distribution + salting, MultiJoin) are what
+    # keeps these within range of the uniform numbers.
+    skew = os.environ.get("PRESTO_TPU_BENCH_SKEW")
+    if skew:
+        detail["skew"] = skew
+        t0 = time.perf_counter()
+        try:
+            TpchConnector(scale=sf, skew=skew).table("lineitem")
+            detail["skew_datagen_s"] = round(time.perf_counter() - t0,
+                                             1)
+        except Exception as exc:  # bad spec must not kill the bench
+            detail["skew_error"] = repr(exc)[:200]
+            skew = None
+    for name in ("q05", "q09") if skew else ():
+        left = budget - (time.perf_counter() - t_start)
+        if left <= 60:
+            detail[f"{name}_skew_skipped"] = "bench time budget " \
+                                             "exhausted"
+            continue
+        r = measure_query(name, sf, reps, left - 15, skew=skew)
+        if "error" in r:
+            detail[f"{name}_skew_error"] = r["error"]
+            continue
+        detail[f"{name}_skew_rows_per_sec"] = round(
+            nrows / r["steady_s"])
+        detail[f"{name}_skew_programs_compiled"] = r.get(
+            "programs_compiled")
+        uni = detail.get(f"{name}_rows_per_sec")
+        if uni:
+            detail[f"{name}_skew_vs_uniform"] = round(
+                detail[f"{name}_skew_rows_per_sec"] / uni, 3)
 
     # warm starts LAST, so they can only spend what the cold
     # measurements (the driver's metrics, budget-shaped exactly as

@@ -200,9 +200,20 @@ def _ps_suppkey(partkey: np.ndarray, i: np.ndarray, s: int) -> np.ndarray:
 
 
 class TpchGenerator:
-    def __init__(self, scale: float, seed: int = 19920101):
+    """``zipf`` (exponent s, None = spec-uniform) skews the FK draws
+    that drive join distribution — lineitem's part keys (and through
+    the spec's supplier formula, its supplier keys) and orders'
+    customer keys follow a bounded Zipf(s) over the key space — so
+    skew-aware join benchmarks (bench.py PRESTO_TPU_BENCH_SKEW) and
+    the hybrid-distribution oracle tests exercise heavy hitters on
+    real TPC-H shapes. Primary keys, payload columns and row counts
+    stay exactly the uniform generator's."""
+
+    def __init__(self, scale: float, seed: int = 19920101,
+                 zipf: float | None = None):
         self.scale = scale
         self.seed = seed
+        self.zipf = zipf
         self.n_supplier = max(int(10_000 * scale), 40)
         self.n_part = max(int(200_000 * scale), 200)
         self.n_customer = max(int(150_000 * scale), 150)
@@ -210,6 +221,22 @@ class TpchGenerator:
 
     def _rng(self, salt: int) -> np.random.Generator:
         return np.random.default_rng([self.seed, salt])
+
+    def _fk(self, rng: np.random.Generator, n_keys: int,
+            size: int) -> np.ndarray:
+        """FK column over 1..n_keys: uniform, or bounded Zipf(s) via
+        inverse-CDF when skewed. Ranks scatter over the key space with
+        a fixed odd multiplier so heavy hitters are not the
+        consecutive low ids (which dense-key direct tables would
+        otherwise make artificially cheap)."""
+        if not self.zipf:
+            return rng.integers(1, n_keys + 1, size).astype(np.int64)
+        w = 1.0 / np.power(
+            np.arange(1, n_keys + 1, dtype=np.float64), self.zipf)
+        cdf = np.cumsum(w)
+        cdf /= cdf[-1]
+        ranks = np.searchsorted(cdf, rng.random(size), side="left")
+        return (ranks.astype(np.int64) * 2654435761 % n_keys) + 1
 
     def region(self):
         return {
@@ -318,8 +345,9 @@ class TpchGenerator:
         rng = self._rng(8)
         n = self.n_orders
         okeys = np.arange(1, n + 1, dtype=np.int64)
-        # custkey: uniform over customers, excluding multiples of 3 (spec 4.2.3)
-        ck = rng.integers(1, self.n_customer + 1, n).astype(np.int64)
+        # custkey: uniform (or Zipf-skewed) over customers, excluding
+        # multiples of 3 (spec 4.2.3)
+        ck = self._fk(rng, self.n_customer, n)
         bump = ck % 3 == 0
         ck = np.where(bump, np.maximum((ck + 1) % (self.n_customer + 1), 1), ck)
         ck = np.where(ck % 3 == 0, np.maximum(ck - 2, 1), ck)
@@ -336,7 +364,7 @@ class TpchGenerator:
               - np.repeat(starts, counts) + 1)
 
         lrng = self._rng(9)
-        lpk = lrng.integers(1, self.n_part + 1, total_lines).astype(np.int64)
+        lpk = self._fk(lrng, self.n_part, total_lines)
         lsk = _ps_suppkey(
             lpk, lrng.integers(0, 4, total_lines), self.n_supplier)
         qty = lrng.integers(1, 51, total_lines).astype(np.int64)
@@ -417,13 +445,24 @@ class TpchGenerator:
 
 
 class TpchConnector(Connector):
-    """Catalog `tpch` with one schema per scale factor (tiny = 0.01)."""
+    """Catalog `tpch` with one schema per scale factor (tiny = 0.01).
+    ``skew`` = None (spec-uniform) or "zipf:<s>" / float s — Zipf-skew
+    the FK columns (see TpchGenerator)."""
 
     name = "tpch"
 
-    def __init__(self, scale: float = 0.01, seed: int = 19920101):
+    def __init__(self, scale: float = 0.01, seed: int = 19920101,
+                 skew: str | float | None = None):
         self.scale = scale
-        self.gen = TpchGenerator(scale, seed)
+        zipf = None
+        if isinstance(skew, str) and skew:
+            kind, _, arg = skew.partition(":")
+            if kind.strip().lower() != "zipf":
+                raise ValueError(f"unknown skew mode: {skew!r}")
+            zipf = float(arg or 1.0)
+        elif skew:
+            zipf = float(skew)
+        self.gen = TpchGenerator(scale, seed, zipf=zipf)
         self._cache: dict[str, dict[str, np.ndarray]] = {}
         self._tables: dict[str, Table] = {}
 
@@ -459,8 +498,9 @@ class TpchConnector(Connector):
         d = os.environ.get("PRESTO_TPU_TPCH_CACHE")
         if not d:
             return None
+        tag = (f"_zipf{self.gen.zipf:g}" if self.gen.zipf else "")
         return os.path.join(
-            d, f"tpch_sf{self.scale:g}_s{self.gen.seed}_{name}")
+            d, f"tpch_sf{self.scale:g}_s{self.gen.seed}{tag}_{name}")
 
     def _disk_load(self, name: str):
         import os

@@ -12,7 +12,11 @@ The engine's analog of the reference's ``io.trino.cost`` package:
   chooser consults;
 - :mod:`presto_tpu.cost.reorder` — the ReorderJoins optimizer rule (DP
   up to 8 relations, greedy above), wired into plan/optimizer.py
-  behind ``optimizer_join_reordering_strategy``.
+  behind ``optimizer_join_reordering_strategy``;
+- :mod:`presto_tpu.cost.skew` — the heavy-hitter/salting decision
+  refining "partitioned" into "hybrid" joins (hot build keys
+  broadcast, cold tail hash-partitioned) from ledger-seeded NDV
+  statistics.
 """
 
 from __future__ import annotations
@@ -21,14 +25,15 @@ from presto_tpu.cost.model import (CostCalculator, PlanCostEstimate,
                                    decide_join_distribution,
                                    dense_span_eligible)
 from presto_tpu.cost.reorder import reorder_joins
+from presto_tpu.cost.skew import SkewDecision, decide_skew
 from presto_tpu.cost.stats import (PlanNodeStatsEstimate, StatsCalculator,
                                    SymbolStats)
 
 __all__ = [
     "CostCalculator", "PlanCostEstimate", "PlanNodeStatsEstimate",
-    "StatsCalculator", "SymbolStats", "decide_join_distribution",
-    "dense_span_eligible", "explain_estimates", "reorder_joins",
-    "row_estimates",
+    "SkewDecision", "StatsCalculator", "SymbolStats",
+    "decide_join_distribution", "decide_skew", "dense_span_eligible",
+    "explain_estimates", "reorder_joins", "row_estimates",
 ]
 
 

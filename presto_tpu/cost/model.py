@@ -59,8 +59,11 @@ def decide_join_distribution(node_distribution: str | None,
     DetermineJoinDistributionType): an explicit per-node distribution
     wins, then a forced session mode, then the AUTOMATIC row-count
     threshold (unknown build size broadcasts, matching the historical
-    behavior of both the fragmenter and the runtime executor)."""
-    if node_distribution in ("broadcast", "partitioned"):
+    behavior of both the fragmenter and the runtime executor).
+    "hybrid" (skew-aware hot-key broadcast + cold-tail partition,
+    cost/skew.py) is a per-node refinement of "partitioned": callers
+    without a hybrid path treat it as partitioned."""
+    if node_distribution in ("broadcast", "partitioned", "hybrid"):
         return node_distribution
     m = (mode or "automatic").lower()
     if m == "broadcast":
@@ -170,6 +173,26 @@ class CostCalculator:
                                   node.right.output_types(),
                                   node.left.output_types(),
                                   node.distribution)
+        if isinstance(node, N.MultiJoin):
+            # fused star chain: each build priced like the binary join
+            # it replaced (its own distribution), the probe estimate
+            # FOLDING forward through each leg's unique-build
+            # containment — an early selective dimension shrinks every
+            # later leg's priced probe, exactly as the cascade's
+            # per-join stats would
+            total = PlanCostEstimate(est.row_count, 0, 0)
+            cur = stats.stats(node.spine)
+            for i, build in enumerate(node.builds):
+                b = stats.stats(build)
+                dist = (node.distributions[i]
+                        if i < len(node.distributions) else "automatic")
+                out_rows = max(
+                    cur.row_count * min(b.selectivity, 1.0), 1.0)
+                total = total.plus(self.join_cost(
+                    cur, b, out_rows, build.output_types(),
+                    node.spine.output_types(), dist))
+                cur = dataclasses.replace(cur, row_count=out_rows)
+            return total
         if isinstance(node, N.SemiJoin):
             src = stats.stats(node.source)
             filt = stats.stats(node.filter_source)

@@ -36,7 +36,9 @@ from __future__ import annotations
 
 import dataclasses
 
-from presto_tpu.cost.model import CostCalculator, decide_join_distribution
+from presto_tpu.cost.model import (CostCalculator, DEFAULT_MESH_SHARDS,
+                                   decide_join_distribution)
+from presto_tpu.cost.skew import decide_skew
 from presto_tpu.cost.stats import StatsCalculator
 from presto_tpu.ops.hash import next_pow2
 from presto_tpu.plan import nodes as N
@@ -76,13 +78,35 @@ class _Ctx:
         session = getattr(engine, "session", None)
         self.mode = "automatic"
         self.threshold = None
+        self.hot_threshold = 0
+        self.max_salt = 0
         if session is not None:
             self.mode = str(session.get(
                 "join_distribution_type") or "automatic").lower()
             self.threshold = int(session.get(
                 "broadcast_join_threshold_rows"))
+            self.hot_threshold = int(session.get(
+                "skew_hot_key_threshold") or 0)
+            self.max_salt = int(session.get("join_salting") or 0)
         self.cost = CostCalculator(
             broadcast_threshold=self.threshold)
+
+    def _skewed(self, dist: str, probe_est, build_est, criteria,
+                build_unique: bool) -> tuple[str, int | None, int | None]:
+        """Refine a plan-time "partitioned" choice with the skew
+        decision (cost/skew.py): returns (distribution, hot_keys,
+        salt_factor) to write into the Join node."""
+        if dist != "partitioned":
+            return dist, None, None
+        d = decide_skew(probe_est, build_est, criteria, build_unique,
+                        join_type_inner=True,
+                        nshards=DEFAULT_MESH_SHARDS,
+                        hot_threshold=self.hot_threshold,
+                        max_salt=self.max_salt)
+        if not d.active:
+            return dist, None, None
+        return (("hybrid" if d.hybrid else dist), d.hot_keys,
+                (d.salt_factor if d.salt_factor > 1 else None))
 
     # -- tree walk ----------------------------------------------------------
 
@@ -162,9 +186,12 @@ class _Ctx:
             p_est, b_est, criteria, build_unique)
         build_rows = next_pow2(max(int(b_est.row_count), 1))
         dist = "automatic"
+        hot_keys = salt = None
         if self.mode == "automatic":
             dist = decide_join_distribution(
                 None, self.mode, build_rows, self.threshold)
+            dist, hot_keys, salt = self._skewed(
+                dist, p_est, b_est, criteria, build_unique)
         out_cap = None
         if not build_unique:
             # conservative hint, same bound as the planner: an
@@ -177,6 +204,7 @@ class _Ctx:
         return N.Join(
             probe, build, N.JoinType.INNER, list(criteria), None,
             build_unique, distribution=dist, build_rows=build_rows,
+            hot_keys=hot_keys, salt_factor=salt,
             capacity=next_pow2(2 * max(int(b_est.row_count), 1)),
             output_capacity=out_cap)
 
@@ -275,13 +303,17 @@ class _Ctx:
         b_est = self.stats.stats(right)
         build_rows = next_pow2(max(int(b_est.row_count), 1))
         dist = out.distribution
+        hot_keys, salt = out.hot_keys, out.salt_factor
         if dist == "automatic" and self.mode == "automatic":
             dist = decide_join_distribution(
                 None, self.mode, build_rows, self.threshold)
+            dist, hot_keys, salt = self._skewed(
+                dist, self.stats.stats(left), b_est,
+                node.criteria, node.build_unique)
         return dataclasses.replace(
             out, build_rows=build_rows,
             capacity=next_pow2(2 * max(int(b_est.row_count), 1)),
-            distribution=dist)
+            distribution=dist, hot_keys=hot_keys, salt_factor=salt)
 
 
 def _connecting(edges: list, mask: int, j: int

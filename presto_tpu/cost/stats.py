@@ -29,25 +29,44 @@ The dispatch table is registered with the plan-dispatch lint rule
 from __future__ import annotations
 
 import dataclasses
+import math
+import re
 
 from presto_tpu.plan import nodes as N
-from presto_tpu.plan.stats import UNKNOWN_FILTER_COEFFICIENT, selectivity
+from presto_tpu.plan.stats import (UNKNOWN_FILTER_COEFFICIENT,
+                                   selectivity, selectivity_informed)
+
+_SYM_SUFFIX = re.compile(r"_\d+$")
+
+
+def base_symbol(sym: str) -> str:
+    """Strip the planner's per-statement ``_NN`` suffix so observation
+    keys ("n_name") pool across statements that allocate different
+    symbol numbers for the same base column (the divergence ledger and
+    this calculator must agree on the spelling). Strips exactly ONE
+    trailing suffix: every symbol the planner allocates carries one,
+    so a base column itself named with a digit suffix ("address_1" ->
+    symbol "address_1_17") round-trips correctly."""
+    return _SYM_SUFFIX.sub("", sym)
+
 
 def predicate_shape(expr) -> str:
     """Literal-normalized structural shape of a predicate expression
     ("lte(l_shipdate, ?)"): the key the divergence ledger
     (obs/qstats.py) aggregates observed selectivity under, so every
     literal variant of one predicate shape — the plan-template notion
-    of sameness — pools into a single observation series. This is the
-    lookup key a future stats-feedback rule in this calculator will
-    consult (ROADMAP item 4); shipped observation-only."""
+    of sameness — pools into a single observation series. The
+    ``_s_filter`` rule below consults it (ROADMAP item 4's feedback
+    loop: observed selectivity outranks the static guess)."""
     from presto_tpu.expr import ir
 
     def walk(e) -> str:
         if isinstance(e, (ir.Literal, ir.Parameter)):
             return "?"
         if isinstance(e, ir.ColumnRef):
-            return e.name
+            # planner symbol suffixes are per-statement; the base
+            # column name pools one predicate shape across statements
+            return base_symbol(e.name)
         if isinstance(e, ir.Call):
             return (f"{e.fn}("
                     + ", ".join(walk(a) for a in e.args) + ")")
@@ -171,6 +190,54 @@ class StatsCalculator:
                                          dict(inner.symbols), False)
         return PlanNodeStatsEstimate(UNKNOWN_ROWS, {}, False)
 
+    # -- observed-statistics feedback (the divergence ledger) ----------------
+    #
+    # Stability contract: estimates flow into pow2-bucketed plan
+    # annotations (capacities, build_rows, skew decisions) that key the
+    # compiled-program/template caches, so feedback must not wobble
+    # them. An observation is admitted only when the static estimate is
+    # MATERIALLY wrong (>= FEEDBACK_BAND off — the divergence class the
+    # ledger exists to catch), and the admitted value is pow2-quantized
+    # so nearby observations of one shape produce identical plans. A
+    # corrected shape costs exactly one recompile, then every literal
+    # variant keeps hitting.
+
+    FEEDBACK_BAND = 4.0
+
+    @classmethod
+    def _material(cls, static: float, observed: float) -> bool:
+        hi = max(static, observed)
+        lo = max(min(static, observed), 1e-30)
+        return hi / lo >= cls.FEEDBACK_BAND
+
+    @staticmethod
+    def _quant(value: float) -> float:
+        """pow2 quantization for counts (>= 1) and fractions alike."""
+        if value <= 0:
+            return 1.0
+        return float(2.0 ** round(math.log2(value)))
+
+    @staticmethod
+    def _ledger():
+        """PR 8's divergence ledger: per-(table, predicate-shape)
+        observed selectivity and per-(table, keys) observed NDV. Lazy
+        import — obs/qstats imports this module for predicate_shape."""
+        from presto_tpu.obs.qstats import DIVERGENCE
+        return DIVERGENCE
+
+    @staticmethod
+    def _scan_table(node: N.PlanNode) -> str | None:
+        """catalog.table of the single base scan under ``node``
+        (through Filters/Projects), or None."""
+        cur = node
+        while True:
+            if isinstance(cur, N.TableScan):
+                return (None if str(cur.catalog).startswith("__")
+                        else f"{cur.catalog}.{cur.table}")
+            if not isinstance(cur, (N.Filter, N.Project)):
+                return None
+            cur = cur.source
+
     # -- leaves -------------------------------------------------------------
 
     def _s_tablescan(self, node: N.TableScan) -> PlanNodeStatsEstimate:
@@ -186,10 +253,20 @@ class StatsCalculator:
             # recognize for stats, or connectors without the SPI
             return PlanNodeStatsEstimate(UNKNOWN_ROWS, {}, False)
         symbols = {}
+        ledger = self._ledger()
+        tname = f"{node.catalog}.{node.table}"
         for sym, col in node.assignments.items():
             rng = ranges.get(col)
+            nd = float(ndv[col]) if col in ndv else None
+            # observed-NDV feedback: a real single-key distinct count
+            # recorded by the divergence ledger replaces a missing or
+            # materially wrong connector guess (ROADMAP item 4
+            # seeding; quantized — see the stability contract above)
+            seen = ledger.observed_ndv(tname, (col,))
+            if seen and (nd is None or self._material(nd, seen)):
+                nd = self._quant(float(seen))
             symbols[sym] = SymbolStats(
-                ndv=float(ndv[col]) if col in ndv else None,
+                ndv=nd,
                 low=float(rng[0]) if rng else None,
                 high=float(rng[1]) if rng else None)
         return PlanNodeStatsEstimate(max(rows, 1.0), symbols)
@@ -215,6 +292,25 @@ class StatsCalculator:
         src = self.stats(node.source)
         ndv, ranges = _ndv_dicts(src)
         sel = selectivity(node.predicate, ndv, ranges)
+        # observed-selectivity feedback: the ledger's average for this
+        # (table, predicate shape) — literal variants pool — replaces
+        # a MATERIALLY wrong static guess once a real execution has
+        # been measured (quantized; see the stability contract above).
+        # Only for predicates the static rule could NOT inform from
+        # real statistics: the pooled mean is literal-blind, and a
+        # value-aware range interpolation legitimately disagrees with
+        # it on selective literals
+        table = self._scan_table(node.source)
+        if table is not None and not selectivity_informed(
+                node.predicate, ndv, ranges):
+            seen = self._ledger().observed_selectivity(
+                table, predicate_shape(node.predicate))
+            if seen is not None and self._material(sel, seen):
+                # floor BEFORE quantizing: _quant(0) means "1" for
+                # counts, but an observed empty filter must estimate
+                # near-zero, not pass-everything
+                sel = max(min(self._quant(max(seen, 1e-9)), 1.0),
+                          1e-9)
         rows = max(src.row_count * sel, 1.0)
         return PlanNodeStatsEstimate(rows, dict(src.symbols),
                                      src.confident,
@@ -291,9 +387,30 @@ class StatsCalculator:
             prod = min(prod * max(nd, 1.0), 1e18)
         return max(min(prod, src.row_count), 1.0), confident
 
+    @staticmethod
+    def _subtree_single_table(node: N.PlanNode) -> str | None:
+        """The one base table under ``node``, or None when the subtree
+        scans several — the ledger's OWN recording-side walk, so the
+        record and consult keys cannot drift apart."""
+        from presto_tpu.obs.qstats import _subtree_table
+        return _subtree_table(node) or None
+
     def _s_aggregate(self, node: N.Aggregate) -> PlanNodeStatsEstimate:
         src = self.stats(node.source)
         rows, confident = self._group_rows(src, node.group_keys)
+        if node.group_keys:
+            table = self._subtree_single_table(node)
+            if table is not None:
+                seen = self._ledger().observed_ndv(
+                    table,
+                    tuple(base_symbol(k) for k in node.group_keys))
+                if seen and self._material(rows, seen):
+                    # the observation covers the UNFILTERED table; a
+                    # filtered source still bounds the group count
+                    # (the static rule's min(prod, rows) invariant)
+                    rows = min(self._quant(float(seen)),
+                               max(src.row_count, 1.0))
+                    confident = True
         symbols = {k: src.symbol(k) for k in node.group_keys}
         for sym in node.output_symbols:
             if sym not in symbols:
@@ -397,6 +514,25 @@ class StatsCalculator:
         return PlanNodeStatsEstimate(
             rows, symbols, confident,
             probe.selectivity * build.selectivity)
+
+    def _s_multijoin(self, node: N.MultiJoin) -> PlanNodeStatsEstimate:
+        """Fused star chain: fold the unique-build containment rule
+        over the spine, build by build — identical math to the cascade
+        of binary joins it replaced, so collapsing cannot change the
+        estimates the rest of the plan is costed on."""
+        cur = self.stats(node.spine)
+        rows, confident = cur.row_count, cur.confident
+        symbols = dict(cur.symbols)
+        sel = cur.selectivity
+        for build, crit in zip(node.builds, node.criteria):
+            b = self.stats(build)
+            step = PlanNodeStatsEstimate(rows, symbols, confident, sel)
+            rows, confident = self.equi_join_rows(
+                step, b, crit, build_unique=True)
+            symbols = {**symbols, **b.symbols}
+            sel = sel * b.selectivity
+        return PlanNodeStatsEstimate(max(rows, 1.0), symbols,
+                                     confident, sel)
 
     def _s_semijoin(self, node: N.SemiJoin) -> PlanNodeStatsEstimate:
         src = self.stats(node.source)

@@ -334,6 +334,26 @@ class PlanInterpreter:
         self._note_ok(node, o_ok, "out")
         return out
 
+    def _r_multijoin(self, node: N.MultiJoin) -> DTable:
+        """Fused star chain (plan/nodes.MultiJoin): trace every build
+        first — registering each build's key set as a dynamic filter,
+        so the spine scan prunes against ALL dimensions at once — then
+        run the sequential probe walk. No hash tables, no overflow
+        retries (sorted builds)."""
+        import types as _pytypes
+        builds = []
+        for bnode, crit in zip(node.builds, node.criteria):
+            bdt = self.run(bnode)
+            builds.append(bdt)
+            if self.session.get("enable_dynamic_filtering"):
+                # duck-typed shim: _collect_dyn_filters only reads
+                # .criteria; keys referencing earlier builds register
+                # harmlessly (applied wherever the symbol first flows)
+                self._collect_dyn_filters(
+                    _pytypes.SimpleNamespace(criteria=crit), bdt)
+        spine = self.run(node.spine)
+        return OP.apply_multi_join(spine, builds, node)
+
     def _r_semijoin(self, node: N.SemiJoin) -> DTable:
         src = self.run(node.source)
         filt = self.run(node.filter_source)
@@ -709,8 +729,14 @@ MAX_JOINS_PER_PROGRAM = 2
 
 
 def _count_joins(node: N.PlanNode) -> int:
-    own = isinstance(node, (N.Join, N.SemiJoin))
-    return int(own) + sum(_count_joins(s) for s in node.sources())
+    # a MultiJoin counts its fan-in: compile-cost-wise it carries one
+    # sorted probe per build, and counting it whole keeps _find_split
+    # from trying to cut inside the fused operator (its children hold
+    # no joins, so the splitter materializes the MultiJoin subtree —
+    # or, via _find_agg_input_split, the aggregate input above it)
+    own = (len(node.builds) if isinstance(node, N.MultiJoin)
+           else int(isinstance(node, (N.Join, N.SemiJoin))))
+    return own + sum(_count_joins(s) for s in node.sources())
 
 
 def _find_split(node: N.PlanNode, engine=None):
@@ -718,6 +744,16 @@ def _find_split(node: N.PlanNode, engine=None):
     materialize first, or None when the plan fits one program."""
     if _count_joins(node) <= MAX_JOINS_PER_PROGRAM:
         return _find_agg_input_split(node, engine)
+    if isinstance(node, N.MultiJoin):
+        # the fused operator is atomic — never cut inside it. Large
+        # inputs materialize it whole (so the aggregate above runs at
+        # compacted live width, the same boundary the cascade's
+        # aggregate-input split provided); small plans run fused with
+        # everything above in one program
+        if engine is None or _subtree_scan_rows(node, engine) \
+                >= AGG_SPLIT_MIN_ROWS:
+            return node
+        return None
     kids = node.sources()
     best = max(kids, key=_count_joins)
     c = _count_joins(best)

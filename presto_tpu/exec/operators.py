@@ -644,6 +644,78 @@ def apply_join(left: DTable, right: DTable, node: N.Join,
     return DTable(out, live, left.n), ok
 
 
+def apply_multi_join(spine: DTable, builds: list[DTable],
+                     node: "N.MultiJoin") -> DTable:
+    """Fused multi-way INNER equi-join (plan/nodes.MultiJoin): one
+    sequential probe walk over the spine's static width. Every build
+    is unique (FK->PK) and residual-free by construction, so each step
+    is one sorted lookup (sort_build_side + probe_runs — no hash
+    table, no overflow retry) whose gathered columns immediately
+    become probe keys for later builds; a single live mask accumulates
+    the conjunction of all matches. The cascade of binary joins this
+    replaces materialized (and in segmented execution, compacted and
+    re-uploaded) an intermediate DTable per join."""
+    out = dict(spine.cols)
+    live = spine.live_mask()
+    width = spine.n
+    for bdt, crit in zip(builds, node.criteria):
+        lkeys = [lk for lk, _ in crit]
+        rkeys = [rk for _, rk in crit]
+        acc = DTable(out, live, width)
+        build_live = _and_key_valid(bdt, rkeys, bdt.live_mask())
+        probe_live = _and_key_valid(acc, lkeys, live)
+        rh = _row_hash(bdt, rkeys)
+        _bsh, bsidx = H.sort_build_side(rh, build_live)
+        ph = _row_hash(acc, lkeys)
+        lo, count, found = H.probe_runs(rh, build_live, ph, probe_live)
+        build_row = jnp.where(
+            found, bsidx[jnp.clip(lo + count - 1, 0, bdt.n - 1)], -1)
+        gather = jnp.clip(build_row, 0, bdt.n - 1)
+        verify = _verify_keys(acc, bdt, crit, None, gather)
+        if verify is not True:
+            found = found & verify
+        for sym, v in bdt.cols.items():
+            # INNER: unmatched rows die via the live mask, so the found
+            # mask is redundant as per-column validity (see apply_join)
+            out[sym] = Val(v.dtype, v.data[gather],
+                           None if v.valid is None else v.valid[gather],
+                           v.dictionary)
+        live = probe_live & found
+    return DTable(out, live, width)
+
+
+def concat_dtables(parts: list[DTable]) -> DTable:
+    """Row-concatenate DTables with identical column sets (the hybrid
+    join's hot + cold result union). Validity masks materialize where
+    any part carries one; array columns keep their length/element-mask
+    companions."""
+    first = parts[0]
+    cols: dict[str, Val] = {}
+    total = sum(p.n for p in parts)
+    for sym, v0 in first.cols.items():
+        vs = [p.cols[sym] for p in parts]
+        data = jnp.concatenate([v.data for v in vs])
+        if any(v.valid is not None for v in vs):
+            valid = jnp.concatenate([
+                v.valid if v.valid is not None
+                else jnp.ones((p.n,), dtype=bool)
+                for v, p in zip(vs, parts)])
+        else:
+            valid = None
+        lengths = ev = None
+        if v0.is_array:
+            lengths = jnp.concatenate([v.lengths for v in vs])
+            if any(v.elem_valid is not None for v in vs):
+                ev = jnp.concatenate([
+                    v.elem_valid if v.elem_valid is not None
+                    else jnp.ones(v.data.shape, dtype=bool)
+                    for v in vs])
+        cols[sym] = Val(v0.dtype, data, valid, v0.dictionary,
+                        lengths, ev)
+    live = jnp.concatenate([p.live_mask() for p in parts])
+    return DTable(cols, live, total)
+
+
 def apply_expand_join(left: DTable, right: DTable, node: N.Join,
                       capacity: int, out_capacity: int) -> tuple:
     """Expanding (many-to-many) hash join: every (probe, build) match

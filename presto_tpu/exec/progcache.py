@@ -105,8 +105,10 @@ TRACE_RELEVANT_PROPERTIES = (
     "enable_dynamic_filtering",
     "groupby_table_size",
     "join_distribution_type",
+    "join_salting",
     "partial_aggregation",
     "partitioned_agg_min_groups",
+    "skew_hot_key_threshold",
     "use_connector_partitioning",
 )
 

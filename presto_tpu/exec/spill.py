@@ -338,6 +338,11 @@ def try_execute_spilled(engine, plan: N.PlanNode):
         raise MemoryLimitExceeded(
             f"query estimated {total} bytes exceeds "
             f"query_max_memory_bytes={budget} and spill is disabled")
+    # the spill machinery partitions root-chain Join nodes by their
+    # keys; under memory pressure that outranks multi-way fusion, so
+    # fused star chains expand back into the binary cascade first
+    from presto_tpu.plan.optimizer import unfuse_multijoin
+    plan = unfuse_multijoin(plan)
 
     # first multi-source node on the root chain: a Join spills by join
     # keys; failing that, a grouped Aggregate spills by group keys

@@ -48,6 +48,10 @@ LOCK_SCOPES = (
     # plan-template pad caches are shared across concurrently
     # compiling queries (templates/shapes.py)
     "presto_tpu/templates/",
+    # the CBO now reads the shared divergence-ledger feedback
+    # (cost/stats.py observed_* lookups) and hosts the skew decision
+    # consulted by concurrently planning queries
+    "presto_tpu/cost/",
     # the engine object is shared by every concurrently-admitted
     # query (device-pin cache, carrier caps, preplanned handoff)
     "presto_tpu/engine.py",

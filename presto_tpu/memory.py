@@ -97,6 +97,12 @@ def estimate_plan_memory(plan: N.PlanNode, engine
                 rows = node.output_capacity or (rows_of(node.left) + build)
             # table: hash + row-id per slot; output: full width
             resident = cap * 16 + rows * width
+        elif isinstance(node, N.MultiJoin):
+            # probe-preserving fused chain: output at spine width, one
+            # sorted build side resident per leg (hash + index per row)
+            rows = rows_of(node.spine)
+            resident = rows * width + sum(
+                rows_of(b) * 16 for b in node.builds)
         elif isinstance(node, N.SemiJoin):
             rows = rows_of(node.source)
             cap = node.capacity or 2 * rows_of(node.filter_source)

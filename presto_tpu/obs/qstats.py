@@ -65,8 +65,8 @@ _CURRENT_QUERY: contextvars.ContextVar["QueryRecorder | None"] = \
 
 # node types the divergence ledger tracks (the ones the CBO actually
 # costs; Exchange/Output/Project pass rows through)
-_DIVERGENCE_NODES = ("TableScan", "Filter", "Join", "SemiJoin",
-                     "Aggregate", "Distinct")
+_DIVERGENCE_NODES = ("TableScan", "Filter", "Join", "MultiJoin",
+                     "SemiJoin", "Aggregate", "Distinct")
 
 _SHARD_SUFFIX = re.compile(r"^\d+(a\d+)?$")
 
@@ -511,9 +511,22 @@ def _subtree_table(node) -> str:
 
 def _observe_shapes(by_pos: dict, order: dict, actual: dict) -> None:
     """Per-(table, predicate-shape) selectivity and per-(table,
-    group-keys) NDV observations — the ROADMAP item 4 substrate."""
-    from presto_tpu.cost.stats import predicate_shape
+    group-keys) NDV observations — the ROADMAP item 4 substrate, now
+    consumed by the StatsCalculator's feedback rules (cost/stats.py):
+    keys normalize through ``base_symbol`` so different statements'
+    symbol numberings pool into one observation series.
 
+    Only SINGLE-relation programs record: in a program with joins,
+    dynamic filtering prunes probe scans with build-side key sets, so
+    a filter's scan baseline (and its own output) measure the JOIN
+    CONTEXT, not the predicate — migrating that into a context-free
+    estimate rule would teach the planner wrong selectivities (and
+    wobble plan annotations that key the template/program caches)."""
+    from presto_tpu.cost.stats import base_symbol, predicate_shape
+
+    if any(type(n).__name__ in ("Join", "MultiJoin", "SemiJoin",
+                                "CrossJoin") for n in by_pos.values()):
+        return
     for pos, node in by_pos.items():
         rows = actual.get(pos)
         if rows is None:
@@ -531,9 +544,40 @@ def _observe_shapes(by_pos: dict, order: dict, actual: dict) -> None:
             DIVERGENCE.observe_selectivity(
                 table, shape, int(scan_rows), int(rows))
         elif ntype == "Aggregate" and getattr(node, "group_keys", None):
+            # a Filter below the aggregate makes the group count a
+            # property of the PREDICATE, not the table — recording it
+            # would let a filtered lower bound overwrite a correct
+            # connector NDV on every later plan (the selectivity side
+            # keys by predicate shape for the same reason). Likewise
+            # only SINGLE-step aggregates measure a true distinct
+            # count: a worker fragment's PARTIAL step counts one
+            # shard's groups, and a coordinator FINAL counts groups of
+            # gathered partial STATES — neither is the table's NDV
+            if str(getattr(getattr(node, "step", None), "value", "")) \
+                    != "single":
+                continue
+            if _subtree_has_filter(node):
+                continue
             table = _subtree_table(node)
-            DIVERGENCE.observe_ndv(
-                table, tuple(node.group_keys), int(rows))
+            if table:
+                DIVERGENCE.observe_ndv(
+                    table,
+                    tuple(base_symbol(k) for k in node.group_keys),
+                    int(rows))
+
+
+def _subtree_has_filter(node) -> bool:
+    """Any Filter (or filter-decorated pushed-down scan) below
+    ``node`` — its row counts are predicate-conditional."""
+    for s in node.sources():
+        tname = type(s).__name__
+        if tname == "Filter":
+            return True
+        if tname == "TableScan" and "#" in str(getattr(s, "table", "")):
+            return True
+        if _subtree_has_filter(s):
+            return True
+    return False
 
 
 def _single_scan(node):

@@ -238,18 +238,77 @@ class ShardedInterpreter:
         exchange on the same hash family) — the exchange is a no-op."""
         return side.part is not None and side.part == tuple(keys)
 
-    def _join_partitioned(self, node: N.Join) -> bool:
-        """Broadcast-vs-partitioned distribution choice, analog of the
-        reference's DetermineJoinDistributionType — delegated to the
-        cost model's SINGLE decision (cost/model.py), the same one the
-        fragmenter and the ReorderJoins rule consult, so the runtime
-        and the stage cutter cannot disagree about a join."""
+    def _join_distribution(self, node: N.Join) -> str:
+        """Distribution choice, analog of the reference's
+        DetermineJoinDistributionType — delegated to the cost model's
+        SINGLE decision (cost/model.py), the same one the fragmenter
+        and the ReorderJoins rule consult, so the runtime and the
+        stage cutter cannot disagree about a join. Returns
+        broadcast | partitioned | hybrid (skew-aware refinement of
+        partitioned, cost/skew.py)."""
         return decide_join_distribution(
             node.distribution,
             str(self.session.get("join_distribution_type")),
             node.build_rows,
-            int(self.session.get("broadcast_join_threshold_rows")),
-        ) == "partitioned"
+            int(self.session.get("broadcast_join_threshold_rows")))
+
+    def _salt_factor(self, node) -> int:
+        """Effective salt fan-out for this join's partitioned
+        exchanges: the plan-time annotation (cost/skew.py, pow2)
+        capped by the session ``join_salting`` limit (0 disables) AND
+        by the real mesh width — the planner sized against its default
+        mesh, and tiling more build copies than shards buys nothing."""
+        limit = int(self.session.get("join_salting") or 0)
+        if limit <= 1 or self.nshards <= 1:
+            return 1
+        return max(1, min(int(node.salt_factor or 1), limit,
+                          self.nshards))
+
+    def _with_salt(self, dt: DTable, salt: int) -> DTable:
+        """Probe side of a salted exchange: a ``__salt__`` column
+        spreading each key's rows round-robin over ``salt`` sub-
+        buckets (deterministic, so replays repartition identically)."""
+        cols = dict(dt.cols)
+        cols["__salt__"] = Val(
+            T.BIGINT,
+            (jnp.arange(dt.n, dtype=jnp.int32) % salt))
+        return DTable(cols, dt.live, dt.n)
+
+    def _tiled_build(self, dt: DTable, salt: int) -> DTable:
+        """Build side of a salted exchange: every build row tiled once
+        per salt value, so each probe sub-bucket finds its copy on its
+        own shard (the classic skew-salting build replication)."""
+        cols = {}
+        for sym, v in dt.cols.items():
+            reps = (salt,) + (1,) * (getattr(v.data, "ndim", 1) - 1)
+            cols[sym] = Val(
+                v.dtype, jnp.tile(v.data, reps),
+                None if v.valid is None else jnp.tile(v.valid, (salt,)),
+                v.dictionary)
+        cols["__salt__"] = Val(
+            T.BIGINT,
+            jnp.repeat(jnp.arange(salt, dtype=jnp.int32), dt.n))
+        return DTable(cols, jnp.tile(dt.live_mask(), (salt,)),
+                      dt.n * salt)
+
+    @staticmethod
+    def _salted_node(node: N.Join) -> N.Join:
+        """The join evaluated on salted exchanges: the salt rides as an
+        extra equi criterion (a probe row only matches the build copy
+        of ITS sub-bucket — for expanding joins this is what keeps the
+        tiled copies from double-matching) and any dense hint drops
+        (a direct-address table holds one copy per key)."""
+        return dataclasses.replace(
+            node,
+            criteria=list(node.criteria) + [("__salt__", "__salt__")],
+            dense_key=None)
+
+    @staticmethod
+    def _strip_salt(dt: DTable) -> DTable:
+        if "__salt__" not in dt.cols:
+            return dt
+        return DTable({s: v for s, v in dt.cols.items()
+                       if s != "__salt__"}, dt.live, dt.n)
 
     # -- leaves -------------------------------------------------------------
 
@@ -383,9 +442,17 @@ class ShardedInterpreter:
         lkeys = [lk for lk, _ in node.criteria]
         rkeys = [rk for _, rk in node.criteria]
         out_part = left.part
+        dist = self._join_distribution(node)
         partitioned = (node.criteria and left.dist == SHARDED
                        and right.dist == SHARDED
-                       and self._join_partitioned(node))
+                       and dist in ("partitioned", "hybrid"))
+        if (partitioned and dist == "hybrid" and node.build_unique
+                and node.join_type in (N.JoinType.INNER,
+                                       N.JoinType.LEFT)
+                and self.nshards > 1
+                and int(self.session.get("skew_hot_key_threshold")
+                        or 0) > 0):
+            return self._hybrid_join(node, left, right, lkeys, rkeys)
         if node.join_type == N.JoinType.FULL and not partitioned:
             # FULL with a broadcast build would emit each unmatched build
             # row once PER SHARD; only the FIXED_HASH layout (both sides
@@ -403,6 +470,7 @@ class ShardedInterpreter:
             self._note_ok(node, t_ok)
             self._note_ok(node, o_ok, "out")
             return DistTable(out, REPLICATED)
+        join_node = node
         if partitioned:
             # FIXED_HASH: repartition both sides by join-key hash so each
             # shard joins only its key range — per-device build memory is
@@ -410,18 +478,35 @@ class ShardedInterpreter:
             # (AddExchanges.java:245 partitionedExchange). A side already
             # partitioned on its keys skips its exchange (connector
             # bucketing / reused exchange, AddExchanges partitioning
-            # matching)
-            probe = (left.dt if self._co_located(left, lkeys)
-                     else self._repart(left.dt, lkeys, node, "probe_exch"))
-            build = (right.dt if self._co_located(right, rkeys)
-                     else self._repart(right.dt, rkeys, node,
-                                       "build_exch"))
-            # FULL's unmatched-build tail rows carry NULL probe keys on
-            # whichever shard the BUILD key hashed to — the output is
-            # NOT partitioned by the probe keys (downstream co-location
-            # shortcuts would emit one NULL group per shard)
-            out_part = (None if node.join_type == N.JoinType.FULL
-                        else tuple(lkeys))
+            # matching). With a cost-model salt annotation the exchange
+            # spreads each key over salt sub-buckets (probe rows round-
+            # robin, build rows tiled per salt) so one heavy key cannot
+            # collapse the all_to_all onto a single shard; FULL keeps
+            # the exact co-partition its unmatched-tail pass requires.
+            salt = (self._salt_factor(node)
+                    if node.join_type != N.JoinType.FULL else 1)
+            if salt > 1:
+                probe = self._repart(
+                    self._with_salt(left.dt, salt),
+                    lkeys + ["__salt__"], node, "probe_exch")
+                build = self._repart(
+                    self._tiled_build(right.dt, salt),
+                    rkeys + ["__salt__"], node, "build_exch")
+                join_node = self._salted_node(node)
+                out_part = None  # partitioned on (keys, salt), not keys
+            else:
+                probe = (left.dt if self._co_located(left, lkeys)
+                         else self._repart(left.dt, lkeys, node,
+                                           "probe_exch"))
+                build = (right.dt if self._co_located(right, rkeys)
+                         else self._repart(right.dt, rkeys, node,
+                                           "build_exch"))
+                # FULL's unmatched-build tail rows carry NULL probe keys
+                # on whichever shard the BUILD key hashed to — the output
+                # is NOT partitioned by the probe keys (downstream co-
+                # location shortcuts would emit one NULL group per shard)
+                out_part = (None if node.join_type == N.JoinType.FULL
+                            else tuple(lkeys))
             # per-shard table: must NOT pick up the planner's global-sized
             # capacity hint (kind "ptable" skips it)
             tab_kind, out_kind = "ptable", "pout"
@@ -436,16 +521,168 @@ class ShardedInterpreter:
             tab_kind, out_kind = "table", "out"
             cap = self._capacity(node, next_pow2(2 * build.n))
         if node.build_unique and node.join_type != N.JoinType.FULL:
-            out, ok = OP.apply_join(probe, build, node, cap)
+            out, ok = OP.apply_join(probe, build, join_node, cap)
             self._note_ok(node, ok, tab_kind)
-            return DistTable(out, left.dist, out_part)
+            return DistTable(self._strip_salt(out), left.dist, out_part)
         out_cap = self._capacity(
             node, next_pow2(2 * (probe.n + build.n)), out_kind)
-        out, t_ok, o_ok = OP.apply_expand_join(probe, build, node, cap,
-                                               out_cap)
+        out, t_ok, o_ok = OP.apply_expand_join(probe, build, join_node,
+                                               cap, out_cap)
         self._note_ok(node, t_ok, tab_kind)
         self._note_ok(node, o_ok, out_kind)
-        return DistTable(out, left.dist, out_part)
+        return DistTable(self._strip_salt(out), left.dist, out_part)
+
+    def _hybrid_join(self, node: N.Join, left: DistTable,
+                     right: DistTable, lkeys, rkeys) -> DistTable:
+        """Skew-aware hybrid distribution (JSPIM-style): heavy-hitter
+        keys are detected AT RUNTIME by a mesh-global count sketch over
+        the probe keys; hot keys keep their probe rows LOCAL and
+        replicate their build rows (``all_gather``), while the cold
+        tail hash-partitions (``all_to_all``, salted when annotated).
+        Classification is per sketch BUCKET with the same content hash
+        on both sides, so a probe row and its matching build row always
+        land on the same path — a collision only promotes a cold key to
+        the (also correct) broadcast path. The two joins are both
+        probe-preserving (INNER/LEFT unique-build, the only shapes this
+        path accepts) and concatenate row-wise; with no key over the
+        threshold the hot side is empty and the join degrades to the
+        plain partitioned plan it refines."""
+        from presto_tpu.cost.skew import SKETCH_BUCKETS
+        threshold = int(self.session.get("skew_hot_key_threshold"))
+        sb = jnp.uint64(SKETCH_BUCKETS)
+        probe_live = left.dt.live_mask()
+        key_valid = OP._and_key_valid(left.dt, lkeys, probe_live)
+        ph = OP._row_hash(left.dt, lkeys)
+        bucket = (ph % sb).astype(jnp.int32)
+        counts = jnp.zeros((SKETCH_BUCKETS,), jnp.int32).at[
+            jnp.where(key_valid, bucket, SKETCH_BUCKETS)].add(
+            1, mode="drop")
+        gcounts = jax.lax.psum(counts, AXIS)
+        # a bucket pools ~rows/SKETCH_BUCKETS cold keys besides any
+        # heavy hitter, so compare against the threshold PLUS that
+        # uniform background — without it, probes over
+        # SKETCH_BUCKETS * threshold rows would classify every bucket
+        # hot on perfectly uniform data and broadcast the whole build
+        background = jnp.sum(gcounts) // SKETCH_BUCKETS
+        hot_bucket = gcounts >= threshold + background
+        probe_hot = hot_bucket[bucket] & key_valid
+        build_live = OP._and_key_valid(right.dt, rkeys,
+                                       right.dt.live_mask())
+        bh = OP._row_hash(right.dt, rkeys)
+        build_hot = hot_bucket[(bh % sb).astype(jnp.int32)] & build_live
+
+        # hot build rows: per-shard compact (overflow-retried — the
+        # planner's hot_keys estimate seeds the width) -> all_gather
+        est_hot = int(node.hot_keys or 16)
+        hot_cap = self._capacity(node, next_pow2(max(
+            4 * est_hot // max(self.nshards, 1), 16)), "hot")
+        hot_local, h_ok = OP.compact_dtable(
+            DTable(right.dt.cols, build_hot, right.dt.n), hot_cap)
+        self._note_ok(node, h_ok, "hot")
+        hot_build = _gather(hot_local, self.nshards)
+        hcap = self._capacity(node, next_pow2(2 * hot_build.n), "htab")
+        out_hot, ok1 = OP.apply_join(
+            DTable(left.dt.cols, probe_live & probe_hot, left.dt.n),
+            hot_build, node, hcap)
+        self._note_ok(node, ok1, "htab")
+
+        # cold tail: strike hot rows out of both sides, then the plain
+        # partitioned join (salted when the cost model asked for it)
+        cold_probe = DTable(left.dt.cols, probe_live & ~probe_hot,
+                            left.dt.n)
+        cold_build = DTable(right.dt.cols, build_live & ~build_hot,
+                            right.dt.n)
+        join_node = node
+        salt = self._salt_factor(node)
+        if salt > 1:
+            cp = self._repart(self._with_salt(cold_probe, salt),
+                              lkeys + ["__salt__"], node, "probe_exch")
+            cb = self._repart(self._tiled_build(cold_build, salt),
+                              rkeys + ["__salt__"], node, "build_exch")
+            join_node = self._salted_node(node)
+        else:
+            # masking hot rows out does not move the survivors, so a
+            # side already partitioned on its keys keeps the same
+            # exchange-skip the plain partitioned path applies
+            cp = (cold_probe if self._co_located(left, lkeys)
+                  else self._repart(cold_probe, lkeys, node,
+                                    "probe_exch"))
+            cb = (cold_build if self._co_located(right, rkeys)
+                  else self._repart(cold_build, rkeys, node,
+                                    "build_exch"))
+        ccap = self._capacity(node, next_pow2(
+            2 * max((node.build_rows or cb.n) // self.nshards, 16)),
+            "ptable")
+        out_cold, ok2 = OP.apply_join(cp, cb, join_node, ccap)
+        self._note_ok(node, ok2, "ptable")
+        out = OP.concat_dtables([out_hot,
+                                 self._strip_salt(out_cold)])
+        return DistTable(out, SHARDED, None)
+
+    def _r_multijoin(self, node: N.MultiJoin) -> DistTable:
+        """Distributed lowering of the fused star chain: every build
+        traces first (each registering its dynamic filter, so the fact
+        scan prunes against ALL dimensions), then AT MOST ONE large
+        build co-partitions with the fact table — one repartition of
+        the fact table where the cascade paid a shuffle per large
+        join — and every other build replicates (``all_gather``). The
+        fused sequential probe walk then runs shard-locally."""
+        import types as _pytypes
+        builds: list[DistTable] = []
+        for bnode, crit in zip(node.builds, node.criteria):
+            b = self.run(bnode)
+            builds.append(b)
+            if self.session.get("enable_dynamic_filtering"):
+                self._collect_dyn_filters(
+                    _pytypes.SimpleNamespace(criteria=crit), b.dt,
+                    b.dist == SHARDED)
+        spine = self.run(node.spine)
+        mode = str(self.session.get("join_distribution_type"))
+        thresh = int(self.session.get("broadcast_join_threshold_rows"))
+        spine_syms = set(node.spine.output_symbols)
+        part_idx, part_rows = None, -1
+        if spine.dist == SHARDED:
+            for i, (b, crit) in enumerate(zip(builds, node.criteria)):
+                rows_i = (node.build_rows[i]
+                          if i < len(node.build_rows) else None)
+                dist_i = (node.distributions[i]
+                          if i < len(node.distributions)
+                          else "automatic")
+                d = decide_join_distribution(
+                    dist_i if dist_i != "automatic" else None,
+                    mode, rows_i, thresh)
+                if (d in ("partitioned", "hybrid")
+                        and b.dist == SHARDED
+                        and all(lk in spine_syms for lk, _ in crit)
+                        and (rows_i or 0) > part_rows):
+                    part_idx, part_rows = i, (rows_i or 0)
+        spine_dt = spine.dt
+        out_part = spine.part
+        part_build_dt = None
+        if part_idx is not None:
+            crit = node.criteria[part_idx]
+            plk = [lk for lk, _ in crit]
+            prk = [rk for _, rk in crit]
+            if not self._co_located(spine, plk):
+                spine_dt = self._repart(spine.dt, plk, node,
+                                        "probe_exch")
+            bsel = builds[part_idx]
+            part_build_dt = (
+                bsel.dt if self._co_located(bsel, prk)
+                else self._repart(bsel.dt, prk, node,
+                                  f"build{part_idx}_exch"))
+            out_part = tuple(plk)
+        build_dts = []
+        for i, b in enumerate(builds):
+            if i == part_idx:
+                build_dts.append(part_build_dt)
+            else:
+                build_dts.append(b.dt if b.dist == REPLICATED
+                                 else _gather(b.dt, self.nshards))
+        out = OP.apply_multi_join(spine_dt, build_dts, node)
+        if spine.dist == REPLICATED:
+            return DistTable(out, REPLICATED)
+        return DistTable(out, SHARDED, out_part)
 
     def _r_semijoin(self, node: N.SemiJoin) -> DistTable:
         src = self.run(node.source)

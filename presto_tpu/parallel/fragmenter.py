@@ -152,8 +152,8 @@ def _fragment_general(plan: N.PlanNode, mode: str = "automatic",
     windows: list[N.Window] = []
     distinct_agg = False
     while True:
-        if isinstance(node, (N.Join, N.SemiJoin, N.CrossJoin,
-                             N.TableScan)):
+        if isinstance(node, (N.Join, N.MultiJoin, N.SemiJoin,
+                             N.CrossJoin, N.TableScan)):
             break
         if isinstance(node, N.Aggregate):
             if agg is not None or node.step != N.AggStep.SINGLE:
@@ -281,6 +281,38 @@ def _fragment_general(plan: N.PlanNode, mode: str = "automatic",
             sources[scan.table] = (sname, "all")
             return dataclasses.replace(node, source=src,
                                        filter_source=scan), dist
+        if isinstance(node, N.MultiJoin):
+            # fused star chain over HTTP workers: keep the fusion only
+            # while EVERY build is broadcast-sized — each worker's
+            # union of side-stage buffers is then the full dimension
+            # relation and the multi-key probe walk runs in one
+            # fragment. A build the binary cascade would FIXED_HASH
+            # co-partition (Q9's partsupp at scale) must not ship
+            # whole to every worker, so such chains expand back into
+            # their cascade and take the hash-cut staging
+            big = any(
+                decide_join_distribution(
+                    (node.distributions[i]
+                     if i < len(node.distributions) else None)
+                    or None,
+                    mode,
+                    (node.build_rows[i]
+                     if i < len(node.build_rows) else None),
+                    broadcast_threshold) != "broadcast"
+                for i in range(len(node.builds)))
+            if big:
+                from presto_tpu.plan.optimizer import unfuse_multijoin
+                return lower(unfuse_multijoin(node), sources,
+                             allow_cut)
+            spine, dist = lower(node.spine, sources, allow_cut)
+            scans = []
+            for b in node.builds:
+                sname, stypes = lower_side(b)
+                scan = exchange_scan(fresh("x"), stypes)
+                sources[scan.table] = (sname, "all")
+                scans.append(scan)
+            return dataclasses.replace(node, spine=spine,
+                                       builds=scans), dist
         if isinstance(node, N.Join):
             full = node.join_type == N.JoinType.FULL
             if full and (not node.criteria or not allow_cut):

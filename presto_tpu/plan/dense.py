@@ -62,6 +62,11 @@ def symbol_ranges(node: N.PlanNode, engine) -> dict[str, tuple]:
         out = symbol_ranges(node.left, engine)
         out.update(symbol_ranges(node.right, engine))
         return out
+    if isinstance(node, N.MultiJoin):
+        out = symbol_ranges(node.spine, engine)
+        for b in node.builds:
+            out.update(symbol_ranges(b, engine))
+        return out
     if isinstance(node, N.SemiJoin):
         return symbol_ranges(node.source, engine)
     if isinstance(node, (N.Sort, N.TopN, N.Limit, N.Distinct,
@@ -113,6 +118,9 @@ def unique_key_sets(node: N.PlanNode, engine) -> list[frozenset]:
             # each probe row matches <= 1 build row: probe keys survive
             return unique_key_sets(node.left, engine)
         return []
+    if isinstance(node, N.MultiJoin):
+        # all builds are unique by construction: spine keys survive
+        return unique_key_sets(node.spine, engine)
     if isinstance(node, N.SemiJoin):
         return unique_key_sets(node.source, engine)
     if isinstance(node, N.Aggregate) and node.group_keys:
@@ -186,6 +194,21 @@ def fd_singles(node: N.PlanNode, engine) -> dict[str, set]:
             deps |= rsyms
             # transitively: whatever rk determined, lk now determines
             deps |= right_fd.get(rk, set())
+        return out
+    if isinstance(node, N.MultiJoin):
+        # the fused chain carries the same FDs as the cascade it
+        # replaced: every build is unique, so each single-criterion
+        # probe key determines its build's columns
+        out = fd_singles(node.spine, engine)
+        for build, crit in zip(node.builds, node.criteria):
+            bfd = fd_singles(build, engine)
+            for det, deps in bfd.items():
+                out.setdefault(det, set()).update(deps)
+            if len(crit) == 1:
+                lk, rk = crit[0]
+                deps = out.setdefault(lk, set())
+                deps |= set(build.output_symbols)
+                deps |= bfd.get(rk, set())
         return out
     return {}
 

@@ -101,6 +101,18 @@ def fd_provenance(node: N.PlanNode, engine) -> dict[str, _Prov]:
             if rk in right and lk not in out:
                 out[lk] = right[rk]
         return out
+    if isinstance(node, N.MultiJoin):
+        # same provenance algebra as the INNER unique-build cascade
+        # the fused chain replaced
+        out = dict(fd_provenance(node.spine, engine))
+        for build, crit in zip(node.builds, node.criteria):
+            right = fd_provenance(build, engine)
+            out.update(right)
+            if len(crit) == 1:
+                lk, rk = crit[0]
+                if rk in right and lk not in out:
+                    out[lk] = right[rk]
+        return out
     return {}
 
 

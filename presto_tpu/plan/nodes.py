@@ -172,11 +172,21 @@ class Join(PlanNode):
     # planner hint: probe-side rows match at most one build row (FK->PK,
     # criteria cover a unique key of the build side)
     build_unique: bool = True
-    distribution: str = "automatic"  # automatic | broadcast | partitioned
+    # automatic | broadcast | partitioned | hybrid ("hybrid" = skew-
+    # aware: build rows of runtime-detected heavy-hitter keys broadcast
+    # while the cold tail hash-partitions; cost/skew.py decides)
+    distribution: str = "automatic"
     # planner cardinality estimate of the build side (drives the
     # broadcast-vs-partitioned choice, reference
     # DetermineJoinDistributionType)
     build_rows: int | None = None
+    # skew annotations (cost/skew.py, pow2-bucketed so the compiled-
+    # program cache keeps hitting across literal variants): estimated
+    # heavy-hitter key count sizing the hybrid hot-build table, and the
+    # salt fan-out applied to partitioned exchanges of this join
+    # (1/None = unsalted)
+    hot_keys: int | None = None
+    salt_factor: int | None = None
     capacity: int | None = None
     # static output-row capacity for the expanding (many-to-many) path
     output_capacity: int | None = None
@@ -236,6 +246,49 @@ class SemiJoin(PlanNode):
 
     def output_types(self):
         return {**self.source.output_types(), self.output: T.BOOLEAN}
+
+
+@dataclasses.dataclass
+class MultiJoin(PlanNode):
+    """Fused multi-way INNER equi-join along one probe spine (the
+    TrieJax-style treatment of a star-schema chain as ONE relational
+    operator instead of cascaded binary hash joins). ``criteria[i]``
+    lists (probe_symbol, build_symbol) equalities for ``builds[i]``,
+    where a probe symbol may come from the spine or any EARLIER build
+    (the collapse preserves chain order, so the sequential probe walk
+    resolves them). All collapsed joins are INNER, unique-build
+    (FK->PK) and residual-free by construction (plan/optimizer.py
+    collapse_multiway), so execution is probe-preserving: one sorted
+    lookup per build over the spine's static width, one fused live
+    mask, no intermediate materialization. Distributed lowering keeps
+    the spine sharded, replicates small builds, and co-partitions AT
+    MOST ONE large build — one repartition of the fact table where the
+    cascade paid one per large join."""
+
+    spine: PlanNode = None  # type: ignore[assignment]
+    builds: list[PlanNode] = dataclasses.field(default_factory=list)
+    criteria: list[list[tuple[str, str]]] = dataclasses.field(
+        default_factory=list)
+    # per-build annotations carried over from the collapsed Join nodes
+    # (pow2-bucketed build rows; broadcast|partitioned distribution)
+    build_rows: list = dataclasses.field(default_factory=list)
+    distributions: list = dataclasses.field(default_factory=list)
+
+    def sources(self):
+        return [self.spine] + list(self.builds)
+
+    @property
+    def output_symbols(self):
+        out = list(self.spine.output_symbols)
+        for b in self.builds:
+            out += b.output_symbols
+        return out
+
+    def output_types(self):
+        out = dict(self.spine.output_types())
+        for b in self.builds:
+            out.update(b.output_types())
+        return out
 
 
 @dataclasses.dataclass

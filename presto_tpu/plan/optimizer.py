@@ -36,6 +36,11 @@ def optimize(plan: N.PlanNode, engine,
     # names, and before dense/latemat so their annotations apply to
     # the final join order
     plan = reorder_joins(plan, engine)
+    # star-schema fusion over the reordered spine (session
+    # multiway_join; AUTOMATIC reordering only — NONE means "leave
+    # plans exactly as planned" and ELIMINATE_CROSS_JOINS promises the
+    # planner's binary shape)
+    plan = collapse_multiway(plan, engine)
     # physical-choice annotation needs final plan shapes; late
     # materialization needs its fd_keys annotations, then re-prunes (the
     # narrowed aggregate source drops dependent columns) and
@@ -56,6 +61,110 @@ def optimize(plan: N.PlanNode, engine,
 
 
 # ---------------------------------------------------------------------------
+
+# fewest collapsible joins before fusion pays: 2-join chains (Q3-class)
+# already fit one compiled program (exec/executor.MAX_JOINS_PER_PROGRAM)
+# and keep the battle-tested binary path
+MIN_MULTIWAY_CHAIN = 3
+
+
+def _collapsible(node: N.PlanNode) -> bool:
+    """A chain link the multi-way fusion may absorb: INNER, equi-only,
+    unique-build, residual-free — exactly the shape whose cascade the
+    fused sequential probe walk reproduces row for row."""
+    return (isinstance(node, N.Join)
+            and node.join_type == N.JoinType.INNER
+            and bool(node.criteria) and node.filter is None
+            and node.build_unique)
+
+
+def collapse_multiway(plan: N.PlanNode, engine) -> N.PlanNode:
+    """Collapse left-deep chains of >= MIN_MULTIWAY_CHAIN INNER
+    unique-build equi-joins sharing one probe spine (the star-schema
+    shape cost/reorder.py emits for Q5/Q9) into a single
+    :class:`~presto_tpu.plan.nodes.MultiJoin` — the TrieJax-style
+    fused multi-way operator. Gated on session ``multiway_join`` and
+    AUTOMATIC join reordering; annotations (pow2 build_rows, explicit
+    distributions, skew refinements) carry over per build so the
+    distributed lowering makes the same choices the cascade would."""
+    session = getattr(engine, "session", None)
+    if session is None:
+        return plan
+    try:
+        enabled = bool(session.get("multiway_join"))
+        strategy = str(session.get("optimizer_join_reordering_strategy")
+                       or "AUTOMATIC").upper()
+    except KeyError:
+        return plan
+    if not enabled or strategy != "AUTOMATIC":
+        return plan
+
+    def visit(node: N.PlanNode) -> N.PlanNode:
+        if not _collapsible(node):
+            return node
+        # bottom-up walk: the first MIN_MULTIWAY_CHAIN links fuse from
+        # scratch; every collapsible link above then absorbs into the
+        # already-fused MultiJoin on its probe side
+        if isinstance(node.left, N.MultiJoin):
+            mj = node.left
+            return dataclasses.replace(
+                mj,
+                builds=mj.builds + [node.right],
+                criteria=mj.criteria + [list(node.criteria)],
+                build_rows=mj.build_rows + [node.build_rows],
+                distributions=mj.distributions + [_leg_dist(node)])
+        chain: list[N.Join] = []
+        cur: N.PlanNode = node
+        while _collapsible(cur):
+            chain.append(cur)
+            cur = cur.left
+        if len(chain) < MIN_MULTIWAY_CHAIN:
+            return node
+        chain.reverse()  # bottom-up: chain[0].left is the spine
+        return N.MultiJoin(
+            spine=cur,
+            builds=[j.right for j in chain],
+            criteria=[list(j.criteria) for j in chain],
+            build_rows=[j.build_rows for j in chain],
+            distributions=[_leg_dist(j) for j in chain])
+
+    return N.rewrite_bottom_up(plan, visit)
+
+
+def _leg_dist(j: N.Join) -> str:
+    """A fused leg's distribution: the MultiJoin lowering has no
+    hybrid/salt machinery (the spine repartitions at most once, up
+    front), so a skew-refined "hybrid" leg honestly becomes
+    "partitioned" — EXPLAIN must not claim a hot-key path that will
+    not run."""
+    return "partitioned" if j.distribution == "hybrid" \
+        else j.distribution
+
+
+def unfuse_multijoin(plan: N.PlanNode) -> N.PlanNode:
+    """Inverse of :func:`collapse_multiway`: expand every MultiJoin
+    back into its left-deep cascade of binary INNER unique-build
+    joins. The memory-pressure spill driver (exec/spill.py) partitions
+    a root-chain ``Join`` by its keys — under an enforced memory
+    budget that machinery outranks fusion, so over-budget fused plans
+    de-fuse and spill instead of failing."""
+
+    def visit(node: N.PlanNode) -> N.PlanNode:
+        if not isinstance(node, N.MultiJoin):
+            return node
+        cur: N.PlanNode = node.spine
+        for i, (build, crit) in enumerate(zip(node.builds,
+                                              node.criteria)):
+            cur = N.Join(
+                cur, build, N.JoinType.INNER, list(crit), None, True,
+                distribution=(node.distributions[i]
+                              if i < len(node.distributions)
+                              else "automatic"),
+                build_rows=(node.build_rows[i]
+                            if i < len(node.build_rows) else None))
+        return cur
+
+    return N.rewrite_bottom_up(plan, visit)
 
 
 def _expr_refs(*exprs) -> set[str]:
@@ -132,6 +241,29 @@ def prune_columns(node: N.PlanNode,
         right = prune_columns(node.right,
                               (needed | crit_r | refs) & rsyms | crit_r)
         return dataclasses.replace(node, left=left, right=right)
+
+    if isinstance(node, N.MultiJoin):
+        # a probe key belongs to the spine or to the EARLIER build that
+        # produced it; each build additionally keeps its own build keys
+        owner: dict[str, int] = {}
+        for s in node.spine.output_types():
+            owner[s] = 0
+        for i, b in enumerate(node.builds):
+            for s in b.output_types():
+                owner[s] = i + 1
+        extra: list[set] = [set() for _ in range(len(node.builds) + 1)]
+        for i, crit in enumerate(node.criteria):
+            for pk, bk in crit:
+                extra[owner[pk]].add(pk)
+                extra[i + 1].add(bk)
+        spine = prune_columns(
+            node.spine,
+            (needed & set(node.spine.output_types())) | extra[0])
+        builds = [
+            prune_columns(b, (needed & set(b.output_types()))
+                          | extra[i + 1])
+            for i, b in enumerate(node.builds)]
+        return dataclasses.replace(node, spine=spine, builds=builds)
 
     if isinstance(node, N.SemiJoin):
         src = prune_columns(node.source,
@@ -229,6 +361,9 @@ def inline_trivial_projects(node: N.PlanNode) -> N.PlanNode:
         elif isinstance(node, (N.Join, N.CrossJoin)):
             rebuilt = dataclasses.replace(node, left=new_kids[0],
                                           right=new_kids[1])
+        elif isinstance(node, N.MultiJoin):
+            rebuilt = dataclasses.replace(node, spine=new_kids[0],
+                                          builds=new_kids[1:])
         elif isinstance(node, N.SemiJoin):
             rebuilt = dataclasses.replace(node, source=new_kids[0],
                                           filter_source=new_kids[1])

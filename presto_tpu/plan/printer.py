@@ -52,7 +52,15 @@ def _describe(node: N.PlanNode) -> str:
         extra = f", filter={node.filter}" if node.filter is not None else ""
         uniq = "unique" if node.build_unique else "expanding"
         return (f"Join[{node.join_type.value}, {uniq}, "
-                f"{node.distribution}]({crit}{extra})")
+                f"{_distribution(node.distribution, node.hot_keys, node.salt_factor)}]"
+                f"({crit}{extra})")
+    if isinstance(node, N.MultiJoin):
+        legs = "; ".join(
+            ", ".join(f"{a} = {b}" for a, b in crit)
+            + f" [{_distribution(d, None, None)}]"
+            for crit, d in zip(node.criteria, node.distributions))
+        return (f"MultiJoin[inner, {len(node.builds)}-way]"
+                f"({legs})")
     if isinstance(node, N.SemiJoin):
         keys = ", ".join(f"{a} = {b}" for a, b in
                          zip(node.source_keys, node.filter_keys))
@@ -96,6 +104,17 @@ def _describe(node: N.PlanNode) -> str:
                          for n, s in zip(node.names, node.symbols))
         return f"Output[{cols}]"
     return t
+
+
+def _distribution(dist: str, hot_keys, salt) -> str:
+    """Render a join's distribution; the skew-aware refinements spell
+    their parameters out ("hybrid[hot=256, salt=4]") so EXPLAIN shows
+    what the runtime will actually do (cost/skew.py annotations)."""
+    if dist == "hybrid" or (salt or 1) > 1:
+        return (f"hybrid[hot={hot_keys or 0}, salt={salt or 1}]"
+                if dist == "hybrid"
+                else f"{dist}[salt={salt}]")
+    return dist
 
 
 def _orderings(orderings) -> str:

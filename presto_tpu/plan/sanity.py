@@ -111,6 +111,23 @@ def validate_plan(plan: N.PlanNode) -> None:
             need(_refs(node.filter), {**lt, **rt}, "join filter")
             if not node.criteria and node.filter is None:
                 fail(node, "equi-join with no criteria")
+        elif isinstance(node, N.MultiJoin):
+            if len(node.builds) != len(node.criteria):
+                fail(node, f"{len(node.builds)} builds but "
+                           f"{len(node.criteria)} criteria lists")
+            if not node.builds:
+                fail(node, "multi-way join with no builds")
+            # probe keys resolve against the spine plus every EARLIER
+            # build (the sequential probe walk's visibility rule)
+            avail = dict(child_types[0])
+            for i, crit in enumerate(node.criteria):
+                if not crit:
+                    fail(node, f"build {i} has no equi criteria")
+                need([pk for pk, _ in crit], avail,
+                     f"build {i} probe keys")
+                need([bk for _, bk in crit], child_types[i + 1],
+                     f"build {i} build keys")
+                avail.update(child_types[i + 1])
         elif isinstance(node, N.SemiJoin):
             need(node.source_keys, child_types[0], "source keys")
             need(node.filter_keys, child_types[1], "filter keys")

@@ -32,7 +32,8 @@ def _register(*classes):
 _register(
     # plan nodes
     N.TableScan, N.Values, N.Filter, N.Project, N.Aggregate, N.Join,
-    N.SemiJoin, N.CrossJoin, N.Union, N.Unnest, N.Sort, N.TopN, N.Limit,
+    N.MultiJoin, N.SemiJoin, N.CrossJoin, N.Union, N.Unnest, N.Sort,
+    N.TopN, N.Limit,
     N.Distinct, N.MarkDistinct, N.Window, N.MatchRecognize, N.Exchange,
     N.Output,
     # plan helpers

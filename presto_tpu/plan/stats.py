@@ -28,20 +28,35 @@ def selectivity(expr: ir.Expr, ndv: dict[str, int],
     return max(min(_sel(expr, ndv, ranges), 1.0), 1e-9)
 
 
-def _literal_number(e: ir.Expr):
-    if isinstance(e, ir.Literal) and isinstance(e.value, (int, float)):
-        return float(e.value)
-    return None
+def _literal_number(e: ir.Expr, col: ir.ColumnRef | None = None):
+    """Numeric literal value in the COLUMN's physical units. Connector
+    ranges are physical (decimals are scaled integers), while a
+    literal's value is scaled to the LITERAL's own type — ``30``
+    against a decimal(12,2) column must interpolate as 3000, not 30
+    (the l_quantity < 30 est-1-row divergence PR 8's ledger exposed:
+    the un-scaled literal fell below the range's low bound and the
+    fraction clamped to a near-zero floor, a 17000x miss)."""
+    if not (isinstance(e, ir.Literal)
+            and isinstance(e.value, (int, float))
+            and not isinstance(e.value, bool)):
+        return None
+    v = float(e.value)
+    col_scale = getattr(col.dtype, "scale", None) if col is not None \
+        else None
+    if col_scale:
+        lit_scale = getattr(e.dtype, "scale", 0) or 0
+        v *= 10.0 ** (col_scale - lit_scale)
+    return v
 
 
 def _col_and_lit(args):
     a, b = args
     if isinstance(a, ir.ColumnRef):
-        lit = _literal_number(b)
+        lit = _literal_number(b, a)
         if lit is not None:
             return a, lit, False
     if isinstance(b, ir.ColumnRef):
-        lit = _literal_number(a)
+        lit = _literal_number(a, b)
         if lit is not None:
             return b, lit, True
     return None, None, False
@@ -59,6 +74,42 @@ def _range_fraction(col: str, lit: float, op: str,
     if op in ("lt", "lte"):
         return (lit - lo) / span
     return (hi - lit) / span  # gt / gte
+
+
+def selectivity_informed(expr: ir.Expr, ndv: dict,
+                         ranges: dict) -> bool:
+    """Did the static rule estimate ``expr`` from real, LITERAL-AWARE
+    statistics (NDV quotients, range interpolation)? Gates the
+    divergence-ledger feedback (cost/stats.py): the ledger pools one
+    average over every literal variant of a shape, so overriding a
+    value-aware interpolation with the literal-blind pooled mean would
+    un-fix exactly the estimates the range rule gets right."""
+    def informed(e) -> bool:
+        if not isinstance(e, ir.Call):
+            return False
+        fn = e.fn
+        if fn in ("and", "or"):
+            return any(informed(a) for a in e.args)
+        if fn == "not":
+            return informed(e.args[0])
+        if fn in ("eq", "neq") and len(e.args) == 2:
+            col, lit, _sw = _col_and_lit(e.args)
+            return col is not None and bool(ndv.get(col.name))
+        if fn in ("lt", "lte", "gt", "gte") and len(e.args) == 2:
+            col, lit, _sw = _col_and_lit(e.args)
+            return col is not None and col.name in ranges
+        if fn == "between" and len(e.args) == 3:
+            col = e.args[0]
+            return isinstance(col, ir.ColumnRef) and col.name in ranges
+        if fn == "in" and len(e.args) >= 2:
+            col = e.args[0]
+            return (isinstance(col, ir.ColumnRef)
+                    and bool(ndv.get(col.name)))
+        # like/is_null/unknown functions: fixed priors, no literal
+        # sensitivity — measured reality may replace them
+        return False
+
+    return informed(expr)
 
 
 def _sel(expr: ir.Expr, ndv, ranges) -> float:
@@ -105,8 +156,10 @@ def _sel(expr: ir.Expr, ndv, ranges) -> float:
         return UNKNOWN_FILTER_COEFFICIENT * 0.5
     if fn == "between" and len(expr.args) == 3:
         col = expr.args[0]
-        lo = _literal_number(expr.args[1])
-        hi = _literal_number(expr.args[2])
+        if not isinstance(col, ir.ColumnRef):
+            return 0.25
+        lo = _literal_number(expr.args[1], col)
+        hi = _literal_number(expr.args[2], col)
         if isinstance(col, ir.ColumnRef) and lo is not None \
                 and hi is not None:
             f_lo = _range_fraction(col.name, lo, "gte", ranges)

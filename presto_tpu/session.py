@@ -37,6 +37,33 @@ SYSTEM_SESSION_PROPERTIES: dict[str, tuple[Any, type, str]] = {
                                       "broadcast joins (consulted "
                                       "through the cost model's single "
                                       "decision, cost/model.py)"),
+    "multiway_join": (True, bool,
+                      "collapse INNER unique-build equi-join chains "
+                      "(>= 3 joins sharing a probe spine) into one "
+                      "fused MultiJoin operator: one program, one "
+                      "live mask, and in the distributed lowering at "
+                      "most ONE fact-table repartition instead of a "
+                      "shuffle per join (plan/optimizer.py "
+                      "collapse_multiway; TrieJax-style multi-way "
+                      "join). Only applies under AUTOMATIC join "
+                      "reordering"),
+    "skew_hot_key_threshold": (1 << 16, int,
+                               "mesh-global probe rows per join key "
+                               "above which the key counts as a heavy "
+                               "hitter: hybrid-distribution joins "
+                               "broadcast the hot keys' build rows "
+                               "and hash-partition only the cold "
+                               "tail (cost/skew.py decides WHEN to "
+                               "compile the hybrid path; the hot SET "
+                               "is detected at runtime by a count "
+                               "sketch inside the program). "
+                               "0 disables hybrid distribution"),
+    "join_salting": (8, int,
+                     "max salt fan-out for skewed partitioned-join "
+                     "exchanges: probe rows of one key spread over up "
+                     "to this many shards (build rows tile per salt). "
+                     "The cost model picks the actual pow2 factor; "
+                     "0 disables salting"),
     "optimizer_join_reordering_strategy": (
         "AUTOMATIC", str,
         "AUTOMATIC (cost-based DP reorder, cost/reorder.py) | "

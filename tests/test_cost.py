@@ -22,14 +22,43 @@ def _joins(plan):
     return out
 
 
+def _join_legs(plan):
+    """(criteria, build_unique) per join leg, counting a fused
+    MultiJoin's builds individually (every absorbed leg is unique-build
+    by the collapse rule's construction)."""
+    out = []
+
+    def visit(n):
+        if isinstance(n, N.Join):
+            out.append((list(n.criteria), n.build_unique))
+        elif isinstance(n, N.MultiJoin):
+            out.extend((list(c), True) for c in n.criteria)
+        for s in n.sources():
+            visit(s)
+
+    visit(plan)
+    return out
+
+
 def test_q5_avoids_nationkey_expansion(tpch_tiny):
     """Q5's customer leg must join through c_custkey (unique) — joining
     it early through c_nationkey = s_nationkey alone is a many-to-many
-    explosion (rows x customers-per-nation)."""
+    explosion (rows x customers-per-nation). Holds for the fused
+    MultiJoin form the default plan now takes AND for the binary
+    cascade."""
     eng = Engine()
     eng.register_catalog("tpch", tpch_tiny)
     plan, _ = eng.plan_sql(QUERIES["q05"])
-    joins = _joins(plan)
+    legs = _join_legs(plan)
+    assert len(legs) == 5
+    assert all(u for _c, u in legs), legs
+    cust = [c for c, _u in legs
+            if any("c_custkey" in b for _a, b in c)]
+    assert cust, legs  # customer joined through its unique key
+
+    eng.session.set("multiway_join", False)
+    plan2, _ = eng.plan_sql(QUERIES["q05"])
+    joins = _joins(plan2)
     assert len(joins) == 5
     assert all(j.build_unique for j in joins), [
         (j.criteria, j.build_unique) for j in joins]
@@ -39,7 +68,8 @@ def test_q9_all_joins_unique_build(tpch_tiny):
     eng = Engine()
     eng.register_catalog("tpch", tpch_tiny)
     plan, _ = eng.plan_sql(QUERIES["q09"])
-    assert all(j.build_unique for j in _joins(plan))
+    legs = _join_legs(plan)
+    assert legs and all(u for _c, u in legs)
 
 
 def test_flipped_stats_change_join_order():

@@ -41,6 +41,19 @@ def _joins(plan):
     return out
 
 
+def _multijoins(plan):
+    out = []
+
+    def visit(n):
+        if isinstance(n, N.MultiJoin):
+            out.append(n)
+        for s in n.sources():
+            visit(s)
+
+    visit(plan)
+    return out
+
+
 # -- oracle: reordering must not change results -----------------------------
 
 
@@ -70,15 +83,30 @@ def test_strategy_none_keeps_planner_annotations(tpch_tiny):
 
 
 def test_automatic_writes_distribution_and_bucketed_rows(tpch_tiny):
-    """AUTOMATIC writes the cost model's decisions into the Join nodes:
-    explicit distribution and power-of-two build_rows (coarse estimates
-    keep the compiled-program cache hitting)."""
+    """AUTOMATIC writes the cost model's decisions into the join
+    nodes: explicit distribution and power-of-two build_rows (coarse
+    estimates keep the compiled-program cache hitting). Under the
+    default multiway_join the Q5 star chain fuses into ONE MultiJoin
+    carrying the same per-build annotations."""
     eng = make_engine(tpch_tiny)
     plan, _ = eng.plan_sql(QUERIES["q05"])
-    joins = _joins(plan)
+    mjs = _multijoins(plan)
+    assert mjs and not _joins(plan)
+    for mj in mjs:
+        assert len(mj.builds) >= 3
+        assert len(mj.distributions) == len(mj.builds)
+        for d, rows in zip(mj.distributions, mj.build_rows):
+            assert d in ("broadcast", "partitioned", "hybrid")
+            assert rows is not None
+            assert rows & (rows - 1) == 0  # pow2-bucketed
+
+    # with fusion off the cascade keeps the binary annotations
+    eng2 = make_engine(tpch_tiny, multiway_join=False)
+    plan2, _ = eng2.plan_sql(QUERIES["q05"])
+    joins = _joins(plan2)
     assert joins
     for j in joins:
-        assert j.distribution in ("broadcast", "partitioned")
+        assert j.distribution in ("broadcast", "partitioned", "hybrid")
         assert j.build_rows is not None
         assert j.build_rows & (j.build_rows - 1) == 0  # pow2-bucketed
 
