@@ -59,7 +59,14 @@ so variant_compiles must be 0 and the variant wall is pure execute.
 detail keys, in its own subprocess) drives N concurrent HTTP clients
 through the real protocol against an in-process coordinator and
 reports sustained queries/sec, p50/p99 latency, and error counts —
-the concurrent-serving scale metric. Knobs:
+the concurrent-serving scale metric. One client drives in ARROW
+result mode (X-Presto-TPU-Result: arrow, binary result pages), and
+the serve report ends with a STREAMED full-table SELECT
+(``qstream_rows_per_sec`` + ``qstream_peak_queue_pages``: the page
+queue must peak at its bound regardless of result size — the O(page)
+coordinator-memory claim of the streaming data plane). The default
+run also reports ``wire_{arrow,npz}_mb_per_sec`` — exchange page
+round-trip MB/s per codec (parallel/wire.py). Knobs:
 PRESTO_TPU_BENCH_SERVE_CLIENTS (4), PRESTO_TPU_BENCH_SERVE_S (20),
 PRESTO_TPU_BENCH_SERVE_SF (0.01).
 
@@ -229,6 +236,52 @@ def warm_metrics(detail: dict, name: str, nrows: int, sf: float,
     detail[f"{name}_warm_compile_s"] = r.get("compile_s")
 
 
+# -- exchange wire throughput per codec (parallel/wire.py) -------------------
+# Host-side only (pure numpy/pyarrow, no device): encode+decode a
+# representative exchange page — ints, short decimals, dictionary
+# varchar, a nullable double — per codec, reporting round-trip MB/s.
+# The Arrow data plane is graded on this ratio: columnar IPC removes
+# the serde term that left the link idle (PAPERS.md 2204.03032).
+
+
+def wire_metrics(detail: dict) -> None:
+    from presto_tpu import types as T
+    from presto_tpu.block import Column
+    from presto_tpu.parallel import wire
+
+    n = 1 << 18  # ~5 MB of raw column bytes, one exchange-page scale
+    rng = np.random.default_rng(0)
+    cols = {
+        "k": Column(T.BIGINT, rng.integers(0, 1 << 40, n)),
+        "p": Column(T.DecimalType(12, 2), rng.integers(0, 10**7, n)),
+        "s": Column(T.VARCHAR, rng.integers(0, 64, n, dtype=np.int32),
+                    None,
+                    np.asarray([f"val{i:03d}" for i in range(64)],
+                               object)),
+        "v": Column(T.DOUBLE, rng.random(n), rng.random(n) > 0.1),
+    }
+    raw = sum(np.asarray(c.data).nbytes for c in cols.values())
+    for codec in (wire.WIRE_ARROW, wire.WIRE_NPZ):
+        if codec == wire.WIRE_ARROW and not wire.have_arrow():
+            detail["wire_arrow_skipped"] = "pyarrow unavailable"
+            continue
+        blob = wire.columns_to_bytes(cols, codec=codec)  # warm
+        t0 = time.perf_counter()
+        reps = 0
+        while time.perf_counter() - t0 < 0.5:
+            blob = wire.columns_to_bytes(cols, codec=codec)
+            wire.bytes_to_columns(blob)
+            reps += 1
+        wall = time.perf_counter() - t0
+        detail[f"wire_{codec}_mb_per_sec"] = round(
+            raw * reps / wall / 1e6, 1)
+        detail[f"wire_{codec}_page_bytes"] = len(blob)
+    a = detail.get("wire_arrow_mb_per_sec")
+    z = detail.get("wire_npz_mb_per_sec")
+    if a and z:
+        detail["wire_arrow_vs_npz"] = round(a / z, 2)
+
+
 # -- concurrent-serving QPS bench (bench.py --serve) -------------------------
 # Drives N concurrent HTTP clients through the REAL protocol (POST
 # /v1/statement + nextUri polling) against an in-process coordinator,
@@ -282,7 +335,11 @@ def run_serve_bench() -> dict:
         deadline = time.perf_counter() + duration
 
         def drive(i: int) -> None:
-            c = Client(base, user=f"bench{i}")
+            # client 0 drives in ARROW result mode: the serving path's
+            # binary page delivery gets exercised (and measured) right
+            # alongside the JSON one
+            c = Client(base, user=f"bench{i}",
+                       result_format="arrow" if i == 0 else "json")
             n = 0
             while time.perf_counter() < deadline:
                 sql = SERVE_QUERIES[(i + n) % len(SERVE_QUERIES)]
@@ -312,8 +369,9 @@ def run_serve_bench() -> dict:
         # runs in-process, so the registry's totals cover exactly the
         # queries this bench drove
         from presto_tpu.obs.metrics import REGISTRY
-        return {
+        out = {
             "serve_clients": nclients,
+            "serve_arrow_clients": 1 if nclients else 0,
             "serve_seconds": round(wall, 1),
             "serve_sf": sf,
             "serve_queries_completed": completed,
@@ -326,6 +384,35 @@ def run_serve_bench() -> dict:
             "serve_template_misses": int(REGISTRY.counter(
                 "presto_tpu_template_cache_misses_total").value()),
         }
+
+        # streamed full-table SELECT (ROADMAP item 1's acceptance):
+        # every lineitem row through the bounded-page-queue protocol
+        # in arrow result mode. qstream_peak_queue_pages is the
+        # O(page) coordinator-memory proof — it must sit at the
+        # RESULT_QUEUE_PAGES cap regardless of result size — and the
+        # query-pool peak shows admission charges not scaling with
+        # the result either.
+        try:
+            qc = Client(base, user="qstream", result_format="arrow")
+            sql = "select l_orderkey, l_extendedprice from lineitem"
+            t0 = time.perf_counter()
+            _, qrows = qc.execute(sql, poll_interval=0.005)
+            qwall = time.perf_counter() - t0
+            peak_pages = 0
+            for q in srv.manager.snapshot():
+                if q.sql == sql and q.result is not None:
+                    peak_pages = max(peak_pages, q.result.peak_depth)
+            out.update({
+                "qstream_rows": len(qrows),
+                "qstream_rows_per_sec": round(
+                    len(qrows) / max(qwall, 1e-9)),
+                "qstream_peak_queue_pages": peak_pages,
+                "qstream_peak_query_pool_bytes":
+                    srv.manager.query_pool.peak,
+            })
+        except Exception as exc:  # noqa: BLE001 - additive metric
+            out["qstream_error"] = repr(exc)[:200]
+        return out
     finally:
         srv.stop()
 
@@ -524,6 +611,13 @@ def main() -> None:
     lineitem = tpch.table("lineitem")
     nrows = lineitem.nrows
     detail["datagen_s"] = round(time.perf_counter() - t0, 1)
+
+    # exchange wire MB/s per codec (host-side, ~1 s): the data-plane
+    # serde term, independent of any query
+    try:
+        wire_metrics(detail)
+    except Exception as exc:  # noqa: BLE001 - additive metric
+        detail["wire_bench_error"] = repr(exc)[:200]
 
     # Q9's reserved slice (PRESTO_TPU_BENCH_Q9_RESERVE_S): read BEFORE
     # anything timed so every earlier measurement's timeout can be

@@ -3,12 +3,21 @@
 Analog of the reference's trino-client StatementClientV1
 (client/trino-client/.../StatementClientV1.java:61,323-335): POST the
 statement, then advance() along nextUri until the server stops returning
-one, accumulating data pages.
+one, accumulating data pages. Results STREAM: the server delivers pages
+while the query is still RUNNING (bounded producer queue, see
+server/results.py), so the loop drains data as it appears and only
+sleeps on genuinely empty polls.
+
+``result_format="arrow"`` asks the server for binary result pages
+(``X-Presto-TPU-Result: arrow``): each nextUri fetch returns the wire
+codec's Arrow bytes untouched, decoded client-side into the SAME row
+values the JSON path yields.
 """
 
 from __future__ import annotations
 
 import json
+import re
 import time
 import urllib.error
 import urllib.request
@@ -28,10 +37,12 @@ class QueryFailed(Exception):
 
 class Client:
     def __init__(self, base_url: str, user: str = "presto",
-                 password: str | None = None):
+                 password: str | None = None,
+                 result_format: str = "json"):
         self.base_url = base_url.rstrip("/")
         self.user = user
         self.password = password
+        self.result_format = result_format
         self.warnings: list = []
         # session properties accumulated from SET SESSION statements,
         # replayed on every request via X-Trino-Session (the reference
@@ -45,6 +56,8 @@ class Client:
     def _request(self, method: str, url: str, body: bytes | None = None):
         req = urllib.request.Request(url, data=body, method=method)
         req.add_header("X-Trino-User", self.user)
+        if self.result_format != "json":
+            req.add_header("X-Presto-TPU-Result", self.result_format)
         if self.session_properties:
             from urllib.parse import quote
             # values are URL-encoded so a comma/equals inside a value
@@ -65,6 +78,9 @@ class Client:
             req.add_header("Authorization", f"Basic {cred}")
         try:
             with _urlopen(req, timeout=300) as resp:
+                ctype = resp.headers.get("Content-Type", "")
+                if ctype.startswith("application/vnd.presto-tpu"):
+                    return self._binary_result(resp, url)
                 return json.loads(resp.read() or b"{}")
         except urllib.error.HTTPError as e:
             # overload shedding answers 429 with the QueryResults JSON
@@ -79,10 +95,31 @@ class Client:
             except (ValueError, TypeError):
                 raise e from None
 
+    def _binary_result(self, resp, url: str) -> dict:
+        """An arrow result page -> the SAME QueryResults shape the
+        JSON envelope carries: the body's wire bytes decode to rows
+        byte-identical to the buffered/JSON path, state/token/columns
+        come off the response headers."""
+        from presto_tpu.server.results import rows_from_wire_page
+
+        body = resp.read()
+        out: dict = {"stats": {
+            "state": resp.headers.get("X-PrestoTpu-State", "RUNNING")}}
+        cols = resp.headers.get("X-PrestoTpu-Columns")
+        if cols:
+            out["columns"] = json.loads(cols)
+        if body:
+            out["data"] = rows_from_wire_page(body)
+        if resp.headers.get("X-PrestoTpu-Complete") != "1":
+            nxt = resp.headers.get("X-PrestoTpu-Next-Token", "0")
+            out["nextUri"] = re.sub(r"/\d+$", f"/{nxt}", url)
+        return out
+
     def execute(self, sql: str, poll_interval: float = 0.02):
-        """Run SQL; returns (columns, rows). Blocks until FINISHED.
-        Server-side diagnostics accumulate in ``self.warnings``
-        (reference StatementClientV1 currentStatusInfo().getWarnings)."""
+        """Run SQL; returns (columns, rows). Blocks until the result
+        stream drains. Server-side diagnostics accumulate in
+        ``self.warnings`` (reference StatementClientV1
+        currentStatusInfo().getWarnings)."""
         out = self._request("POST", f"{self.base_url}/v1/statement",
                             sql.encode())
         columns = None
@@ -108,7 +145,9 @@ class Client:
             if next_uri is None:
                 return columns or [], rows
             state = out.get("stats", {}).get("state")
-            if state in ("QUEUED", "RUNNING"):
+            if state in ("QUEUED", "RUNNING") and not out.get("data"):
+                # only an EMPTY poll sleeps: streamed pages arriving
+                # while RUNNING drain back-to-back at wire speed
                 time.sleep(poll_interval)
             out = self._request("GET", next_uri)
 

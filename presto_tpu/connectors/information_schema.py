@@ -133,6 +133,8 @@ class SystemConnector(_ReflectiveConnector):
             "state": T.VARCHAR, "shard": T.BIGINT,
             "input_rows": T.BIGINT, "output_rows": T.BIGINT,
             "exchange_pages": T.BIGINT, "exchange_bytes": T.BIGINT,
+            "exchange_bytes_arrow": T.BIGINT,
+            "exchange_bytes_npz": T.BIGINT,
             "spooled_pages": T.BIGINT, "programs": T.BIGINT,
             "compiles": T.BIGINT, "cache_hits": T.BIGINT,
             "template_hits": T.BIGINT, "retries": T.BIGINT,
@@ -244,7 +246,11 @@ class SystemConnector(_ReflectiveConnector):
             (qid, stage, t["taskId"], t["node"], t["state"],
              int(t["shard"]), int(t["inputRows"]),
              int(t["outputRows"]), int(t["exchangePages"]),
-             int(t["exchangeBytes"]), int(t["spooledPages"]),
+             int(t["exchangeBytes"]),
+             int((t.get("exchangeBytesByCodec") or {})
+                 .get("arrow", 0)),
+             int((t.get("exchangeBytesByCodec") or {}).get("npz", 0)),
+             int(t["spooledPages"]),
              int(t["programs"]), int(t["compiles"]),
              int(t["cacheHits"]), int(t["templateHits"]),
              int(t["retries"]), int(t["compileMillis"]),

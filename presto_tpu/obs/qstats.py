@@ -183,7 +183,14 @@ class TaskRecorder:
         self.output_rows = 0
         self.exchange_pages = 0
         self.exchange_bytes = 0
+        # pulled exchange bytes split by wire codec (arrow | npz):
+        # the "exchange bytes/s roughly doubles on arrow" claim is
+        # checked against this split in system.tasks
+        self.exchange_bytes_by_codec: dict[str, int] = {}
         self.pages_emitted = 0
+        # emitted page bytes split by wire codec (the producer-side
+        # twin of exchange_bytes_by_codec)
+        self.emitted_bytes_by_codec: dict[str, int] = {}
         self.spooled_pages = 0
         self.peak_memory_bytes = 0
         # attempt number parsed from attempt-versioned task ids
@@ -226,7 +233,11 @@ class TaskRecorder:
                 "outputRows": self.output_rows,
                 "exchangePages": self.exchange_pages,
                 "exchangeBytes": self.exchange_bytes,
+                "exchangeBytesByCodec": dict(
+                    self.exchange_bytes_by_codec),
                 "pagesEmitted": self.pages_emitted,
+                "emittedBytesByCodec": dict(
+                    self.emitted_bytes_by_codec),
                 "spooledPages": self.spooled_pages,
                 "peakMemoryBytes": self.peak_memory_bytes,
                 "retries": self.retries,
@@ -358,16 +369,21 @@ def set_output_rows(rows: int) -> None:
         rec.output_rows = int(rows)
 
 
-def note_exchange(pages: int, nbytes: int) -> None:
+def note_exchange(pages: int, nbytes: int,
+                  codec: str | None = None) -> None:
     rec = _CURRENT_TASK.get()
     if rec is None:
         return
     with rec._lock:
         rec.exchange_pages += int(pages)
         rec.exchange_bytes += int(nbytes)
+        if codec:
+            rec.exchange_bytes_by_codec[codec] = \
+                rec.exchange_bytes_by_codec.get(codec, 0) + int(nbytes)
 
 
-def note_emitted_page(nbytes: int, spooled: bool) -> None:
+def note_emitted_page(nbytes: int, spooled: bool,
+                      codec: str | None = None) -> None:
     """Called by the output buffer per produced page (the producer
     thread IS the task thread, so the ambient recorder applies)."""
     rec = _CURRENT_TASK.get()
@@ -375,6 +391,9 @@ def note_emitted_page(nbytes: int, spooled: bool) -> None:
         return
     with rec._lock:
         rec.pages_emitted += 1
+        if codec:
+            rec.emitted_bytes_by_codec[codec] = \
+                rec.emitted_bytes_by_codec.get(codec, 0) + int(nbytes)
         if spooled:
             rec.spooled_pages += 1
 

@@ -75,12 +75,18 @@ class OutputBuffer:
         no consumer made progress for IDLE_ABORT_S."""
         # per-task page accounting (obs/qstats.py): the producer
         # thread IS the task thread, so the ambient recorder
-        # attributes emitted (and spooled) pages to this task
+        # attributes emitted (and spooled) pages — split by wire
+        # codec — to this task
         from presto_tpu.obs import qstats as QS
-        QS.note_emitted_page(len(blob), spooled=self.spool is not None)
+        from presto_tpu.parallel.wire import payload_codec
+        QS.note_emitted_page(len(blob), spooled=self.spool is not None,
+                             codec=payload_codec(blob))
         if self.spool is not None:
             # durable copy first: a producer dying between spool and
-            # buffer leaves a retryable page, never a phantom one
+            # buffer leaves a retryable page, never a phantom one.
+            # The spool re-frames (not re-encodes) the same blob into
+            # its mmap-servable Arrow-file form — the page's values
+            # are serialized exactly once, here by the producer.
             self.spool.write(partition, blob)
         with self._cv:
             idle = 0.0

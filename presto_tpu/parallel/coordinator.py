@@ -306,6 +306,16 @@ class ClusterCoordinator:
     def _task_timeout(self) -> float:
         return float(self.engine.session.get("task_request_timeout_s"))
 
+    def _wire_codec(self) -> str:
+        """Page codec pinned into this query's task payloads (one
+        codec per stage DAG): session ``exchange_wire_codec``
+        override, else the process default (PRESTO_TPU_WIRE env /
+        arrow-when-available). See parallel/wire.py."""
+        from presto_tpu.parallel import wire
+        return wire.resolve_codec(
+            str(self.engine.session.get("exchange_wire_codec")
+                or "") or None)
+
     def _ping_timeout(self) -> float:
         return float(self.engine.session.get("heartbeat_timeout_s"))
 
@@ -590,13 +600,12 @@ class ClusterCoordinator:
 
         from presto_tpu.exec.executor import ScanInput, run_plan
         from presto_tpu.exec.streaming import _replace_node
-        from presto_tpu.parallel.wire import (bytes_to_columns,
-                                              concat_columns)
+        from presto_tpu.parallel.wire import pages_to_columns
         from presto_tpu.plan import nodes as N
 
-        parts = [bytes_to_columns(b) for b in buffers]
-        cols = concat_columns([p[0] for p in parts])
-        total = sum(p[1] for p in parts)
+        # single preallocated assembly (arrow buffers decode to
+        # zero-copy views; one fill per column — no concat cascade)
+        cols, total = pages_to_columns(buffers)
         # coordinator-stage input accounting: the stats tree's final
         # conservation link (last worker stage's output rows == the
         # coordinator's gathered partial rows)
@@ -638,8 +647,7 @@ class ClusterCoordinator:
 
         from presto_tpu.exec.executor import ScanInput, run_plan
         from presto_tpu.exec.streaming import _replace_node
-        from presto_tpu.parallel.wire import (bytes_to_columns,
-                                              concat_columns)
+        from presto_tpu.parallel.wire import pages_to_columns
         from presto_tpu.plan import nodes as N
         from presto_tpu.plan.serde import fragment_to_dict
 
@@ -650,17 +658,17 @@ class ClusterCoordinator:
         # task ids exist purely so worker TaskStats attribute to this
         # query (binary inline results carry no stats payload)
         qid = query_id or uuid.uuid4().hex[:8]
+        wire_codec = self._wire_codec()
         payloads = [{"fragment": frag, "shard": i, "nshards": nshards,
-                     "task_id": f"{qid}.partial.{i}"}
+                     "task_id": f"{qid}.partial.{i}",
+                     "wire": wire_codec}
                     for i in range(nshards)]
         try:
             results = self._dispatch_splits(payloads, workers)
         finally:
             self._collect_stage_stats(workers, qid, {})
 
-        parts = [bytes_to_columns(b) for b in results]
-        cols = concat_columns([p[0] for p in parts])
-        total = sum(p[1] for p in parts)
+        cols, total = pages_to_columns(results)
         carrier = N.TableScan("__cluster__", "__partials__",
                               {s: s for s in types}, dict(types))
         final_agg = DC.replace(agg, source=carrier,
@@ -701,6 +709,7 @@ class ClusterCoordinator:
         qid = (f"{query_id}.{uuid.uuid4().hex[:6]}" if query_id
                else uuid.uuid4().hex[:8])
         W = len(workers)
+        wire_codec = self._wire_codec()
         nparts_of: dict[str, int] = {}
         readers_of = g.consumer_readers(W)
 
@@ -734,7 +743,8 @@ class ClusterCoordinator:
                         sources[tname] = refs
                     p: dict = {"fragment": frag,
                                "task_id": f"{qid}.{st.name}",
-                               "shard": i, "nshards": W}
+                               "shard": i, "nshards": W,
+                               "wire": wire_codec}
                     if sources:
                         p["sources"] = sources
                     if st.partition_keys is not None:
@@ -809,6 +819,7 @@ class ClusterCoordinator:
         session = self.engine.session
         qid = query_id or uuid.uuid4().hex[:8]
         W = len(workers)
+        wire_codec = self._wire_codec()
         task_backoff = FTR.backoff_from_session(
             session, int(session.get("task_retry_attempts")))
         spool_on = bool(session.get("exchange_spooling"))
@@ -853,7 +864,8 @@ class ClusterCoordinator:
                             for s in sorted(pl) for p in range(np_)]
                 sources[tname] = refs
             p: dict = {"fragment": frag_of[st.name], "task_id": tid,
-                       "shard": shard, "nshards": W}
+                       "shard": shard, "nshards": W,
+                       "wire": wire_codec}
             if sources:
                 p["sources"] = sources
             if st.partition_keys is not None:
@@ -1017,6 +1029,7 @@ class ClusterCoordinator:
         qid = (f"{query_id}.{uuid.uuid4().hex[:6]}" if query_id
                else uuid.uuid4().hex[:8])
         W = len(workers)
+        wire_codec = self._wire_codec()
 
         def exchange_scan(name: str, types: dict) -> N.TableScan:
             return N.TableScan("__exchange__", name,
@@ -1034,7 +1047,7 @@ class ClusterCoordinator:
                 run_stage([{
                     "fragment": frag,
                     "task_id": f"{qid}.{st.name}",
-                    "shard": i, "nshards": W,
+                    "shard": i, "nshards": W, "wire": wire_codec,
                     "partition": {"nparts": W,
                                   "keys": st.partition_keys},
                     "async": True,
@@ -1071,7 +1084,8 @@ class ClusterCoordinator:
                              "part": i} for w in workers],
                     }
                     p: dict = {"fragment": frag, "sources": sources,
-                               "task_id": f"{qid}.{js.name}"}
+                               "task_id": f"{qid}.{js.name}",
+                               "wire": wire_codec}
                     if js.out_partition_keys is not None:
                         p["partition"] = {
                             "nparts": W, "keys": js.out_partition_keys}

@@ -10,11 +10,13 @@ The multi-host analog of the reference worker runtime
 2. ``{"fragment", ...}`` — serialized plan IR (plan/serde.py), the
    HttpRemoteTask.sendUpdate analog. A fragment may scan base catalogs
    (split by shard/nshards) and/or ``__exchange__`` tables fed by
-   pulling peer workers' partition buffers (binary npz wire,
-   parallel/wire.py — the ExchangeClient/OutputBuffer pair of the
-   reference, TaskResource.java:261 results endpoints). The fragment's
-   result either hash-partitions into this worker's buffer store for
-   the next stage, or returns inline as binary columns.
+   pulling peer workers' partition buffers (binary columnar wire,
+   parallel/wire.py: Arrow IPC pages by default, framed npz fallback,
+   negotiated per request via Accept + the payload's ``wire`` field —
+   the ExchangeClient/OutputBuffer pair of the reference,
+   TaskResource.java:261 results endpoints). The fragment's result
+   either hash-partitions into this worker's buffer store for the
+   next stage, or returns inline as binary columns.
 """
 
 from __future__ import annotations
@@ -179,7 +181,14 @@ def _fetch_pages(ref: dict, timeout: float = 240.0,
     :class:`presto_tpu.ft.ExchangeFetchError` naming the producer."""
     import time as _time
 
+    from presto_tpu.parallel import wire as _wire
+
     headers = _auth_headers(secret)
+    # Accept negotiation: name the codecs THIS process decodes so a
+    # producer holding pages in another codec transcodes before
+    # serving (mixed-version clusters); current peers serve their
+    # stored arrow pages untouched
+    headers["Accept"] = _wire.accept_header()
     reader = int(ref.get("reader", 0))
     base = (f"{ref['uri']}/v1/task/{ref['task_id']}/results/"
             f"{ref['part']}")
@@ -218,10 +227,20 @@ def _fetch_pages(ref: dict, timeout: float = 240.0,
             if nxt == token and complete:
                 nbytes = sum(len(p) for p in pages)
                 _FETCH_BYTES.inc(nbytes)
-                # per-task exchange accounting (obs/qstats.py): the
-                # fetch runs on the task's thread, so the ambient
-                # recorder attributes pulled pages to this task
-                QS.note_exchange(len(pages), nbytes)
+                # per-task exchange accounting (obs/qstats.py), split
+                # by wire codec: the fetch runs on the task's thread,
+                # so the ambient recorder attributes pulled pages to
+                # this task
+                by_codec: dict[str, list[int]] = {}
+                for p in pages:
+                    c = by_codec.setdefault(
+                        _wire.payload_codec(p), [0, 0])
+                    c[0] += 1
+                    c[1] += len(p)
+                for codec, (np_, nb) in by_codec.items():
+                    QS.note_exchange(np_, nb, codec=codec)
+                if not by_codec:
+                    QS.note_exchange(0, 0)
                 if sp is not None:
                     sp.attrs["pages"] = len(pages)
                     sp.attrs["bytes"] = nbytes
@@ -251,24 +270,28 @@ def execute_fragment_task(engine, req: dict, store: dict,
     from presto_tpu.exec.executor import collect_scans, run_plan
     from presto_tpu.parallel.exchange_host import (partition_ids,
                                                    slice_columns)
-    from presto_tpu.parallel.wire import (bytes_to_columns,
-                                          columns_to_bytes,
-                                          concat_columns)
+    from presto_tpu.parallel.wire import (columns_to_bytes,
+                                          pages_to_columns)
     from presto_tpu.plan.serde import fragment_from_dict
 
     plan = fragment_from_dict(req["fragment"])
+    # producer-side codec: the coordinator pins one per query in the
+    # payload so a whole stage DAG stays consistent; absent (older
+    # coordinator) the worker's own default applies
+    codec = req.get("wire")
     sources = req.get("sources") or {}
     conn = None
     if sources:
         conn = BufferConnector()
         for tname, refs in sources.items():
-            parts = []
+            blobs: list = []
             for r in refs:
-                for blob in _fetch_pages(r, secret=secret):
-                    parts.append(bytes_to_columns(blob))
-            cols = concat_columns([p[0] for p in parts]) \
-                if parts else {}
-            nrows = sum(p[1] for p in parts)
+                blobs.extend(_fetch_pages(r, secret=secret))
+            # single preallocated assembly: arrow pages decode to
+            # zero-copy views and each column is filled into ONE
+            # output array (the old per-page decode + concat copied
+            # every byte twice)
+            cols, nrows = pages_to_columns(blobs)
             # per-source input rows: the stage-rollup consistency
             # check (producer output rows == consumer input rows for
             # partitioned sources) reads these
@@ -287,7 +310,7 @@ def execute_fragment_task(engine, req: dict, store: dict,
     part = req.get("partition")
     if part is None and not req.get("store"):
         QS.set_output_rows(int(live.sum()))
-        return columns_to_bytes(cols)
+        return columns_to_bytes(cols, codec=codec)
 
     # buffered output: pages of ~PAGE_BYTES each stream into the
     # task's bounded OutputBuffer. add() BLOCKS when unacked bytes
@@ -295,14 +318,14 @@ def execute_fragment_task(engine, req: dict, store: dict,
     # stage to drain (backpressure; see parallel/buffer.py)
     buf = store[req["task_id"]]
     if part is None:
-        _emit_pages(buf, 0, cols, int(live.sum()))
+        _emit_pages(buf, 0, cols, int(live.sum()), codec=codec)
     else:
         nparts = int(part["nparts"])
         ids = partition_ids(cols, part["keys"], nparts)
         for p in range(nparts):
             sel = ids == p
             _emit_pages(buf, p, slice_columns(cols, sel),
-                        int(sel.sum()))
+                        int(sel.sum()), codec=codec)
     buf.set_complete()
     QS.set_output_rows(sum(buf.rows()))
     return {"rows": buf.rows()}
@@ -314,14 +337,15 @@ BUFFER_BYTES = int(os.environ.get(
     "PRESTO_TPU_EXCHANGE_BUFFER_BYTES", 64 << 20))
 
 
-def _emit_pages(buf, partition: int, cols: dict, nrows: int) -> None:
+def _emit_pages(buf, partition: int, cols: dict, nrows: int,
+                codec: str | None = None) -> None:
     """Slice one partition's columns into ~PAGE_BYTES pages and stream
     them into the bounded buffer."""
     from presto_tpu.parallel.exchange_host import slice_columns
     from presto_tpu.parallel.wire import columns_to_bytes
 
     if nrows == 0:
-        buf.add(partition, columns_to_bytes(cols), 0)
+        buf.add(partition, columns_to_bytes(cols, codec=codec), 0)
         return
     # size estimate includes amortized dictionary bytes so wide string
     # columns don't produce pages far beyond PAGE_BYTES
@@ -342,32 +366,17 @@ def _emit_pages(buf, partition: int, cols: dict, nrows: int) -> None:
             mask[start:stop] = True
             page_cols = _compact_dictionaries(
                 slice_columns(cols, mask))
-        buf.add(partition, columns_to_bytes(page_cols), stop - start)
+        buf.add(partition, columns_to_bytes(page_cols, codec=codec),
+                stop - start)
         start = stop
 
 
 def _compact_dictionaries(cols: dict) -> dict:
-    """Narrow each string column's dictionary to the entries its page
-    actually references — slice_columns keeps the full dictionary, and
-    serializing it whole into EVERY page would multiply the transfer by
-    the page count."""
-    from presto_tpu.block import Column
+    """Per-page dictionary narrowing — shared with the streamed
+    result path (parallel/wire.py, where the page codecs live)."""
+    from presto_tpu.parallel.wire import compact_page_dictionaries
 
-    out = {}
-    for name, c in cols.items():
-        if c.dictionary is None or len(c.dictionary) <= 16:
-            out[name] = c
-            continue
-        codes = np.asarray(c.data)
-        used = np.unique(np.clip(codes, 0, len(c.dictionary) - 1))
-        if len(used) >= len(c.dictionary):
-            out[name] = c
-            continue
-        remap = np.searchsorted(used, np.clip(codes, 0,
-                                              len(c.dictionary) - 1))
-        out[name] = Column(c.dtype, remap.astype(codes.dtype),
-                           c.valid, c.dictionary[used])
-    return out
+    return compact_page_dictionaries(cols)
 
 
 class WorkerServer(HttpService):
@@ -549,6 +558,7 @@ class WorkerServer(HttpService):
                     part_i = int(parts[4])
                     token_i = int(parts[5])
                     reader_i = int(parts[6]) if len(parts) == 7 else 0
+                    from presto_tpu.parallel import wire as _W
                     from presto_tpu.parallel.buffer import TaskFailed
                     buf = outer.buffers.get(parts[2])
                     if buf is None:
@@ -571,11 +581,28 @@ class WorkerServer(HttpService):
                                                 500)
                                 return
                             blob, nxt, complete = sp
+                    ctype = None
                     if blob:
+                        # content negotiation: stored pages serve
+                        # UNTOUCHED (mmap'd spool bytes included) when
+                        # the consumer's Accept admits their codec; a
+                        # consumer that cannot parse it (npz-only
+                        # peer in a mixed-version cluster, or no
+                        # Accept header at all = pre-arrow reader)
+                        # gets a transcoded copy
+                        codec = _W.payload_codec(blob)
+                        accepted = _W.accepted_codecs(
+                            self.headers.get("Accept"))
+                        if codec not in accepted:
+                            blob = _W.transcode(blob, accepted[0])
+                            codec = accepted[0]
+                        ctype = _W.CONTENT_TYPES[codec]
                         _EXCHANGE_PAGES.inc(node=outer.node_id)
                         _EXCHANGE_BYTES.inc(len(blob),
-                                            node=outer.node_id)
-                    self._send_bytes(blob or b"", extra_headers={
+                                            node=outer.node_id,
+                                            codec=codec)
+                    self._send_bytes(blob or b"", content_type=ctype,
+                                     extra_headers={
                         "X-PrestoTpu-Next-Token": str(nxt),
                         "X-PrestoTpu-Complete":
                             "1" if complete else "0"})

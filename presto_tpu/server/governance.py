@@ -91,3 +91,19 @@ class QueryReaper:
                     mgr.reap(
                         q, f"query exceeded query_max_run_time "
                            f"({limit:g}s)", kind="run")
+            elif q.state == "FINISHED":
+                # abandoned result stream: the query finished with
+                # pages still queued (result smaller than the queue
+                # bound, so the producer never blocked and its own
+                # idle-abort could not fire) and no client fetched
+                # for the idle window — release the buffered pages
+                # and their depth-gauge contribution, or every
+                # crashed-after-submit client pins them for the
+                # server's lifetime
+                queue = q.result
+                if (queue is not None and queue.depth > 0
+                        and q.finished is not None
+                        and now - q.finished > queue.IDLE_ABORT_S):
+                    queue.fail(
+                        "result abandoned: no page fetched for "
+                        f"{queue.IDLE_ABORT_S:.0f}s after completion")

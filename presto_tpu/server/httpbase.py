@@ -28,10 +28,16 @@ class JsonHandler(BaseHTTPRequestHandler):
         length = int(self.headers.get("Content-Length", 0))
         return json.loads(self.rfile.read(length))
 
-    def _send_bytes(self, body: bytes, status: int = 200,
-                    extra_headers: dict | None = None) -> None:
+    def _send_bytes(self, body, status: int = 200,
+                    extra_headers: dict | None = None,
+                    content_type: str | None = None) -> None:
+        """``body`` may be bytes or a memoryview (mmap-served spool
+        pages write to the socket without a heap copy).
+        ``content_type`` overrides the octet-stream default (the wire
+        codecs' vnd types for negotiated exchange/result pages)."""
         self.send_response(status)
-        self.send_header("Content-Type", "application/octet-stream")
+        self.send_header("Content-Type",
+                         content_type or "application/octet-stream")
         self.send_header("Content-Length", str(len(body)))
         for k, v in (extra_headers or {}).items():
             self.send_header(k, v)

@@ -21,12 +21,11 @@ from __future__ import annotations
 
 import contextlib
 import dataclasses
+import os
 import threading
 import time
 import uuid
 from concurrent.futures import ThreadPoolExecutor
-
-import numpy as np
 
 from presto_tpu import types as T
 from presto_tpu.obs import qstats as QS
@@ -34,8 +33,22 @@ from presto_tpu.obs.jsonlog import LOG
 from presto_tpu.obs.metrics import REGISTRY
 from presto_tpu.obs.trace import TRACER
 from presto_tpu.server.httpbase import HttpService, JsonHandler
+from presto_tpu.server.results import (ResultAbandoned, ResultQueue,
+                                       compact_table, json_rows,
+                                       json_value as _json_value,
+                                       page_slice)
 
 PAGE_ROWS = 4096
+# result pages buffered ahead of the client per query; the streaming
+# producer BLOCKS when full (server/results.py backpressure), so a
+# query's protocol-layer memory is bounded by this window regardless
+# of result size
+RESULT_QUEUE_PAGES = int(os.environ.get(
+    "PRESTO_TPU_RESULT_QUEUE_PAGES", "8") or 8)
+# request header selecting the result-page delivery form: "arrow"
+# streams pages as wire-codec bytes handed through untouched;
+# default JSON matches the reference protocol
+RESULT_FORMAT_HEADER = "X-Presto-TPU-Result"
 
 # coordinator instruments (process-wide shared registry, obs/metrics).
 # The counters are REAL monotonic counters incremented at the state
@@ -69,7 +82,13 @@ class QueryInfo:
     # QUERY_QUEUE_FULL, EXCEEDED_TIME_LIMIT, CLUSTER_OUT_OF_MEMORY, ...
     error_name: str | None = None
     columns: list[dict] | None = None
+    # small/statement results buffer here (the legacy path); SELECT
+    # results stream through ``result`` instead — O(page) protocol
+    # memory with producer backpressure (server/results.py)
     rows: list[list] | None = None
+    result: ResultQueue | None = None
+    # "json" | "arrow" — from the X-Presto-TPU-Result request header
+    result_format: str = "json"
     created: float = dataclasses.field(default_factory=time.monotonic)
     # wall-clock twin of ``created`` for the trace timeline (spans use
     # wall time; ``created`` stays monotonic for duration math)
@@ -94,6 +113,14 @@ class QueryInfo:
     add_prepared: dict | None = None
     remove_prepared: list | None = None
 
+    def rows_done(self) -> int:
+        """Rows produced so far: counted at page-EMIT time for
+        streamed results (a streaming query must report true totals,
+        not the length of a buffer it no longer keeps)."""
+        if self.result is not None:
+            return self.result.rows_emitted
+        return len(self.rows or [])
+
     def stats(self) -> dict:
         wall = ((self.finished or time.monotonic())
                 - (self.started or self.created))
@@ -102,38 +129,8 @@ class QueryInfo:
             "queued": self.state == "QUEUED",
             "scheduled": self.state in ("RUNNING", "FINISHED"),
             "elapsedTimeMillis": int(wall * 1000),
-            "processedRows": len(self.rows or []),
+            "processedRows": self.rows_done(),
         }
-
-
-def _json_value(v, dtype: T.DataType):
-    if v is None:
-        return None
-    if isinstance(dtype, T.DecimalType):
-        return f"{v:.{dtype.scale}f}"
-    if isinstance(dtype, T.DateType):
-        return str(v)
-    if isinstance(dtype, T.TimestampType):
-        # Trino wire format: 'YYYY-MM-DD HH:MM:SS.fff'
-        return str(v).replace("T", " ")
-    if isinstance(v, np.timedelta64):
-        us = int(v.astype("timedelta64[us]").astype(np.int64))
-        h, rem = divmod(us, 3_600_000_000)
-        m, rem = divmod(rem, 60_000_000)
-        sec, frac = divmod(rem, 1_000_000)
-        return (f"{h:02d}:{m:02d}:{sec:02d}.{frac:06d}" if frac
-                else f"{h:02d}:{m:02d}:{sec:02d}")
-    if isinstance(v, (np.integer,)):
-        return int(v)
-    if isinstance(v, (np.floating,)):
-        return float(v)
-    if isinstance(v, (np.bool_,)):
-        return bool(v)
-    if isinstance(v, np.str_):
-        return str(v)
-    if isinstance(v, np.datetime64):
-        return str(v)
-    return v
 
 
 def _classify_error(e: BaseException) -> str | None:
@@ -216,14 +213,18 @@ class QueryManager:
 
     def submit(self, sql: str, user: str,
                session_properties: dict | None = None,
-               prepared_statements: dict | None = None) -> QueryInfo:
+               prepared_statements: dict | None = None,
+               result_format: str = "json") -> QueryInfo:
         from presto_tpu.server.resource_groups import (
             NoMatchingGroupError, QueryQueueFullError)
 
         qid = f"{time.strftime('%Y%m%d_%H%M%S')}_{uuid.uuid4().hex[:5]}"
         q = QueryInfo(qid, sql, user,
                       session_properties=session_properties or {},
-                      prepared_statements=prepared_statements or {})
+                      prepared_statements=prepared_statements or {},
+                      result_format=(result_format
+                                     if result_format == "arrow"
+                                     else "json"))
         _TRANSITIONS.inc(state="queued")
         with self.lock:
             self.queries[qid] = q
@@ -309,7 +310,10 @@ class QueryManager:
                         if q.state == "RUNNING":
                             q.state = "FINISHED"
                             _TRANSITIONS.inc(state="finished")
-                            _RESULT_ROWS.inc(len(q.rows or []))
+                            if q.result is None:
+                                # streamed results already counted
+                                # their rows at page-emit time
+                                _RESULT_ROWS.inc(len(q.rows or []))
                             _DURATION.observe(
                                 time.monotonic() - q.started)
                 except TimeLimitExceeded as e:
@@ -346,14 +350,17 @@ class QueryManager:
                     q.finished = time.monotonic()
                     # sync the protocol-level terminal state into the
                     # stats tree before its scope closes (the reaper
-                    # may have set FAILED; the recorder must agree)
+                    # may have set FAILED; the recorder must agree).
+                    # Row totals come from rows_done(): emit-time
+                    # counts for streamed results, so a streaming
+                    # query reports its TRUE total
                     qrec.state = q.state
                     qrec.error = q.error
-                    qrec.output_rows = len(q.rows or [])
+                    qrec.output_rows = q.rows_done()
             LOG.log("query", query_id=q.query_id, user=q.user,
                     state=q.state,
                     elapsed_ms=round((q.finished - q.started) * 1e3, 3),
-                    rows=len(q.rows or []), error=q.error)
+                    rows=q.rows_done(), error=q.error)
         finally:
             with self.lock:
                 self._tickets.pop(q.query_id, None)
@@ -450,10 +457,44 @@ class QueryManager:
                       getattr(self.engine, "last_warnings", [])]
         q.columns = [{"name": n, "type": str(c.dtype)}
                      for n, c in table.columns.items()]
-        dtypes = [c.dtype for c in table.columns.values()]
-        q.rows = [
-            [_json_value(v, t) for v, t in zip(row, dtypes)]
-            for row in table.to_pylist()]
+        self._stream_result(q, table)
+
+    def _stream_result(self, q: QueryInfo, table) -> None:
+        """Hand the columnar result to the protocol layer one page at
+        a time through a bounded queue (server/results.py): pages are
+        decoded to JSON rows — or Arrow-encoded untouched wire bytes
+        in ``X-Presto-TPU-Result: arrow`` mode — per PAGE_ROWS slice
+        ON DEMAND, and this producer BLOCKS when the client lags
+        RESULT_QUEUE_PAGES behind (backpressure). The old path
+        materialized the ENTIRE result into ``q.rows`` Python lists
+        before the first page went out — a ~10-100x memory amplifier
+        held for the query's whole protocol lifetime. Result rows
+        count into the protocol metrics at page-EMIT time, so
+        streaming queries report true totals."""
+        from presto_tpu.parallel import wire
+
+        queue = ResultQueue(RESULT_QUEUE_PAGES, owner=q.cancel_token)
+        with self.lock:
+            q.result = queue
+        cols, total = compact_table(table)
+        start = 0
+        while start < total:
+            stop = min(start + PAGE_ROWS, total)
+            page = page_slice(cols, start, stop)
+            if q.result_format == "arrow":
+                # narrow each page's varchar dictionary to the codes
+                # it references: slicing keeps the FULL dictionary,
+                # and shipping it whole per page would scale bytes
+                # (and the queue's buffered memory) by the page count
+                payload: object = wire.columns_to_bytes(
+                    wire.compact_page_dictionaries(page),
+                    codec=wire.WIRE_ARROW)
+            else:
+                payload = json_rows(page, stop - start)
+            _RESULT_ROWS.inc(stop - start)
+            queue.put(payload, stop - start)
+            start = stop
+        queue.close()
 
     @contextlib.contextmanager
     def _admission(self, q: QueryInfo, overrides: dict,
@@ -547,6 +588,11 @@ class QueryManager:
                            kind=kind, error=message[:200])
         if token is not None:
             token.kill(TimeLimitExceeded(message))
+        if q.result is not None:
+            # wake a producer blocked on the full page queue (its next
+            # wait turn raises the attributable TimeLimitExceeded via
+            # the killed token) and any polling consumer
+            q.result.fail(message)
         if ticket is not None:
             group, start = ticket
             group.cancel_queued(start)
@@ -591,6 +637,10 @@ class QueryManager:
                 # checkpoint (between blocks / retries / spill parts)
                 # and aborts, freeing the device
                 q.cancel_token.cancel()
+        if q.result is not None:
+            # a producer blocked streaming pages to a now-canceled
+            # query wakes immediately (QueryCanceled via the token)
+            q.result.fail("Query was canceled")
         if ticket is not None:
             group, start = ticket
             # a still-group-queued query frees its max_queued slot now;
@@ -705,6 +755,26 @@ class _Handler(JsonHandler):
                             "errorName": "USER_CANCELED"}
             return out
         if q.state in ("QUEUED", "RUNNING"):
+            # streamed results deliver data pages WHILE RUNNING: the
+            # producer fills a bounded queue as pages finish, and the
+            # client drains it here instead of waiting for the whole
+            # result to buffer (reference protocol: data flows in the
+            # RUNNING state)
+            queue = q.result
+            if (q.state == "RUNNING" and queue is not None
+                    and q.columns is not None
+                    and q.result_format == "json"):
+                out["columns"] = q.columns
+                try:
+                    payload, nxt, _done = queue.get(token, poll_s=0.25)
+                except ResultAbandoned:
+                    # mid-RUNNING stream failure: the terminal state
+                    # (set by the producer/reaper momentarily) carries
+                    # the real error on the next poll
+                    payload, nxt = None, token
+                if payload:
+                    out["data"] = payload
+                    token = nxt
             out["nextUri"] = (f"{self._base_uri()}/v1/statement/executing/"
                               f"{q.query_id}/{token}")
             return out
@@ -719,6 +789,32 @@ class _Handler(JsonHandler):
                 # reference protocol/QueryResults warnings field
                 out["warnings"] = q.warnings
             out["columns"] = q.columns
+            if q.result is not None and q.result_format != "json":
+                # arrow-mode data pages go out through the binary
+                # route only; this JSON envelope just points there
+                out["nextUri"] = (
+                    f"{self._base_uri()}/v1/statement/executing/"
+                    f"{q.query_id}/{token}")
+                return out
+            if q.result is not None:
+                try:
+                    payload, nxt, done = q.result.get(token,
+                                                      poll_s=0.25)
+                except ResultAbandoned as e:
+                    # a released/failed stream on a FINISHED query
+                    # fails LOUDLY (a re-requested token below the
+                    # freed watermark must not poll forever)
+                    out["error"] = {
+                        "message": str(e),
+                        "errorName": "RESULT_PAGES_RELEASED"}
+                    return out
+                if payload:
+                    out["data"] = payload
+                if not done:
+                    out["nextUri"] = (
+                        f"{self._base_uri()}/v1/statement/executing/"
+                        f"{q.query_id}/{nxt if payload else token}")
+                return out
             start = token * PAGE_ROWS
             chunk = (q.rows or [])[start:start + PAGE_ROWS]
             if chunk:
@@ -745,7 +841,9 @@ class _Handler(JsonHandler):
             sql = self.rfile.read(length).decode()
             q = self.manager.submit(
                 sql, user, session_properties=props,
-                prepared_statements=self._prepared_statements())
+                prepared_statements=self._prepared_statements(),
+                result_format=str(self.headers.get(
+                    RESULT_FORMAT_HEADER, "json")).strip().lower())
             if q.error_name == "QUERY_QUEUE_FULL":
                 # fast 429-style shed (reference QUERY_QUEUE_FULL +
                 # Too Many Requests): the client backs off and
@@ -911,9 +1009,51 @@ class _Handler(JsonHandler):
             if q is None or not self._can_view(user, q):
                 self._send_json({"error": "unknown query"}, 404)
                 return
+            if self._send_arrow_page(q, int(parts[4])):
+                return
             self._send_json(self._query_results(q, int(parts[4])))
             return
         self._send_json({"error": "not found"}, 404)
+
+    def _send_arrow_page(self, q: QueryInfo, token: int) -> bool:
+        """Arrow result mode: streamed pages go to the client as the
+        wire-codec bytes the producer encoded, UNTOUCHED — no JSON
+        boxing anywhere on the result path. State/token/columns ride
+        response headers; terminal/error states fall through to the
+        JSON envelope (returns False)."""
+        import json as _json
+
+        from presto_tpu.parallel import wire
+        if (q.result_format != "arrow" or q.result is None
+                or q.state not in ("RUNNING", "FINISHED")):
+            return False
+        try:
+            payload, nxt, done = q.result.get(token, poll_s=0.25)
+        except ResultAbandoned as e:
+            if q.state == "FINISHED":
+                # released/failed stream on a finished query: fail
+                # LOUDLY — the JSON fallback would re-point nextUri
+                # here forever
+                self._send_json({
+                    "id": q.query_id,
+                    "stats": q.stats(),
+                    "error": {"message": str(e),
+                              "errorName": "RESULT_PAGES_RELEASED"}})
+                return True
+            return False  # terminal state will carry the error
+        headers = {
+            "X-PrestoTpu-State": q.state,
+            "X-PrestoTpu-Next-Token": str(nxt),
+            "X-PrestoTpu-Complete":
+                "1" if (q.state == "FINISHED" and done) else "0",
+        }
+        if q.columns is not None:
+            headers["X-PrestoTpu-Columns"] = _json.dumps(q.columns)
+        self._send_bytes(
+            payload or b"",
+            content_type=wire.CONTENT_TYPES[wire.WIRE_ARROW],
+            extra_headers=headers)
+        return True
 
     def _can_view(self, user: str, q: QueryInfo) -> bool:
         """With an authenticator configured, query state/results are

@@ -217,6 +217,14 @@ SYSTEM_SESSION_PROPERTIES: dict[str, tuple[Any, type, str]] = {
                           "re-fetch a dead producer's pages instead "
                           "of recomputing (ft/spool.py; no-op when "
                           "no spool directory is configured)"),
+    "exchange_wire_codec": ("", str,
+                            "page serialization for the exchange "
+                            "data plane: arrow (zero-copy Arrow IPC "
+                            "RecordBatches) | npz (framed np.savez "
+                            "fallback) | '' = auto (PRESTO_TPU_WIRE "
+                            "env, else arrow when pyarrow is "
+                            "available). Pinned per query into every "
+                            "task payload (parallel/wire.py)"),
     "plan_templates": (True, bool,
                        "hoist comparison/arithmetic literals out of "
                        "traced programs into runtime arguments and key "

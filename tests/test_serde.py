@@ -133,9 +133,9 @@ def test_wire_roundtrip_without_native(monkeypatch):
     import presto_tpu.native as native
     from presto_tpu.parallel import wire
     cols = _mk_cols()
-    framed = wire.columns_to_bytes(cols)
+    framed = wire.columns_to_bytes(cols, codec="npz")
     monkeypatch.setattr(native, "_codec", None)
-    plain = wire.columns_to_bytes(cols)
+    plain = wire.columns_to_bytes(cols, codec="npz")
     assert plain[:4] != wire._MAGIC  # unframed npz
     back, nrows = wire.bytes_to_columns(plain)
     assert nrows == 5000
@@ -150,7 +150,9 @@ def test_wire_corrupt_frame_detected():
     if codec() is None:
         pytest.skip("native toolchain unavailable")
     from presto_tpu.parallel import wire
-    payload = wire.columns_to_bytes(_mk_cols())
+    # the npz codec explicitly: arrow is the default wire now, and
+    # the CRC frame under test belongs to the npz fallback
+    payload = wire.columns_to_bytes(_mk_cols(), codec="npz")
     assert payload[:4] == wire._MAGIC
     corrupt = payload[:-3] + bytes([payload[-3] ^ 0xFF]) + payload[-2:]
     with pytest.raises((ValueError, RuntimeError)):
