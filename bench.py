@@ -124,6 +124,12 @@ engine = Engine()
 # dedicated q05/q09 skew measurements only
 engine.register_catalog("tpch", TpchConnector(
     scale=sf, skew=os.environ.get("PRESTO_TPU_BENCH_SKEW_ACTIVE") or None))
+# kernel backend override (PRESTO_TPU_BENCH_KERNEL_BACKEND): the
+# parent forces pallas/xla for the per-backend q05/q09 comparison
+from presto_tpu import kernels as _K
+_kb = os.environ.get("PRESTO_TPU_BENCH_KERNEL_BACKEND")
+if _kb:
+    engine.session.set("kernel_backend", _kb)
 plan, _ = engine.plan_sql(QUERIES[name])
 compiles = REGISTRY.counter("presto_tpu_programs_compiled_total")
 compile_hist = REGISTRY.histogram("presto_tpu_compile_seconds")
@@ -138,8 +144,27 @@ for _ in range(reps):
     t0 = time.perf_counter()
     np.asarray(run_plan_live(engine, plan))
     times.append(time.perf_counter() - t0)
+top_ops = None
+if reps:
+    # ONE extra steady run under a qstats scope, OUTSIDE the timed
+    # samples, so the child can report the top operators by
+    # attributed wall (which operator dominates —
+    # system.operator_stats' per-kernel split) without the stats
+    # recording ever inflating steady_s
+    from presto_tpu.obs import qstats as QS
+    with QS.query("bench-" + name, QUERIES[name], "bench") as qr:
+        np.asarray(run_plan_live(engine, plan))
+    snap = qr.snapshot()
+    ops = [o for st in snap["stages"] for t in st["tasks"]
+           for o in t["operators"]]
+    ops.sort(key=lambda o: -(o.get("wallMillis") or 0))
+    top_ops = [{"node": o["nodeType"], "label": o["label"],
+                "wall_ms": o.get("wallMillis"),
+                "kernel": o.get("kernel") or ""}
+               for o in ops[:3]]
 out = {
     "name": name, "first_s": round(first, 3),
+    "kernel_backend": _K.resolve(engine.session),
     # real compile/execute attribution: XLA compile wall from the obs
     # histogram (exec/executor + parallel/executor both feed it), not
     # the first-minus-steady approximation
@@ -149,6 +174,8 @@ out = {
     "cache_hits_memory": int(hits.value(tier="memory"))}
 if times:  # reps=0 = warm-start probe: first_s is the measurement
     out["steady_s"] = min(times)
+if top_ops is not None:
+    out["top_operators"] = top_ops
 variant = sys.argv[4] if len(sys.argv) > 4 else ""
 if variant:
     # literal-variant warm measurement (plan templates): the same
@@ -178,24 +205,31 @@ VARIANTS = {
 
 
 def measure_query(name: str, sf: float, reps: int,
-                  timeout_s: float, skew: str | None = None) -> dict:
+                  timeout_s: float, skew: str | None = None,
+                  kernel_backend: str | None = None) -> dict:
     """One query's (first, steady) walls + compile attribution and
     program-cache counters, isolated in a subprocess. With
     PRESTO_TPU_PROGRAM_CACHE_DIR set (bench default) a SECOND call for
     the same query measures the warm start: the fresh process loads
     the AOT executables from the persistent store instead of
     compiling. ``skew`` ("zipf:<s>") points the child at the
-    Zipf-skewed datagen variant (PRESTO_TPU_BENCH_SKEW mode)."""
+    Zipf-skewed datagen variant (PRESTO_TPU_BENCH_SKEW mode);
+    ``kernel_backend`` forces the child's kernel dispatch (the
+    pallas-vs-xla per-backend comparison)."""
     t0 = time.perf_counter()
     argv = [sys.executable, "-c", _CHILD, name, str(sf), str(reps)]
-    if name in VARIANTS and reps > 0 and not skew:
+    if name in VARIANTS and reps > 0 and not skew and not kernel_backend:
         # variant rides the COLD child only: the warm-start probe
-        # (reps=0) measures the persistent cache, not templates
+        # (reps=0) measures the persistent cache, not templates, and
+        # the per-backend comparison reruns read only steady_s
         argv.append(VARIANTS[name])
     env = dict(os.environ)
     env.pop("PRESTO_TPU_BENCH_SKEW_ACTIVE", None)
+    env.pop("PRESTO_TPU_BENCH_KERNEL_BACKEND", None)
     if skew:
         env["PRESTO_TPU_BENCH_SKEW_ACTIVE"] = skew
+    if kernel_backend:
+        env["PRESTO_TPU_BENCH_KERNEL_BACKEND"] = kernel_backend
     try:
         proc = subprocess.run(
             argv, capture_output=True, text=True, timeout=timeout_s,
@@ -280,6 +314,98 @@ def wire_metrics(detail: dict) -> None:
     z = detail.get("wire_npz_mb_per_sec")
     if a and z:
         detail["wire_arrow_vs_npz"] = round(a / z, 2)
+
+
+# -- per-kernel microbench + interpret-mode parity (bench.py --kernels) ------
+# Pallas-vs-XLA rows/s for each kernel in the dispatch table
+# (presto_tpu/kernels/), plus Q5/Q9 result parity between the two
+# backends at tiny SF. On TPU the microbench grades the real Mosaic
+# lowering; on CPU-only containers the Pallas numbers are interpret
+# mode — correctness evidence, not speed (which is exactly what the
+# acceptance asks for there).
+
+
+def run_kernel_bench() -> dict:
+    import jax
+    import jax.numpy as jnp
+
+    from presto_tpu import Engine
+    from presto_tpu import kernels as K
+    from presto_tpu.connectors.tpch import TpchConnector
+    from tests.tpch_queries import QUERIES
+
+    detail: dict = {"kernel_default_backend": K.default_backend()}
+    rng = np.random.default_rng(7)
+    n = int(os.environ.get("PRESTO_TPU_BENCH_KERNEL_ROWS",
+                           str(1 << 15)))
+    bh = jnp.asarray(rng.integers(0, n, n).astype(np.uint64))
+    ph = jnp.asarray(rng.integers(0, 2 * n, n).astype(np.uint64))
+    ones = jnp.ones((n,), bool)
+    vals = jnp.asarray(rng.integers(-(1 << 40), 1 << 40, n))
+    sids = jnp.asarray(rng.integers(0, 64, n).astype(np.int32))
+    keep = jnp.asarray(rng.random(n) > 0.5)
+    cols = {"a": vals, "b": keep}
+
+    def timed_rows_per_sec(fn) -> float:
+        fn()  # warm: compile outside the timed window
+        t0 = time.perf_counter()
+        reps = 0
+        while time.perf_counter() - t0 < 0.4:
+            fn()
+            reps += 1
+        return round(n * reps / (time.perf_counter() - t0))
+
+    for be in ("pallas", "xla"):
+        with K.use_backend(be):
+            join_fn = jax.jit(lambda: K.dispatch("join_lookup")(
+                bh, ones, ph, ones, 2 * n)[0])
+            agg_fn = jax.jit(lambda: K.dispatch("agg_sum")(
+                vals, sids, 64))
+            cmp_fn = jax.jit(lambda: K.dispatch("compact")(
+                keep, cols, n)["a"])
+            for kname, fn in (("join", join_fn), ("agg", agg_fn),
+                              ("compact", cmp_fn)):
+                try:
+                    detail[f"kernel_{kname}_{be}_rows_per_sec"] = \
+                        timed_rows_per_sec(lambda f=fn: np.asarray(f()))
+                except Exception as exc:  # noqa: BLE001 - additive
+                    detail[f"kernel_{kname}_{be}_error"] = \
+                        repr(exc)[:200]
+
+    # Q5/Q9 parity: byte-identical results pallas (interpret on CPU)
+    # vs xla through the full SQL path at tiny SF
+    conn = TpchConnector(scale=0.01)
+    for qname in ("q05", "q09"):
+        try:
+            results = {}
+            for be in ("xla", "pallas"):
+                e = Engine()
+                e.register_catalog("tpch", conn)
+                e.session.set("kernel_backend", be)
+                results[be] = e.execute(QUERIES[qname])
+            detail[f"{qname}_pallas_parity"] = (
+                results["xla"] == results["pallas"])
+        except Exception as exc:  # noqa: BLE001 - additive metric
+            detail[f"{qname}_parity_error"] = repr(exc)[:200]
+    return detail
+
+
+def kernel_metrics(detail: dict, budget_left: float) -> None:
+    """Run the per-kernel microbench + parity check in its OWN
+    subprocess (same device-isolation rationale as measure_query)."""
+    if budget_left <= 90:
+        detail["kernel_bench_skipped"] = "bench time budget exhausted"
+        return
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--kernels"],
+            capture_output=True, text=True,
+            timeout=min(budget_left - 10, 300),
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+        line = (proc.stdout or "").strip().splitlines()[-1]
+        detail.update(json.loads(line).get("detail") or {})
+    except Exception as exc:  # noqa: BLE001 - additive metrics
+        detail["kernel_bench_error"] = repr(exc)[:200]
 
 
 # -- concurrent-serving QPS bench (bench.py --serve) -------------------------
@@ -584,6 +710,12 @@ def main() -> None:
             "metric": "serve_qps", "value": out["serve_qps"],
             "unit": "queries/s", "detail": out}))
         return
+    if "--kernels" in sys.argv[1:]:
+        out = run_kernel_bench()
+        print(json.dumps({
+            "metric": "kernel_bench", "value": 1, "unit": "report",
+            "detail": out}))
+        return
 
     sf = float(os.environ.get("PRESTO_TPU_BENCH_SF", "10"))
     reps = int(os.environ.get("PRESTO_TPU_BENCH_REPS", "2"))
@@ -735,6 +867,11 @@ def main() -> None:
             "compile_s", round(r["first_s"] - r["steady_s"], 1))
         detail[f"{name}_execute_s"] = round(r["steady_s"], 2)
         detail[f"{name}_programs_compiled"] = r.get("programs_compiled")
+        # which kernel backend the child resolved (auto = pallas on
+        # TPU, xla on CPU) + its top-3 operators by attributed wall
+        detail[f"{name}_kernel_backend"] = r.get("kernel_backend")
+        if r.get("top_operators"):
+            detail[f"{name}_top_operators"] = r["top_operators"]
         if "variant_s" in r:
             # literal-variant warm rerun inside the cold child: with
             # plan templates on, variant_compiles MUST be 0 — the
@@ -750,6 +887,33 @@ def main() -> None:
         if base:
             detail[f"{name}_vs_baseline"] = round(
                 base / r["steady_s"], 2)
+
+    # per-backend q05/q09 (the kernel-backend comparison): when the
+    # default run resolved to pallas (a TPU container), measure the
+    # XLA fallback too, so the execute-phase kernel speedup is
+    # checkable per backend from one BENCH file. On CPU containers
+    # the default IS xla and the pallas side is interpret mode —
+    # kernel_metrics() below reports interpret-mode PARITY instead
+    # (correctness, not speed).
+    for name in ("q05", "q09"):
+        if detail.get(f"{name}_kernel_backend") != "pallas":
+            continue
+        left = budget - (time.perf_counter() - t_start)
+        if left <= 60:
+            detail[f"{name}_xla_skipped"] = "bench time budget " \
+                                            "exhausted"
+            continue
+        r = measure_query(name, sf, reps, left - 15,
+                          kernel_backend="xla")
+        if "error" in r:
+            detail[f"{name}_xla_error"] = r["error"]
+            continue
+        detail[f"{name}_xla_rows_per_sec"] = round(
+            nrows / r["steady_s"])
+        pallas_rps = detail.get(f"{name}_rows_per_sec")
+        if pallas_rps:
+            detail[f"{name}_pallas_vs_xla"] = round(
+                pallas_rps / detail[f"{name}_xla_rows_per_sec"], 3)
 
     # Zipf-skew measurements (PRESTO_TPU_BENCH_SKEW=zipf:<s>): q05/q09
     # rerun against the Zipf-skewed datagen variant, so skew
@@ -797,6 +961,10 @@ def main() -> None:
         if f"{name}_rows_per_sec" in detail or name == "q01":
             warm_metrics(detail, name, nrows, sf,
                          budget - (time.perf_counter() - t_start))
+
+    # per-kernel pallas-vs-xla microbench + Q5/Q9 backend parity
+    # (own subprocess, tiny SF)
+    kernel_metrics(detail, budget - (time.perf_counter() - t_start))
 
     # concurrent-serving QPS + latency (own subprocess, tiny SF): the
     # scale numbers ride the same BENCH json as the throughput ones

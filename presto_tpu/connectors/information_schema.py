@@ -147,6 +147,11 @@ class SystemConnector(_ReflectiveConnector):
             "node_type": T.VARCHAR, "label": T.VARCHAR,
             "input_rows": T.BIGINT, "output_rows": T.BIGINT,
             "output_bytes": T.BIGINT, "est_rows": T.BIGINT,
+            # per-operator kernel attribution (presto_tpu/kernels/):
+            # which backend:kernel pairs the operator dispatched, and
+            # its rows-weighted share of the program's execute wall —
+            # "which operator dominates" is answerable from SQL
+            "kernel": T.VARCHAR, "wall_ms": T.BIGINT,
         },
         "plan_divergence": {
             "query_id": T.VARCHAR, "stage": T.VARCHAR,
@@ -263,6 +268,7 @@ class SystemConnector(_ReflectiveConnector):
             (qid, stage, t["taskId"], str(op["planNodeId"]),
              op["nodeType"], op["label"], int(op["inputRows"]),
              int(op["outputRows"]), int(op["outputBytes"]),
-             int(op["estRows"]))
+             int(op["estRows"]), str(op.get("kernel") or ""),
+             int(op.get("wallMillis") or 0))
             for qid, stage, t in self._stage_tasks()
             for op in t["operators"]]

@@ -167,6 +167,12 @@ class PlanInterpreter:
         self.ok_flags: list = []
         self.ok_keys: list[tuple] = []
         self.used_capacity: dict[tuple, int] = {}
+        # per-node kernel attribution (presto_tpu/kernels/): stable
+        # preorder position -> ["pallas:join_lookup", ...] noted by
+        # the dispatch table while this node's handler traced; rides
+        # meta into qstats so system.operator_stats names the kernel
+        # (and splits execute wall) per operator
+        self.kernel_used: dict[object, list[str]] = {}
         # always-on runtime stats (obs/qstats.py): live rows out of
         # EVERY plan node, keyed by stable preorder position so the
         # counts survive replans and ride program-cache entries across
@@ -184,8 +190,13 @@ class PlanInterpreter:
         self._df_applied: set[str] = set()
 
     def run(self, node: N.PlanNode) -> DTable:
+        from presto_tpu import kernels as K
         m = getattr(self, "_r_" + type(node).__name__.lower())
-        dt = m(node)
+        with K.collect() as used:
+            dt = m(node)
+        if used:
+            self.kernel_used[
+                self.node_order.get(id(node), id(node))] = list(used)
         if self.dyn_filters:
             dt = self._apply_dyn_filters(dt)
         if self.collect_rows:
@@ -338,8 +349,10 @@ class PlanInterpreter:
         """Fused star chain (plan/nodes.MultiJoin): trace every build
         first — registering each build's key set as a dynamic filter,
         so the spine scan prunes against ALL dimensions at once — then
-        run the sequential probe walk. No hash tables, no overflow
-        retries (sorted builds)."""
+        run the probe walk (one Pallas kernel under
+        kernel_backend=pallas, the sequential sorted walk on XLA).
+        The Pallas tables can chain-overflow; the ok flag feeds the
+        capacity retry ladder like every other hash table."""
         import types as _pytypes
         builds = []
         for bnode, crit in zip(node.builds, node.criteria):
@@ -352,7 +365,13 @@ class PlanInterpreter:
                 self._collect_dyn_filters(
                     _pytypes.SimpleNamespace(criteria=crit), bdt)
         spine = self.run(node.spine)
-        return OP.apply_multi_join(spine, builds, node)
+        default = next_pow2(
+            2 * max(max((b.n for b in builds), default=1), 1))
+        cap = self._capacity(node, default)
+        out, ok = OP.apply_multi_join(spine, builds, node,
+                                      growth=max(1, cap // default))
+        self._note_ok(node, ok)
+        return out
 
     def _r_semijoin(self, node: N.SemiJoin) -> DTable:
         src = self.run(node.source)
@@ -465,6 +484,7 @@ def make_traced(scan_inputs: list[ScanInput], plan: N.PlanNode,
     node_order = preorder_index(plan)
 
     def traced_fn(*args):
+        from presto_tpu import kernels as K
         it = iter(args)
         scans = {}
         for scan in scan_inputs:
@@ -473,19 +493,26 @@ def make_traced(scan_inputs: list[ScanInput], plan: N.PlanNode,
         interp = (interp_factory or PlanInterpreter)(
             scans, capacities, session, node_order)
         interp.collect_rows = collect_rows
+        # resolve + install the kernel backend for this trace
+        # (kernel_backend session property; ambient so operators and
+        # ops/segred dispatch without threading the session through)
+        backend = K.resolve(interp.session)
         if params is not None:
             from presto_tpu.templates import runtime as TR
             tp = TR.TraceParams(list(it))
-            with TR.active(tp):
+            with TR.active(tp), K.use_backend(backend):
                 out = interp.run(plan)
             meta["param_bindings"] = dict(tp.bindings)
         else:
-            out = interp.run(plan)
+            with K.use_backend(backend):
+                out = interp.run(plan)
         meta["out"] = [
             (sym, v.dtype, v.dictionary, v.valid is not None)
             for sym, v in out.cols.items()]
         meta["ok_keys"] = interp.ok_keys
         meta["used_capacity"] = interp.used_capacity
+        meta["kernel_backend"] = backend
+        meta["kernels"] = dict(getattr(interp, "kernel_used", {}))
         res = []
         for sym, v in out.cols.items():
             res.append(v.data)
@@ -714,11 +741,16 @@ def prepare_plan(engine, plan: N.PlanNode, scan_inputs: list[ScanInput]):
             # a failed rung's program is dead weight in the bounded
             # LRU: future runs jump straight to the successful caps
             cache.discard((base_key, caps_key))
-        for key, okv in zip(meta["ok_keys"], oks_np):
-            if not okv:
-                capacities[key] = (RETRY_GROWTH
-                                   * meta["used_capacity"][key])
-    raise RuntimeError("hash table capacity retry limit exceeded")
+        # the LOUD path of what used to be a silent in-kernel
+        # give-up: grow every failed capacity and count hash-table
+        # overflows, then retry (ops/hash.grow_overflowed — shared by
+        # all four retry ladders)
+        from presto_tpu.ops.hash import grow_overflowed
+        grow_overflowed(capacities, meta["ok_keys"], oks_np,
+                        meta["used_capacity"], RETRY_GROWTH)
+    from presto_tpu.ops.hash import HashChainOverflow
+    raise HashChainOverflow(
+        "hash table capacity retry limit exceeded")
 
 
 # XLA compile time grows superlinearly with program size (a 5-join

@@ -21,6 +21,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from presto_tpu import kernels as K
 from presto_tpu import types as T
 from presto_tpu.expr import aggregates as A
 from presto_tpu.expr import ir
@@ -594,16 +595,14 @@ def apply_join(left: DTable, right: DTable, node: N.Join,
         if verify is not True:
             found = found & verify
     else:
+        # backend-dispatched lookup (presto_tpu/kernels/): Pallas
+        # open-addressing build+probe on TPU (capacity-sized table,
+        # ok=False on chain overflow -> capacity retry ladder), the
+        # sorted-merge lookup as the XLA fallback (always ok)
         rh = _row_hash(right, rkeys)
-        _bsh, bsidx = H.sort_build_side(rh, build_live)
         ph = _row_hash(left, lkeys)
-        lo, count, found = H.probe_runs(rh, build_live, ph, probe_live)
-        # representative on duplicate build keys: the run's last sorted
-        # row = the largest source index (stable sort), matching the
-        # previous open-addressing table's scatter-max choice
-        build_row = jnp.where(
-            found, bsidx[jnp.clip(lo + count - 1, 0, right.n - 1)], -1)
-        ok = jnp.asarray(True)  # sorted build: no table, no overflow
+        build_row, found, ok = K.dispatch("join_lookup")(
+            rh, build_live, ph, probe_live, capacity)
 
         gather = jnp.clip(build_row, 0, right.n - 1)
         found = found & _verify_keys(left, right, node.criteria, None,
@@ -645,16 +644,42 @@ def apply_join(left: DTable, right: DTable, node: N.Join,
 
 
 def apply_multi_join(spine: DTable, builds: list[DTable],
-                     node: "N.MultiJoin") -> DTable:
+                     node: "N.MultiJoin", growth: int = 1) -> tuple:
     """Fused multi-way INNER equi-join (plan/nodes.MultiJoin): one
     sequential probe walk over the spine's static width. Every build
     is unique (FK->PK) and residual-free by construction, so each step
-    is one sorted lookup (sort_build_side + probe_runs — no hash
-    table, no overflow retry) whose gathered columns immediately
-    become probe keys for later builds; a single live mask accumulates
-    the conjunction of all matches. The cascade of binary joins this
+    is one lookup whose gathered columns immediately become probe
+    keys for later builds; a single live mask accumulates the
+    conjunction of all matches. The cascade of binary joins this
     replaces materialized (and in segmented execution, compacted and
-    re-uploaded) an intermediate DTable per join."""
+    re-uploaded) an intermediate DTable per join.
+
+    Backend-dispatched (presto_tpu/kernels/): under
+    ``kernel_backend=pallas`` the WHOLE chain runs as one Pallas
+    probe-walk kernel over per-build open-addressing tables
+    (kernels/multijoin.py — k probes while each spine tile is VMEM
+    resident, no sorts); the XLA walk below is the fallback, one
+    sorted lookup per step. ``growth`` scales every table capacity
+    (the retry ladder's knob on chain overflow). Returns
+    (DTable, ok) — ok is always True on the XLA path (sorted builds
+    cannot overflow)."""
+    # kernels self-note attribution: try_fused notes pallas only when
+    # it actually runs; a declined chain records the XLA walk
+    fused = K.dispatch("multijoin")(
+        spine.cols, spine.live_mask(), spine.n,
+        [(b.cols, b.live_mask(), b.n) for b in builds],
+        node.criteria, growth)
+    if fused is not None:
+        gathers, live, ok = fused
+        out = dict(spine.cols)
+        for bdt, gather in zip(builds, gathers):
+            for sym, v in bdt.cols.items():
+                out[sym] = Val(
+                    v.dtype, v.data[gather],
+                    None if v.valid is None else v.valid[gather],
+                    v.dictionary)
+        return DTable(out, live, spine.n), ok
+    K.note("xla:multijoin")
     out = dict(spine.cols)
     live = spine.live_mask()
     width = spine.n
@@ -681,7 +706,7 @@ def apply_multi_join(spine: DTable, builds: list[DTable],
                            None if v.valid is None else v.valid[gather],
                            v.dictionary)
         live = probe_live & found
-    return DTable(out, live, width)
+    return DTable(out, live, width), jnp.asarray(True)
 
 
 def concat_dtables(parts: list[DTable]) -> DTable:
@@ -860,13 +885,11 @@ def apply_semijoin(dt: DTable, filt: DTable, node: N.SemiJoin,
             jnp.clip(pkey - lo, 0, span - 1).astype(jnp.int32)]
         ok = jnp.asarray(True)
     else:
+        # backend-dispatched lookup, same dispatch as apply_join
         fh = _row_hash(filt, node.filter_keys)
-        _bsh, bsidx = H.sort_build_side(fh, build_live)
         sh = _row_hash(dt, node.source_keys)
-        lo, count, found = H.probe_runs(fh, build_live, sh, probe_live)
-        build_row = jnp.where(
-            found, bsidx[jnp.clip(lo + count - 1, 0, filt.n - 1)], -1)
-        ok = jnp.asarray(True)  # sorted build: no table, no overflow
+        build_row, found, ok = K.dispatch("join_lookup")(
+            fh, build_live, sh, probe_live, capacity)
         found = found & _verify_keys(
             dt, filt, list(zip(node.source_keys, node.filter_keys)),
             None, jnp.clip(build_row, 0, filt.n - 1))
@@ -893,14 +916,25 @@ def compact_dtable(dt: DTable, capacity: int) -> tuple:
     """Gather live rows to the front of a ``capacity``-row DTable (the
     page-compaction analog inside a traced program). Returns
     (DTable [capacity], ok); ok is False when live rows overflow the
-    capacity (host retries with a grown capacity)."""
+    capacity (host retries with a grown capacity).
+
+    Backend-dispatched (presto_tpu/kernels/compact.py): the Pallas
+    kernel streams the mask + columns once, writing survivors densely
+    from a running VMEM count; the XLA fallback is the nonzero+gather
+    this always was. Stable order and the overflow flag are identical
+    on both backends."""
     live = dt.live_mask()
     cnt = jnp.sum(live.astype(jnp.int32))
     ok = cnt <= capacity
-    idx = jnp.nonzero(live, size=capacity, fill_value=dt.n - 1)[0]
+    arrays: dict = {}
+    for sym, v in dt.cols.items():
+        arrays[f"{sym}!d"] = v.data
+        if v.valid is not None:
+            arrays[f"{sym}!v"] = v.valid
+    out = K.dispatch("compact")(live, arrays, capacity)
     cols = {
-        sym: Val(v.dtype, v.data[idx],
-                 None if v.valid is None else v.valid[idx], v.dictionary)
+        sym: Val(v.dtype, out[f"{sym}!d"], out.get(f"{sym}!v"),
+                 v.dictionary)
         for sym, v in dt.cols.items()}
     return DTable(cols, jnp.arange(capacity) < cnt, capacity), ok
 

@@ -90,10 +90,12 @@ def _profiled_compile_run(engine, plan, scans):
         run_s = time.perf_counter() - t0
         if oks_np.all():
             return meta, res, live, counts, compile_s, run_s
-        for key, okv in zip(meta["ok_keys"], oks_np):
-            if not okv:
-                capacities[key] = 4 * meta["used_capacity"][key]
-    raise RuntimeError("hash table capacity retry limit exceeded")
+        from presto_tpu.ops.hash import grow_overflowed
+        grow_overflowed(capacities, meta["ok_keys"], oks_np,
+                        meta["used_capacity"])
+    from presto_tpu.ops.hash import HashChainOverflow
+    raise HashChainOverflow(
+        "hash table capacity retry limit exceeded")
 
 
 def _profiled_runner(engine, mat, scans, cap_floor=None):

@@ -106,6 +106,10 @@ TRACE_RELEVANT_PROPERTIES = (
     "groupby_table_size",
     "join_distribution_type",
     "join_salting",
+    # kernel_backend selects the operator inner-loop implementation
+    # (presto_tpu/kernels/ dispatch) at trace time: pallas and xla
+    # traces are different programs and must not share an entry
+    "kernel_backend",
     "partial_aggregation",
     "partitioned_agg_min_groups",
     "skew_hot_key_threshold",
@@ -201,11 +205,17 @@ def platform_fingerprint(mesh_shape: tuple | None = None) -> tuple:
     programs) the mesh shape."""
     import jax
     import jaxlib
+
+    from presto_tpu import kernels as K
     devs = jax.devices()
     return (jax.__version__, jaxlib.__version__,
             jax.default_backend(), len(devs),
             getattr(devs[0], "device_kind", "?"),
             bool(jax.config.jax_enable_x64), PROGRAM_FORMAT,
+            # what kernel_backend=auto resolves to here: a persisted
+            # entry from a TPU process (pallas kernels inside) must
+            # not be loaded by a CPU process expecting XLA bodies
+            f"kernels-{K.default_backend()}",
             mesh_shape)
 
 

@@ -133,12 +133,14 @@ def try_execute_streamed(engine, plan: N.PlanNode):
             oks_np = np.asarray(oks)
             if oks_np.all():
                 break
-            for key, okv in zip(meta["ok_keys"], oks_np):
-                if not okv:
-                    capacities[key] = 4 * meta["used_capacity"][key]
+            from presto_tpu.ops.hash import grow_overflowed
+            grow_overflowed(capacities, meta["ok_keys"], oks_np,
+                            meta["used_capacity"])
             compiled = None  # recompile with grown capacity
         else:
-            raise RuntimeError("hash table capacity retry limit exceeded")
+            from presto_tpu.ops.hash import HashChainOverflow
+            raise HashChainOverflow(
+                "hash table capacity retry limit exceeded")
         out_schema = meta["out"]
         partial_cols.append([np.asarray(r) for r in res])
         partial_live.append(np.asarray(live))

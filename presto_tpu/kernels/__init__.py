@@ -1,0 +1,151 @@
+"""Hand-written Pallas/Mosaic kernels for the operator inner loops.
+
+The operator layer (exec/operators.py) lowers everything to
+whole-array XLA ops over pow2-padded buffers — hash joins pay
+sort/gather cascades, aggregations pay full-width segment ops, and
+compaction pays nonzero+gather passes. This package hand-writes the
+3-4 inner loops that dominate ``system.operator_stats`` as Pallas
+kernels with tiled HBM->VMEM pipelines:
+
+==============  ===================================  ====================
+kernel          Pallas implementation                XLA fallback
+==============  ===================================  ====================
+join_lookup     open-addressing build+probe          sorted-merge lookup
+                (kernels/hashjoin.py)                (ops/hash.probe_runs)
+multijoin       fused star-chain probe walk          sequential sorted
+                (kernels/multijoin.py)               walk (apply_multi_join)
+agg_sum/min/max per-tile VMEM accumulate             ops/segred.py
+                (kernels/segagg.py)                  (MXU limb matmuls)
+compact         one-pass dense survivor write        nonzero+gather
+                (kernels/compact.py)                 (compact_dtable)
+==============  ===================================  ====================
+
+Every kernel has a NUMERICALLY IDENTICAL fallback — the pre-kernel
+XLA path — registered beside it in :data:`KERNELS` (the
+``kernel-parity`` lint rule keeps the table total). Selection is the
+``kernel_backend`` session property:
+
+- ``auto`` (default): Pallas on TPU, XLA elsewhere;
+- ``pallas``: force the kernels; off-TPU they run under
+  ``pl.pallas_call(interpret=True)`` so the CPU test tier executes
+  the real kernel bodies;
+- ``xla``: force the fallbacks.
+
+The resolved backend is installed as an ambient context for the
+duration of one plan trace (both interpreters wrap ``interp.run``),
+rides the program-cache key (``kernel_backend`` is in
+TRACE_RELEVANT_PROPERTIES and the resolved default rides the
+platform fingerprint), and every dispatch is noted against the plan
+node being traced so ``system.operator_stats`` can name the kernel
+and split execute wall per operator.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+
+from presto_tpu.kernels import compact as _compact
+from presto_tpu.kernels import hashjoin as _hashjoin
+from presto_tpu.kernels import multijoin as _multijoin
+from presto_tpu.kernels import segagg as _segagg
+
+BACKENDS = ("auto", "pallas", "xla")
+
+_ACTIVE: contextvars.ContextVar[str] = contextvars.ContextVar(
+    "presto_tpu_kernel_backend", default="xla")
+_USED: contextvars.ContextVar[list | None] = contextvars.ContextVar(
+    "presto_tpu_kernel_used", default=None)
+
+
+# kernel name -> backend -> implementation. Both entries of every row
+# must exist and be reachable from dispatch() — asserted statically by
+# the kernel-parity lint rule (lint/kernels.py).
+KERNELS: dict[str, dict[str, object]] = {
+    "join_lookup": {"pallas": _hashjoin.lookup_join_pallas,
+                    "xla": _hashjoin.lookup_join_xla},
+    "agg_sum": {"pallas": _segagg.segment_sum_pallas,
+                "xla": _segagg.segment_sum_xla},
+    "agg_max": {"pallas": _segagg.segment_max_pallas,
+                "xla": _segagg.segment_max_xla},
+    "agg_min": {"pallas": _segagg.segment_min_pallas,
+                "xla": _segagg.segment_min_xla},
+    "compact": {"pallas": _compact.filter_compact_pallas,
+                "xla": _compact.filter_compact_xla},
+    "multijoin": {"pallas": _multijoin.try_fused,
+                  "xla": _multijoin.try_fused_xla},
+}
+
+
+def default_backend() -> str:
+    """What ``auto`` resolves to on this process' platform."""
+    import jax
+    return "pallas" if jax.default_backend() == "tpu" else "xla"
+
+
+def interpret_mode() -> bool:
+    """Pallas kernels run interpreted off-TPU (forced ``pallas`` on a
+    CPU container is exactly how tier-1 exercises the kernel bodies)."""
+    import jax
+    return jax.default_backend() != "tpu"
+
+
+def resolve(session) -> str:
+    """Resolve the session's ``kernel_backend`` property to a concrete
+    backend for this trace."""
+    try:
+        value = str(session.get("kernel_backend") or "auto").lower()
+    except Exception:  # noqa: BLE001 - sessionless callers get auto
+        value = "auto"
+    if value == "auto":
+        return default_backend()
+    return value if value in ("pallas", "xla") else default_backend()
+
+
+def active_backend() -> str:
+    return _ACTIVE.get()
+
+
+@contextlib.contextmanager
+def use_backend(backend: str):
+    """Install the resolved backend for one plan trace (ambient, like
+    the trace context — operators and ops/segred read it instead of
+    threading a session through every call)."""
+    tok = _ACTIVE.set(backend)
+    try:
+        yield
+    finally:
+        _ACTIVE.reset(tok)
+
+
+@contextlib.contextmanager
+def collect():
+    """Collect the kernel dispatches of one plan node's trace (the
+    interpreter wraps each node handler; nested nodes re-enter, so
+    notes land on the NEAREST enclosing node)."""
+    used: list[str] = []
+    tok = _USED.set(used)
+    try:
+        yield used
+    finally:
+        _USED.reset(tok)
+
+
+def dispatch(name: str):
+    """The active backend's implementation of kernel ``name``.
+    Attribution is SELF-noted by the implementations (each function
+    calls :func:`note` for the path that actually executes) — a
+    pallas entry may still decline at its eligibility gate and run
+    the XLA fallback, and a dispatch-time note would name a kernel
+    that never ran."""
+    backend = _ACTIVE.get()
+    fns = KERNELS[name]
+    return fns.get(backend) or fns["xla"]
+
+
+def note(tag: str) -> None:
+    """Record one kernel execution (``backend:kernel``) against the
+    collecting plan node. No-op outside a collection scope."""
+    used = _USED.get()
+    if used is not None and tag not in used:
+        used.append(tag)

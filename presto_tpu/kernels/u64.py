@@ -1,0 +1,132 @@
+"""64-bit hash arithmetic as 32-bit limb pairs for Pallas kernels.
+
+Mosaic has no native 64-bit ALU (TPU v5e emulates int64, and Pallas
+rejects it outright inside kernels), so every kernel in this package
+carries row hashes as two uint32 planes ``(hi, lo)``. This module is
+the limb calculus: splitting/packing against the uint64 arrays the
+XLA-side hash machinery (ops/hash.py) produces, the golden-ratio
+multiply of ``combine_hashes`` re-derived over 16-bit limb products,
+and a 32-bit avalanche mix for slot addressing.
+
+The multiply must be BIT-IDENTICAL to ``ops/hash.combine_hashes``:
+in-kernel probe hashes are compared against table entries built from
+the XLA-computed combined hash, so one differing bit is a missed join
+row. tests/test_kernels.py cross-checks every helper against the
+uint64 reference on random inputs.
+
+Slot addressing gets a murmur3 finalizer (``mix32``) the XLA path
+never needed: ``hash_int_column`` is deliberately an identity key
+(see ops/hash.py — sort-based kernels only need equality), but open
+addressing with identity keys degenerates — dense key ranges form
+one giant cluster and every miss walks it end to end. Mixing only
+decides WHERE a hash sits, never WHETHER two hashes are equal, so
+layout stays an internal detail and results stay byte-identical.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+# golden-ratio constant of ops/hash.combine_hashes, split into limbs.
+# Plain Python ints throughout: module-level jnp scalars would be
+# CLOSURE-CAPTURED device arrays inside pallas kernel functions
+# (pallas rejects captured constants); weak-typed ints inline as
+# literals instead.
+PHI64 = 0x9E3779B97F4A7C15
+_MASK16 = 0xFFFF
+
+# the EMPTY slot sentinel (ops/hash._EMPTY = max uint64) per plane
+EMPTY32 = 0xFFFFFFFF
+
+
+def split(h):
+    """uint64 [n] -> (hi uint32 [n], lo uint32 [n])."""
+    return ((h >> jnp.uint64(32)).astype(jnp.uint32),
+            h.astype(jnp.uint32))
+
+
+def join(hi, lo):
+    """Inverse of :func:`split` (host/XLA side only)."""
+    return ((hi.astype(jnp.uint64) << jnp.uint64(32))
+            | lo.astype(jnp.uint64))
+
+
+def _mul32_wide(a, b):
+    """Full 64-bit product of two uint32 values as (hi, lo) uint32,
+    via 16-bit limb products (each partial fits uint32 exactly)."""
+    a0, a1 = a & _MASK16, a >> jnp.uint32(16)
+    b0, b1 = b & _MASK16, b >> jnp.uint32(16)
+    p00 = a0 * b0
+    p01 = a0 * b1
+    p10 = a1 * b0
+    p11 = a1 * b1
+    mid = (p00 >> jnp.uint32(16)) + (p01 & _MASK16) + (p10 & _MASK16)
+    lo = (p00 & _MASK16) | ((mid & _MASK16) << jnp.uint32(16))
+    hi = (p11 + (p01 >> jnp.uint32(16)) + (p10 >> jnp.uint32(16))
+          + (mid >> jnp.uint32(16)))
+    return hi, lo
+
+
+def mul_const(hi, lo, c: int):
+    """(hi, lo) * c mod 2^64 for a Python-int constant ``c``."""
+    c_lo = jnp.uint32(c & 0xFFFFFFFF)
+    c_hi = jnp.uint32((c >> 32) & 0xFFFFFFFF)
+    phi, plo = _mul32_wide(lo, c_lo)
+    # high word only needs the products' low 32 bits (wrapping * is it)
+    out_hi = phi + lo * c_hi + hi * c_lo
+    return out_hi, plo
+
+
+def combine_step(hi, lo, kh, kl):
+    """One accumulation step of ops/hash.combine_hashes:
+    ``acc = acc * PHI64 ^ key``."""
+    hi, lo = mul_const(hi, lo, PHI64)
+    return hi ^ kh, lo ^ kl
+
+
+def remap_empty(hi, lo):
+    """combine_hashes' tail: keep the EMPTY sentinel unreachable
+    (``where(out == EMPTY, out - 1, out)`` — EMPTY has lo = all-ones,
+    so the decrement never borrows into the high word)."""
+    is_empty = (hi == EMPTY32) & (lo == EMPTY32)
+    return hi, jnp.where(is_empty, lo - jnp.uint32(1), lo)
+
+
+def mix32(x):
+    """murmur3 fmix32: avalanche a uint32 for open-address slot
+    choice (identity row keys would otherwise cluster; see module
+    docstring). Layout-only — never part of hash equality."""
+    x = x ^ (x >> jnp.uint32(16))
+    x = x * jnp.uint32(0x85EBCA6B)
+    x = x ^ (x >> jnp.uint32(13))
+    x = x * jnp.uint32(0xC2B2AE35)
+    return x ^ (x >> jnp.uint32(16))
+
+
+def slot32(hi, lo):
+    """Open-address home slot of a 64-bit hash. Both words avalanche
+    INDEPENDENTLY before folding: a plain ``mix32(hi ^ lo)`` would
+    alias every key whose words are equal — e.g. the identity int
+    keys (m << 32) | m — into ONE cluster at every table size, so no
+    capacity-retry rung could ever break the chain. Layout-only."""
+    return mix32(lo ^ mix32(hi))
+
+
+def pad_rows(arr, tile: int, fill):
+    """Pad an [n, ...] array's row axis up to a multiple of ``tile``
+    (the shared tile-padding of every kernel's blocked inputs)."""
+    n = arr.shape[0]
+    pad = (-n) % tile
+    if pad == 0:
+        return arr
+    widths = [(0, pad)] + [(0, 0)] * (arr.ndim - 1)
+    return jnp.pad(arr, widths, constant_values=fill)
+
+
+def add64(acc_hi, acc_lo, v_hi, v_lo):
+    """(acc + v) mod 2^64 in limb planes (carry via unsigned wrap
+    detection) — the exact two's-complement accumulate of an int64
+    scatter-add, including wraparound."""
+    lo = acc_lo + v_lo
+    carry = (lo < acc_lo).astype(jnp.uint32)
+    return acc_hi + v_hi + carry, lo

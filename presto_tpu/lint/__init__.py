@@ -57,5 +57,6 @@ from presto_tpu.lint import pools as _pools  # noqa: E402,F401
 from presto_tpu.lint import spans as _spans  # noqa: E402,F401
 from presto_tpu.lint import races as _races  # noqa: E402,F401
 from presto_tpu.lint import handoff as _handoff  # noqa: E402,F401
+from presto_tpu.lint import kernels as _kernels  # noqa: E402,F401
 
 __all__ = ["Finding", "Project", "available_rules", "run_lint"]

@@ -1,0 +1,203 @@
+"""Kernel-parity rule: every Pallas kernel has a reachable fallback.
+
+The kernel subsystem's contract (presto_tpu/kernels/__init__.py) is
+that the ``kernel_backend`` session property can always force ``xla``
+and get numerically identical results — which only holds if EVERY
+Pallas kernel is registered in the :data:`KERNELS` dispatch table
+beside an XLA fallback, and both names resolve to real functions. A
+Pallas kernel wired directly into an operator (bypassing the table)
+would be unreachable from the session property, untested by the
+parity tier, and invisible to per-operator kernel attribution.
+
+Checked statically, in the spirit of lint/dispatch.py's plan-node
+exhaustiveness sites:
+
+- ``KERNELS`` is a literal dict of ``name -> {"pallas": ref,
+  "xla": ref}`` with BOTH backend keys per row;
+- every referenced function exists in the kernels module it names;
+- every module-level ``*_pallas`` function in ``presto_tpu/kernels/``
+  appears in some row's ``pallas`` slot (reachability from the
+  dispatch table);
+- ``dispatch`` itself exists and reads ``KERNELS``.
+
+Kernels exempt from registration (helpers, building blocks) use a
+module-level ``KERNEL_DISPATCH_EXEMPT = {"fn_name": "reason"}`` in
+their defining module — same hygiene as DISPATCH_EXEMPT, including
+staleness detection.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from presto_tpu.lint.core import (Finding, Project, SourceModule,
+                                  qual_name, rule)
+
+REGISTRY_PATH = "presto_tpu/kernels/__init__.py"
+PACKAGE_PREFIX = "presto_tpu/kernels/"
+
+
+def _registry_rows(mod: SourceModule):
+    """Parse ``KERNELS = {...}``: name -> {backend: (module_alias,
+    fn_name, line)}; None when the assignment is missing/not literal."""
+    for node in mod.tree.body:
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, ast.AnnAssign):
+            targets = [node.target]
+        else:
+            continue
+        if not any(isinstance(t, ast.Name) and t.id == "KERNELS"
+                   for t in targets):
+            continue
+        if not isinstance(node.value, ast.Dict):
+            return None
+        rows: dict[str, dict[str, tuple]] = {}
+        for k, v in zip(node.value.keys, node.value.values):
+            if not (isinstance(k, ast.Constant)
+                    and isinstance(k.value, str)
+                    and isinstance(v, ast.Dict)):
+                return None
+            entry: dict[str, tuple] = {}
+            for bk, bv in zip(v.keys, v.values):
+                if not (isinstance(bk, ast.Constant)
+                        and isinstance(bk.value, str)):
+                    return None
+                q = qual_name(bv)
+                if q is None or "." not in q:
+                    return None
+                alias, fn = q.rsplit(".", 1)
+                entry[bk.value] = (alias, fn, bv.lineno)
+            rows[k.value] = entry
+        return rows
+    return None
+
+
+def _module_functions(mod: SourceModule) -> set[str]:
+    return {n.name for n in mod.tree.body
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+
+
+def _exempt(mod: SourceModule) -> dict[str, tuple[str, int]]:
+    out: dict[str, tuple[str, int]] = {}
+    for node in mod.tree.body:
+        if not (isinstance(node, ast.Assign)
+                and any(isinstance(t, ast.Name)
+                        and t.id == "KERNEL_DISPATCH_EXEMPT"
+                        for t in node.targets)
+                and isinstance(node.value, ast.Dict)):
+            continue
+        for k, v in zip(node.value.keys, node.value.values):
+            if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                reason = (v.value if isinstance(v, ast.Constant)
+                          and isinstance(v.value, str) else "")
+                out[k.value] = (reason, k.lineno)
+    return out
+
+
+@rule("kernel-parity")
+def kernel_parity(project: Project) -> list[Finding]:
+    reg_mod = project.by_relpath.get(REGISTRY_PATH)
+    if reg_mod is None:
+        return []  # subtree run without the kernels package
+    findings: list[Finding] = []
+    rows = _registry_rows(reg_mod)
+    if rows is None:
+        return [Finding(
+            "kernel-parity", REGISTRY_PATH, 1, 0,
+            "KERNELS must be a literal dict of "
+            "name -> {'pallas': fn, 'xla': fn} (the parity contract "
+            "is checked statically against it)")]
+
+    # module alias -> kernels submodule relpath (from the imports)
+    submods = {m.relpath.rsplit("/", 1)[-1][:-3]: m
+               for m in project.modules
+               if m.relpath.startswith(PACKAGE_PREFIX)
+               and m.relpath != REGISTRY_PATH}
+    alias_to_mod: dict[str, SourceModule] = {}
+    for alias, target in reg_mod.aliases.items():
+        leaf = target.rsplit(".", 1)[-1]
+        if leaf in submods:
+            alias_to_mod[alias] = submods[leaf]
+
+    registered_pallas: set[tuple[str, str]] = set()  # (module, fn)
+    for name, entry in sorted(rows.items()):
+        for backend in ("pallas", "xla"):
+            if backend not in entry:
+                findings.append(Finding(
+                    "kernel-parity", REGISTRY_PATH, 1, 0,
+                    f"kernel {name!r} has no {backend!r} entry — "
+                    "every Pallas kernel needs a registered XLA "
+                    "fallback (and vice versa) so kernel_backend "
+                    "can always force either"))
+                continue
+            alias, fn, line = entry[backend]
+            mod = alias_to_mod.get(alias)
+            if mod is None:
+                findings.append(Finding(
+                    "kernel-parity", REGISTRY_PATH, line, 0,
+                    f"kernel {name!r} {backend} entry references "
+                    f"unknown module alias {alias!r}"))
+                continue
+            if fn not in _module_functions(mod):
+                findings.append(Finding(
+                    "kernel-parity", REGISTRY_PATH, line, 0,
+                    f"kernel {name!r} {backend} entry references "
+                    f"{mod.relpath}:{fn} which does not exist"))
+            elif backend == "pallas":
+                registered_pallas.add((mod.relpath, fn))
+
+    # a dispatch() that ignores the table would make the rows above
+    # decorative: require the function and a KERNELS read inside it
+    dispatch_fns = [n for n in reg_mod.tree.body
+                    if isinstance(n, ast.FunctionDef)
+                    and n.name == "dispatch"]
+    if not dispatch_fns or not any(
+            isinstance(sub, ast.Name) and sub.id == "KERNELS"
+            for fn in dispatch_fns for sub in ast.walk(fn)):
+        findings.append(Finding(
+            "kernel-parity", REGISTRY_PATH, 1, 0,
+            "kernels/__init__.py must define dispatch() reading the "
+            "KERNELS table (the kernel_backend selection point)"))
+
+    # reachability: every *_pallas kernel function is registered
+    for mod in project.modules:
+        if not mod.relpath.startswith(PACKAGE_PREFIX) \
+                or mod.relpath == REGISTRY_PATH:
+            continue
+        exempt = _exempt(mod)
+        fns = _module_functions(mod)
+        for node in mod.tree.body:
+            if not isinstance(node, ast.FunctionDef):
+                continue
+            if not node.name.endswith("_pallas"):
+                continue
+            if (mod.relpath, node.name) in registered_pallas:
+                continue
+            if node.name in exempt:
+                continue
+            findings.append(Finding(
+                "kernel-parity", mod.relpath, node.lineno, 0,
+                f"Pallas kernel {node.name} is not registered in the "
+                "kernel_backend dispatch table "
+                "(kernels/__init__.KERNELS) — unreachable from the "
+                "session property and invisible to parity testing; "
+                "register it or list it in KERNEL_DISPATCH_EXEMPT "
+                "with a reason"))
+        for name, (reason, line) in sorted(exempt.items()):
+            if name not in fns:
+                findings.append(Finding(
+                    "kernel-parity", mod.relpath, line, 0,
+                    f"KERNEL_DISPATCH_EXEMPT lists unknown function "
+                    f"{name!r} (stale entry?)"))
+            elif (mod.relpath, name) in registered_pallas:
+                findings.append(Finding(
+                    "kernel-parity", mod.relpath, line, 0,
+                    f"KERNEL_DISPATCH_EXEMPT lists {name} but it IS "
+                    "registered; drop the stale exemption"))
+            elif not reason:
+                findings.append(Finding(
+                    "kernel-parity", mod.relpath, line, 0,
+                    f"KERNEL_DISPATCH_EXEMPT entry for {name} needs "
+                    "a non-empty reason string"))
+    return findings

@@ -463,7 +463,9 @@ def _record_program(engine, rec: TaskRecorder, plan, meta, counts,
         # colliding planNodeIds
         program = rec.programs
         rec.programs += 1
+    kernels_by_pos = meta.get("kernels") or {}
     ops: list[dict] = []
+    weights: list[int] = []
     for pos, node in by_pos.items():
         rows = actual.get(pos)
         if rows is None:
@@ -484,12 +486,24 @@ def _record_program(engine, rec: TaskRecorder, plan, meta, counts,
             "inputRows": -1 if in_rows is None else int(in_rows),
             "outputRows": int(rows), "outputBytes": int(nbytes),
             "estRows": -1 if est is None else int(est),
+            "kernel": ",".join(kernels_by_pos.get(pos) or ()),
         })
+        weights.append((0 if in_rows is None else int(in_rows))
+                       + int(rows) + 1)
         if ntype in _DIVERGENCE_NODES and est is not None:
             ratio = (rows + 1) / (est + 1)
             _DIVERGENCE_RATIO.observe(ratio, node_type=ntype)
             DIVERGENCE.observe(qid, rec.stage, f"{program}.{pos}",
                                ntype, _subtree_table(node), est, rows)
+
+    # split this program's execute wall across its operators,
+    # proportional to rows-through (in+out; XLA fuses the chain, so a
+    # per-operator device timer does not exist — the weighting makes
+    # "which operator dominates" answerable from SQL; rounding means
+    # the parts sum to the program wall only approximately)
+    total_w = sum(weights) or 1
+    for op, w in zip(ops, weights):
+        op["wallMillis"] = round(execute_s * 1000.0 * w / total_w)
 
     _observe_shapes(by_pos, order, actual)
 

@@ -37,6 +37,53 @@ _EMPTY = jnp.uint64(0xFFFFFFFFFFFFFFFF)
 _NULL_KEY_HASH = jnp.uint64(0x9E3779B97F4A7C15)
 
 
+class HashChainOverflow(RuntimeError):
+    """A hash-table kernel gave up LOUDLY: a probe chain exceeded its
+    bound (Pallas open addressing, ``max_probes``) or a group count
+    exceeded every capacity the retry ladder was willing to try
+    (``max_rounds`` analog). Raised by the executor when the capacity
+    retry ladder exhausts — the in-kernel bound itself surfaces as a
+    failed ``ok`` flag that the ladder catches and retries at a
+    larger capacity, counted per occurrence in
+    ``presto_tpu_hash_probe_overflow_total``. Subclasses RuntimeError
+    so callers matching the ladder's historical exception keep
+    working."""
+
+
+def grow_overflowed(capacities: dict, ok_keys, oks,
+                    used_capacity: dict, growth: int = 4) -> int:
+    """Host-side body of one capacity-retry rung, shared by every
+    retry ladder (prepare_plan, the distributed executor, block
+    streaming, EXPLAIN ANALYZE): grow each failed key's capacity by
+    ``growth`` and count hash-TABLE overflows (kinds table/final) in
+    ``presto_tpu_hash_probe_overflow_total`` — output/compaction
+    capacity kinds are sizing misses, not hash-chain give-ups, and
+    stay out of the metric. Returns the counted overflow total."""
+    import numpy as np
+    overflowed = 0
+    for key, okv in zip(ok_keys, oks):
+        if not bool(np.asarray(okv)):
+            if key[1] in ("table", "final"):
+                overflowed += 1
+            capacities[key] = growth * used_capacity[key]
+    if overflowed:
+        note_probe_overflow(overflowed)
+    return overflowed
+
+
+def note_probe_overflow(count: int = 1) -> None:
+    """Count a kernel-reported hash-TABLE overflow — a bounded probe
+    chain giving up (Pallas open addressing) or a group/build count
+    exceeding its table capacity (the max_rounds analog). The loud
+    path of what used to be a silent give-up; output/compaction
+    capacity retries are deliberately NOT counted here."""
+    from presto_tpu.obs.metrics import REGISTRY
+    REGISTRY.counter(
+        "presto_tpu_hash_probe_overflow_total",
+        "hash-table probe-chain/capacity overflows caught by the "
+        "capacity retry ladder").inc(count)
+
+
 def _splitmix64(x):
     x = x.astype(jnp.uint64)
     x = (x + jnp.uint64(0x9E3779B97F4A7C15))

@@ -242,6 +242,16 @@ SYSTEM_SESSION_PROPERTIES: dict[str, tuple[Any, type, str]] = {
                                  "(templates/shapes.py); only "
                                  "consulted when plan_templates is "
                                  "on"),
+    "kernel_backend": ("auto", str,
+                       "operator inner-loop implementation: auto "
+                       "(hand-written Pallas kernels on TPU, XLA "
+                       "whole-array ops elsewhere) | pallas (force "
+                       "the kernels — off-TPU they run under "
+                       "pallas_call(interpret=True), which is how "
+                       "the CPU test tier executes the kernel "
+                       "bodies) | xla (force the fallbacks). "
+                       "Numerically identical results either way "
+                       "(presto_tpu/kernels/)"),
     "task_request_timeout_s": (300.0, float,
                                "HTTP deadline for coordinator->worker "
                                "task POSTs (was hard-coded 300)"),

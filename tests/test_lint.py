@@ -1083,3 +1083,99 @@ def test_unparseable_file_is_a_usage_error_not_a_traceback(tmp_path,
     (bad / "scratch.py").write_text("def broken(:\n")
     assert lint_main([str(tmp_path / "presto_tpu")]) == 2
     assert "cannot parse" in capsys.readouterr().err
+
+
+# -- kernel-parity ----------------------------------------------------------
+
+KERNELS_GOOD = {
+    "presto_tpu/kernels/__init__.py": """
+        from presto_tpu.kernels import body as _body
+
+        KERNELS = {
+            "thing": {"pallas": _body.thing_pallas,
+                      "xla": _body.thing_xla},
+        }
+
+        def dispatch(name):
+            return KERNELS[name]["xla"]
+    """,
+    "presto_tpu/kernels/body.py": """
+        def thing_pallas(x):
+            return x
+
+        def thing_xla(x):
+            return x
+    """,
+}
+
+
+def test_kernel_parity_clean_registry(tmp_path):
+    pkg = write_pkg(tmp_path, KERNELS_GOOD)
+    assert run_lint([pkg], rules=["kernel-parity"]) == []
+
+
+def test_kernel_parity_missing_fallback(tmp_path):
+    files = dict(KERNELS_GOOD)
+    files["presto_tpu/kernels/__init__.py"] = """
+        from presto_tpu.kernels import body as _body
+
+        KERNELS = {
+            "thing": {"pallas": _body.thing_pallas},
+        }
+
+        def dispatch(name):
+            return KERNELS[name]["pallas"]
+    """
+    pkg = write_pkg(tmp_path, files)
+    findings = run_lint([pkg], rules=["kernel-parity"])
+    assert any("no 'xla' entry" in f.message for f in findings)
+
+
+def test_kernel_parity_unregistered_pallas_kernel(tmp_path):
+    files = dict(KERNELS_GOOD)
+    files["presto_tpu/kernels/body.py"] = """
+        def thing_pallas(x):
+            return x
+
+        def thing_xla(x):
+            return x
+
+        def rogue_pallas(x):
+            return x
+    """
+    pkg = write_pkg(tmp_path, files)
+    findings = run_lint([pkg], rules=["kernel-parity"])
+    assert any("rogue_pallas" in f.message and
+               "not registered" in f.message for f in findings)
+
+
+def test_kernel_parity_dangling_reference_and_exemption(tmp_path):
+    files = dict(KERNELS_GOOD)
+    files["presto_tpu/kernels/__init__.py"] = """
+        from presto_tpu.kernels import body as _body
+
+        KERNELS = {
+            "thing": {"pallas": _body.missing_pallas,
+                      "xla": _body.thing_xla},
+        }
+
+        def dispatch(name):
+            return KERNELS[name]["xla"]
+    """
+    files["presto_tpu/kernels/body.py"] = """
+        KERNEL_DISPATCH_EXEMPT = {
+            "thing_pallas": "shared helper, not an entry point",
+            "ghost_pallas": "stale",
+        }
+
+        def thing_pallas(x):
+            return x
+
+        def thing_xla(x):
+            return x
+    """
+    pkg = write_pkg(tmp_path, files)
+    findings = run_lint([pkg], rules=["kernel-parity"])
+    msgs = [f.message for f in findings]
+    assert any("does not exist" in m for m in msgs)
+    assert any("ghost_pallas" in m and "stale" in m for m in msgs)

@@ -2,6 +2,7 @@
 64-bit scatter-add it replaces (jax.ops.segment_sum), including negative
 values, int64 wraparound, and uint64 checksum sums."""
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -100,3 +101,107 @@ def test_large_k_falls_back():
     want = np.zeros(k, np.int64)
     np.add.at(want, np.asarray(ids), x)
     np.testing.assert_array_equal(got, want)
+
+
+# -- fast-path vs slow-path equivalence (the _use_fast_path boundary) -------
+# The MXU limb path and the broadcast-compare path must agree with the
+# jax.ops scatter path on EXACTLY the inputs where eligibility flips:
+# one row below/at the BLOCK floor, one segment count at/above the
+# MAX_MATMUL_K / MAX_CMP_K ceilings, empty segments, rows that are all
+# dead (out-of-range segment ids drop on both paths), and NaN/NULL
+# data through min/max.
+
+
+def _sum_both_paths(x, ids, k):
+    got_fast = np.asarray(segred.segment_sum(jnp.asarray(x),
+                                             jnp.asarray(ids), k))
+    got_slow = np.asarray(jax.ops.segment_sum(jnp.asarray(x),
+                                              jnp.asarray(ids),
+                                              num_segments=k))
+    return got_fast, got_slow
+
+
+@pytest.mark.parametrize("n", [segred.BLOCK - 1, segred.BLOCK,
+                               segred.BLOCK + 1, 4 * segred.BLOCK])
+def test_sum_exact_block_boundary_sizes(n):
+    # n < BLOCK takes the scatter path, n >= BLOCK the MXU path:
+    # results must be identical either side of the flip
+    rng = np.random.default_rng(n)
+    x = rng.integers(-(1 << 40), 1 << 40, n).astype(np.int64)
+    ids = rng.integers(0, 5, n).astype(np.int32)
+    fast, slow = _sum_both_paths(x, ids, 5)
+    np.testing.assert_array_equal(fast, slow)
+
+
+@pytest.mark.parametrize("k", [segred.MAX_MATMUL_K,
+                               segred.MAX_MATMUL_K + 1])
+def test_sum_exact_segment_count_boundary(k):
+    rng = np.random.default_rng(k)
+    n = 3 * segred.BLOCK
+    x = rng.integers(-(1 << 40), 1 << 40, n).astype(np.int64)
+    ids = rng.integers(0, k, n).astype(np.int32)
+    fast, slow = _sum_both_paths(x, ids, k)
+    np.testing.assert_array_equal(fast, slow)
+
+
+@pytest.mark.parametrize("k", [segred.MAX_CMP_K, segred.MAX_CMP_K + 1])
+def test_minmax_exact_segment_count_boundary(k):
+    rng = np.random.default_rng(k)
+    n = 3 * segred.BLOCK
+    x = rng.integers(-(1 << 50), 1 << 50, n).astype(np.int64)
+    ids = jnp.asarray(rng.integers(0, k, n).astype(np.int32))
+    xj = jnp.asarray(x)
+    np.testing.assert_array_equal(
+        np.asarray(segred.segment_max(xj, ids, k)),
+        np.asarray(jax.ops.segment_max(xj, ids, num_segments=k)))
+    np.testing.assert_array_equal(
+        np.asarray(segred.segment_min(xj, ids, k)),
+        np.asarray(jax.ops.segment_min(xj, ids, num_segments=k)))
+
+
+def test_all_dead_rows_match_scatter_path():
+    # every row targets the out-of-range pad segment (how the engine
+    # masks dead __live__ rows out of a fold): both paths must drop
+    # them and report pure identities
+    n = 2 * segred.BLOCK
+    x = np.full(n, 123456789, np.int64)
+    ids = np.full(n, 7, np.int32)  # == num_segments: out of range
+    fast, slow = _sum_both_paths(x, ids, 7)
+    np.testing.assert_array_equal(fast, slow)
+    np.testing.assert_array_equal(fast, np.zeros(7, np.int64))
+    xj, idsj = jnp.asarray(x), jnp.asarray(ids)
+    np.testing.assert_array_equal(
+        np.asarray(segred.segment_max(xj, idsj, 7)),
+        np.asarray(jax.ops.segment_max(xj, idsj, num_segments=7)))
+
+
+def test_minmax_nan_identical_on_both_paths():
+    # NaN data rows (live SQL DOUBLE NaNs) must order identically on
+    # the broadcast-compare fast path and the scatter slow path (both
+    # propagate NaN into the segment's result)
+    rng = np.random.default_rng(17)
+    n = 3 * segred.BLOCK
+    x = rng.standard_normal(n)
+    x[:: 7] = np.nan
+    xj = jnp.asarray(x)
+    ids = jnp.asarray(rng.integers(0, 9, n).astype(np.int32))
+    fast_max = np.asarray(segred._cmp_reduce(xj, ids, 9, True))
+    slow_max = np.asarray(jax.ops.segment_max(xj, ids, num_segments=9))
+    np.testing.assert_array_equal(fast_max, slow_max)
+    fast_min = np.asarray(segred._cmp_reduce(xj, ids, 9, False))
+    slow_min = np.asarray(jax.ops.segment_min(xj, ids, num_segments=9))
+    np.testing.assert_array_equal(fast_min, slow_min)
+
+
+def test_null_masked_rows_fold_identically():
+    # NULL handling upstream masks rows via weight=0 + slot unchanged
+    # (expr/aggregates.fold): emulate by zeroing masked data — the
+    # fast path must agree with the scatter path on the masked fold
+    rng = np.random.default_rng(23)
+    n = 4 * segred.BLOCK
+    data = rng.integers(-(1 << 40), 1 << 40, n)
+    valid = rng.random(n) > 0.4
+    masked = np.where(valid, data, 0)
+    ids = rng.integers(0, 11, n).astype(np.int32)
+    fast, slow = _sum_both_paths(masked, ids, 11)
+    np.testing.assert_array_equal(fast, slow)
