@@ -159,6 +159,17 @@ class SystemConnector(_ReflectiveConnector):
             "table_name": T.VARCHAR, "est_rows": T.BIGINT,
             "actual_rows": T.BIGINT, "ratio": T.DOUBLE,
         },
+        # mid-query adaptive-execution audit (parallel/adaptive.py):
+        # every remainder re-plan, per-node strategy flip, capacity
+        # re-bucket and speculative re-dispatch, with the est-vs-
+        # actual rows that triggered it and the old -> new strategy
+        "adaptive_decisions": {
+            "query_id": T.VARCHAR, "stage": T.VARCHAR,
+            "kind": T.VARCHAR, "node_type": T.VARCHAR,
+            "detail": T.VARCHAR, "est_rows": T.BIGINT,
+            "actual_rows": T.BIGINT, "old_strategy": T.VARCHAR,
+            "new_strategy": T.VARCHAR,
+        },
         "query_history": {
             "query_id": T.VARCHAR, "state": T.VARCHAR,
             "user": T.VARCHAR, "query": T.VARCHAR,
@@ -189,6 +200,13 @@ class SystemConnector(_ReflectiveConnector):
                      r["node_type"], r["table"], r["est_rows"],
                      r["actual_rows"], float(r["ratio"]))
                     for r in DIVERGENCE.records()]
+        if name == "adaptive_decisions":
+            from presto_tpu.obs.qstats import ADAPTIVE
+            return [(r["query_id"], r["stage"], r["kind"],
+                     r["node_type"], r["detail"], r["est_rows"],
+                     r["actual_rows"], r["old_strategy"],
+                     r["new_strategy"])
+                    for r in ADAPTIVE.records()]
         if name == "query_history":
             history = getattr(self.engine, "history", None)
             if history is None:

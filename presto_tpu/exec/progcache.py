@@ -98,7 +98,13 @@ _LOAD_SECONDS = REGISTRY.histogram(
 # (PlanInterpreter / ShardedInterpreter): the canonical session
 # component of a cache key. Everything else either acts at plan time
 # (captured by the plan fingerprint) or host-side before/after the
-# compiled program runs.
+# compiled program runs. The adaptive-execution properties
+# (adaptive_replanning, speculative_execution, speculation_*) are
+# deliberately NOT listed: they steer the coordinator's HTTP stage
+# walk only, and re-keying compiled programs on them would evict warm
+# entries for a knob the trace never sees. (A replan changes plan
+# ANNOTATIONS — capacities, distributions — which already participate
+# via the plan fingerprint and capacity buckets.)
 TRACE_RELEVANT_PROPERTIES = (
     "broadcast_join_threshold_rows",
     "distributed_sort",

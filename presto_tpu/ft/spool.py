@@ -170,6 +170,13 @@ class TaskSpool:
                 shutil.rmtree(os.path.join(self.directory, name),
                               ignore_errors=True)
 
+    def delete_exact(self, task_id: str) -> None:
+        """Drop exactly one spooled task (the speculation loser-cancel
+        path: a losing primary's id is a PREFIX of its winning
+        attempt-versioned duplicate, so prefix deletion would wipe the
+        winner's pages too)."""
+        shutil.rmtree(self._task_dir(task_id), ignore_errors=True)
+
 
 class SpoolWriter:
     """Per-task page writer. Page indices are assigned here (the

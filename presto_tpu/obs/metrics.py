@@ -114,6 +114,12 @@ class Counter(_Metric):
         with self._lock:
             return self._values.get(_label_key(labels), 0)
 
+    def total(self) -> float:
+        """Sum over every label combination (bench/test reporting of
+        labeled counters — callers must not reach into _values)."""
+        with self._lock:
+            return sum(self._values.values())
+
 
 class Gauge(_Metric):
     kind = "gauge"

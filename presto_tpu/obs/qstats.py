@@ -826,6 +826,46 @@ class DivergenceLedger:
 DIVERGENCE = DivergenceLedger()
 
 
+# -- adaptive-execution decision log -----------------------------------------
+
+class AdaptiveLog:
+    """Bounded ring of mid-query adaptive-execution decisions
+    (parallel/adaptive.py) backing ``system.adaptive_decisions``: what
+    was re-planned (or speculated), why (est vs actual rows), and the
+    old -> new strategy — the audit trail for the within-query half of
+    the feedback loop, next to the between-queries divergence ledger
+    above."""
+
+    MAX_RECORDS = 2048
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._records: deque = deque(maxlen=self.MAX_RECORDS)
+
+    def note(self, query_id: str, stage: str, kind: str,
+             node_type: str = "", detail: str = "",
+             est_rows: int = -1, actual_rows: int = -1,
+             old_strategy: str = "", new_strategy: str = "") -> None:
+        with self._lock:
+            self._records.append({
+                "query_id": str(query_id), "stage": str(stage),
+                "kind": str(kind), "node_type": str(node_type),
+                "detail": str(detail)[:300],
+                "est_rows": int(est_rows),
+                "actual_rows": int(actual_rows),
+                "old_strategy": str(old_strategy),
+                "new_strategy": str(new_strategy),
+                "time": time.time(),
+            })
+
+    def records(self) -> list[dict]:
+        with self._lock:
+            return list(self._records)
+
+
+ADAPTIVE = AdaptiveLog()
+
+
 # -- query history (on-disk JSONL) -------------------------------------------
 
 def _history_max_bytes() -> int:

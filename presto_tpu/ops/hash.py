@@ -58,17 +58,38 @@ def grow_overflowed(capacities: dict, ok_keys, oks,
     ``growth`` and count hash-TABLE overflows (kinds table/final) in
     ``presto_tpu_hash_probe_overflow_total`` — output/compaction
     capacity kinds are sizing misses, not hash-chain give-ups, and
-    stay out of the metric. Returns the counted overflow total."""
+    stay out of the metric. EVERY failed key additionally counts one
+    ``presto_tpu_capacity_overflow_retries_total{operator=<kind>}``:
+    each rung is a full recompile on the hot path, so the "overflow
+    retries go to ~zero" claim of adaptive capacity re-bucketing
+    (parallel/adaptive.py) is measurable from /metrics rather than
+    inferred from logs. The kind label names the operator role the
+    capacity sizes (table/final = hash build or aggregation table,
+    out/pout = expanding-join output, probe_exch/build_exch/agg_exch =
+    exchange buckets, hot/htab = hybrid-join hot set, ...).
+    Returns the counted hash-table overflow total."""
     import numpy as np
     overflowed = 0
     for key, okv in zip(ok_keys, oks):
         if not bool(np.asarray(okv)):
             if key[1] in ("table", "final"):
                 overflowed += 1
+            note_capacity_retry(str(key[1]))
             capacities[key] = growth * used_capacity[key]
     if overflowed:
         note_probe_overflow(overflowed)
     return overflowed
+
+
+def note_capacity_retry(kind: str) -> None:
+    """Count one capacity-overflow retry rung (a recompile) for the
+    capacity kind that overflowed."""
+    from presto_tpu.obs.metrics import REGISTRY
+    REGISTRY.counter(
+        "presto_tpu_capacity_overflow_retries_total",
+        "capacity-overflow retry-ladder rungs (each one is a "
+        "recompile), by the operator-role capacity kind that "
+        "overflowed").inc(operator=kind)
 
 
 def note_probe_overflow(count: int = 1) -> None:

@@ -176,10 +176,16 @@ class RemoteWorker:
         except Exception:  # noqa: BLE001 - best-effort observability
             return []
 
-    def delete_task(self, prefix: str, timeout: float = 10.0) -> None:
-        req = urllib.request.Request(
-            f"{self.uri}/v1/task/{prefix}", method="DELETE",
-            headers=self._auth_headers())
+    def delete_task(self, prefix: str, timeout: float = 10.0,
+                    exact: bool = False) -> None:
+        """Prefix DELETE of the worker's tasks; ``exact`` deletes one
+        task id verbatim (speculation loser-cancel: a losing primary
+        id is a prefix of its winning duplicate's id)."""
+        url = f"{self.uri}/v1/task/{prefix}"
+        if exact:
+            url += "?exact=1"
+        req = urllib.request.Request(url, method="DELETE",
+                                     headers=self._auth_headers())
         try:
             with _urlopen(req, timeout=timeout):
                 pass
@@ -274,6 +280,10 @@ class ClusterCoordinator:
             self.workers, heartbeat_interval_s,
             ping_timeout=self._ping_timeout)
         self.last_distribution: dict | None = None
+        # EXPLAIN-ANALYZE-style rendering of the last adaptively
+        # re-planned query's plan, with [replanned: old->new] markers
+        # (parallel/adaptive.py AdaptiveController.annotated_plan)
+        self.last_adaptive_explain: str | None = None
         # live cluster view for the engine's system.nodes table
         # (connectors/information_schema.py reads worker uri/state/
         # active-task counts off this handle)
@@ -592,10 +602,14 @@ class ClusterCoordinator:
             pass
 
     def _finish_with_partials(self, plan, agg, boundary,
-                              buffers: list[bytes], meta: dict):
+                              buffers: list[bytes], meta: dict,
+                              adapt=None):
         """Coordinator completion: concatenate worker partial-aggregate
         buffers, splice a FINAL aggregate over a carrier scan into the
-        original plan, and run the remainder locally."""
+        original plan, and run the remainder locally. ``adapt`` (the
+        query's AdaptiveController) re-buckets the FINAL aggregate's
+        capacity hint from the observed partial-state row count before
+        the final program compiles."""
         import dataclasses as DC
 
         from presto_tpu.exec.executor import ScanInput, run_plan
@@ -606,6 +620,8 @@ class ClusterCoordinator:
         # single preallocated assembly (arrow buffers decode to
         # zero-copy views; one fill per column — no concat cascade)
         cols, total = pages_to_columns(buffers)
+        if adapt is not None and agg is not None:
+            agg = adapt.revised_final_agg(agg, total)
         # coordinator-stage input accounting: the stats tree's final
         # conservation link (last worker stage's output rows == the
         # coordinator's gathered partial rows)
@@ -841,6 +857,11 @@ class ClusterCoordinator:
         placed: dict[str, dict[int, tuple[RemoteWorker, str]]] = {}
         attempts: dict[tuple[str, int], int] = {}
         retries = [0]
+        # set once the walk has its inline results: speculation losers
+        # still in flight must then stop retrying and — above all —
+        # stop REPAIRING exchanges (a post-cleanup repair would re-run
+        # a producer task and leak its buffers past the qid sweep)
+        walk_done = [False]
 
         def live_pool() -> list[RemoteWorker]:
             pool = [w for w in workers if w.schedulable]
@@ -857,6 +878,17 @@ class ClusterCoordinator:
                 if mode == "part":
                     refs = [{"uri": pl[s][0].uri, "task_id": pl[s][1],
                              "part": shard} for s in sorted(pl)]
+                elif mode == "own":
+                    # split-semantics read of a materialized per-worker
+                    # store (adaptive re-planning): consumer i alone
+                    # reads producer i's buffers, so the union over
+                    # consumers is the relation exactly once — an
+                    # "all" read here would hand EVERY consumer the
+                    # full store and duplicate rows downstream
+                    np_ = nparts_of[producer]
+                    refs = [{"uri": pl[shard][0].uri,
+                             "task_id": pl[shard][1], "part": p}
+                            for p in range(np_)]
                 else:  # "all": broadcast read of every buffer
                     np_ = nparts_of[producer]
                     refs = [{"uri": pl[s][0].uri, "task_id": pl[s][1],
@@ -889,6 +921,8 @@ class ClusterCoordinator:
             True when the exchange was repaired (re-point or re-run)
             and the consumer should retry; False when the failure is
             not an exchange failure (a real application error)."""
+            if walk_done[0]:
+                return False  # finished query: nothing left to repair
             hit = FTR.parse_exchange_failure(message)
             if hit is None:
                 return False
@@ -924,87 +958,217 @@ class ClusterCoordinator:
             dispatch(st, pshard, last=False)
             return True
 
-        def dispatch(st, shard: int, last: bool):
-            while True:
-                # reaped/canceled queries stop re-dispatching; the
-                # QueryCanceled propagates (it is not a node failure)
-                if tok is not None:
-                    tok.check()
-                with state_lock:
-                    n = attempts.get((st.name, shard), 0)
-                    attempts[(st.name, shard)] = n + 1
-                tid = f"{qid}.{st.name}.{shard}" + (
-                    f"a{n}" if n else "")
-                pool = live_pool()
-                w = pool[(shard + n) % len(pool)]
-                payload = build_payload(st, shard, tid, last)
-                err: Exception
-                try:
-                    with OT.TRACER.attach(ctx):
-                        out = w.post_task_any(payload,
-                                              timeout=task_timeout)
-                    w.record(False)
+        def dispatch(st, shard: int, last: bool, arbiter=None,
+                     speculative: bool = False):
+            """Run one stage task to success (with the task-retry
+            ladder). With an ``arbiter`` (speculative execution) the
+            attempt races siblings: the first finisher publishes its
+            placement; a loser cleans its own output up (exact-id
+            DELETE) and returns None, and terminal failures are
+            reported to the arbiter instead of raised (another attempt
+            for the shard may still win)."""
+            try:
+                while True:
+                    # reaped/canceled queries stop re-dispatching; the
+                    # QueryCanceled propagates (not a node failure)
+                    if tok is not None:
+                        tok.check()
+                    if walk_done[0] or (arbiter is not None
+                                        and arbiter.has_winner(shard)):
+                        return None
                     with state_lock:
-                        placed[st.name][shard] = (w, tid)
-                    return out
-                except TaskError as te:
-                    if not repair_exchange(str(te)):
-                        raise  # deterministic application error
-                    err = te
-                    reason = "exchange-repair"
-                except FTR.DeadlineExceeded:
-                    raise
-                except Exception as e:  # noqa: BLE001 - node failure
-                    w.record(True)
-                    w.record(True)  # fast-fail: push over threshold
-                    err = e
-                    reason = f"node-failure:{type(e).__name__}"
-                if n + 1 >= task_backoff.attempts:
-                    raise NoWorkersError(
-                        f"task {st.name}.{shard} failed after "
-                        f"{n + 1} attempts: {err}")
-                deadline.check(f"task {st.name}.{shard}")
-                _TASK_RETRIES.inc()
-                if qr is not None:
-                    qr.note_task_retry()
-                with state_lock:
-                    retries[0] += 1
-                delay = task_backoff.delay_s(n)
-                with OT.TRACER.attach(ctx), OT.TRACER.span(
-                        "task-retry", task_id=tid, attempt=n,
-                        reason=reason, delay_s=round(delay, 4),
-                        error=f"{type(err).__name__}: "
-                              f"{str(err)[:200]}"):
-                    time.sleep(delay)
+                        n = attempts.get((st.name, shard), 0)
+                        attempts[(st.name, shard)] = n + 1
+                    tid = f"{qid}.{st.name}.{shard}" + (
+                        f"a{n}" if n else "")
+                    pool = live_pool()
+                    w = pool[(shard + n) % len(pool)]
+                    payload = build_payload(st, shard, tid, last)
+                    err: Exception
+                    try:
+                        with OT.TRACER.attach(ctx):
+                            out = w.post_task_any(payload,
+                                                  timeout=task_timeout)
+                        w.record(False)
+                        if arbiter is not None:
+                            def publish(w=w, tid=tid):
+                                with state_lock:
+                                    placed[st.name][shard] = (w, tid)
 
-        sources_of = {
-            st.name: {t: {"stage": p, "mode": m}
-                      for t, (p, m) in st.sources.items()}
-            for st in g.stages}
+                            # placement publishes INSIDE the claim's
+                            # critical section: all_won() must never
+                            # release the walk before every winner's
+                            # producer entry is in `placed`
+                            if not arbiter.claim_win(shard, tid, out,
+                                                     speculative,
+                                                     on_win=publish):
+                                # second finisher: drop the
+                                # duplicate's buffers/spool (exact id
+                                # — a losing primary's id prefixes
+                                # the winner's)
+                                w.delete_task(tid, exact=True)
+                                return None
+                            return out
+                        with state_lock:
+                            placed[st.name][shard] = (w, tid)
+                        return out
+                    except TaskError as te:
+                        if arbiter is not None \
+                                and (walk_done[0]
+                                     or arbiter.has_winner(shard)):
+                            # a lost speculation race, not a failure:
+                            # no repair, no retry (a repair here would
+                            # re-run a producer AFTER query cleanup)
+                            return None
+                        if not repair_exchange(str(te)):
+                            raise  # deterministic application error
+                        err = te
+                        reason = "exchange-repair"
+                    except FTR.DeadlineExceeded:
+                        raise
+                    except Exception as e:  # noqa: BLE001 - node failure
+                        w.record(True)
+                        w.record(True)  # fast-fail: over threshold
+                        err = e
+                        reason = f"node-failure:{type(e).__name__}"
+                    if n + 1 >= task_backoff.attempts:
+                        raise NoWorkersError(
+                            f"task {st.name}.{shard} failed after "
+                            f"{n + 1} attempts: {err}")
+                    deadline.check(f"task {st.name}.{shard}")
+                    _TASK_RETRIES.inc()
+                    if qr is not None:
+                        qr.note_task_retry()
+                    with state_lock:
+                        retries[0] += 1
+                    delay = task_backoff.delay_s(n)
+                    with OT.TRACER.attach(ctx), OT.TRACER.span(
+                            "task-retry", task_id=tid, attempt=n,
+                            reason=reason, delay_s=round(delay, 4),
+                            error=f"{type(err).__name__}: "
+                                  f"{str(err)[:200]}"):
+                        time.sleep(delay)
+            except BaseException as exc:
+                if arbiter is None:
+                    raise
+                # speculative mode: a failed attempt only fails the
+                # stage once NO attempt for the shard remains
+                arbiter.record_failure(shard, exc)
+                return None
+
+        def run_stage(st, last: bool) -> list:
+            """Dispatch one stage's W tasks. Without speculation this
+            is the plain synchronous fan-out; with it, a straggler
+            task past the policy threshold gets a duplicate attempt on
+            another worker and the first finisher wins (the stage does
+            NOT wait for losers)."""
+            if not spec_policy.enabled or W < 2:
+                with ThreadPoolExecutor(max_workers=W) as pool:
+                    return list(pool.map(
+                        lambda i: dispatch(st, i, last), range(W)))
+            arb = SPEC.StageArbiter(W, spec_policy)
+            # 2W slots: every shard may run a primary and a duplicate
+            pool = ThreadPoolExecutor(
+                max_workers=2 * W,
+                thread_name_prefix="presto-tpu-speculate")
+            try:
+                for i in range(W):
+                    pool.submit(dispatch, st, i, last, arb, False)
+                while not arb.all_won():
+                    dead = arb.failed_shard()
+                    if dead is not None:
+                        raise dead[1]
+                    for shard in arb.stragglers():
+                        arb.note_speculation(shard)
+                        with OT.TRACER.attach(ctx):
+                            OT.TRACER.instant_for(
+                                qid, "speculative-dispatch",
+                                create=True, stage=st.name,
+                                shard=shard)
+                        pool.submit(dispatch, st, shard, last, arb,
+                                    True)
+                    arb.wait_turn(0.05)
+            finally:
+                # losers may still be in flight: do not join them —
+                # they clean up after themselves (arbiter loss path)
+                # and the query-end prefix DELETE sweeps any residue
+                pool.shutdown(wait=False)
+            for shard in arb.speculation_summary()["speculated"]:
+                QS.ADAPTIVE.note(
+                    qid, st.name, "speculation",
+                    detail=(f"shard {shard} winner "
+                            f"{arb.winner_task_id(shard)}"),
+                    old_strategy="primary",
+                    new_strategy=("speculative"
+                                  if arb.winner_was_speculative(shard)
+                                  else "primary"))
+            return arb.results()
+
+        from presto_tpu.ft import speculate as SPEC
+        spec_policy = SPEC.SpeculationPolicy.from_session(session)
+        adapt = None
+        if bool(session.get("adaptive_replanning")):
+            from presto_tpu.parallel.adaptive import AdaptiveController
+            try:
+                adapt = AdaptiveController(self.engine, plan, g, qid, W)
+            except Exception:  # noqa: BLE001 - adaptivity is optional
+                adapt = None
+
+        stages = list(g.stages)
+        last_name = g.last_stage
+        sources_of: dict[str, dict] = {}
         try:
             inline: list | None = None
-            for st in g.stages:
+            idx = 0
+            while idx < len(stages):
+                st = stages[idx]
                 CANCEL.checkpoint()
+                stage_by_name[st.name] = st
+                sources_of[st.name] = {
+                    t: {"stage": p, "mode": m}
+                    for t, (p, m) in st.sources.items()}
                 frag_of[st.name] = fragment_to_dict(st.fragment)
                 nparts_of[st.name] = (W if st.partition_keys is not None
                                       else 1)
                 with state_lock:
                     placed.setdefault(st.name, {})
-                last = st.name == g.last_stage
-                with ThreadPoolExecutor(max_workers=W) as pool:
-                    outs = list(pool.map(
-                        lambda i: dispatch(st, i, last), range(W)))
+                last = st.name == last_name
+                outs = run_stage(st, last)
                 if last:
                     inline = outs
+                elif adapt is not None and idx + 1 < len(stages):
+                    # the within-query feedback loop: materially
+                    # divergent stage actuals re-optimize and re-stage
+                    # the not-yet-dispatched remainder
+                    revised = adapt.observe(st, outs,
+                                            stages[idx + 1:])
+                    if revised is not None:
+                        stages = stages[:idx + 1] + list(revised.stages)
+                        last_name = revised.last_stage
+                        for st2 in revised.stages:
+                            for _t, (prod, m) in st2.sources.items():
+                                readers_of[prod] = max(
+                                    readers_of.get(prod, 1),
+                                    W if m == "all" else 1)
+                idx += 1
+            walk_done[0] = True
             assert inline is not None
             with state_lock:
                 task_retries = retries[0]
+            meta: dict = {"nshards": W, "mode": "fragments",
+                          "stages": len(stages),
+                          "retry_policy": "TASK",
+                          "task_retries": task_retries}
+            if adapt is not None and adapt.replans:
+                meta["replans"] = adapt.replans
+                meta["adaptive"] = adapt.summary()["decisions"]
+                self.last_adaptive_explain = adapt.annotated_plan()
             return self._finish_with_partials(
-                plan, g.agg, g.boundary, inline,
-                {"nshards": W, "mode": "fragments",
-                 "stages": len(g.stages), "retry_policy": "TASK",
-                 "task_retries": task_retries})
+                plan, g.agg, g.boundary, inline, meta, adapt=adapt)
         finally:
+            # failed/canceled walks too: in-flight speculation losers
+            # must not repair exchanges once cleanup starts
+            walk_done[0] = True
             self._collect_stage_stats(workers, qid, sources_of)
             for w in workers:
                 try:

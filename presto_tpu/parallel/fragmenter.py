@@ -83,9 +83,33 @@ class GStage:
     fragment: N.PlanNode
     # exchange table name used inside ``fragment`` -> (producer stage
     # name, read mode): "part" pulls this worker's partition from every
-    # producer, "all" pulls every buffer of every producer (broadcast)
+    # producer, "all" pulls every buffer of every producer (broadcast),
+    # "own" pulls ONLY this worker's producer's buffers (a split-
+    # distribution read of an already-materialized per-worker store —
+    # used by adaptive re-planning's pass-through/repartition stages)
     sources: dict[str, tuple[str, str]]
     partition_keys: list[str] | None
+    # the node of the PLAN THAT WAS FRAGMENTED whose output this stage
+    # materializes (side/probe/build/rows stages; None for the final
+    # stage) — the linkage mid-query adaptive re-planning
+    # (parallel/adaptive.py) uses to swap completed subtrees for
+    # exchange carrier scans in the remainder
+    subtree: N.PlanNode | None = None
+
+
+@dataclasses.dataclass(frozen=True)
+class ExchangeSource:
+    """An already-materialized stage a REMAINDER plan may read as a
+    leaf (adaptive re-planning): carrier ``TableScan``s with catalog
+    ``__exchange__`` and table == the completed stage's name resolve
+    here. ``partition_keys`` records how the stage was PRODUCED (hash
+    partition keys, or None for a per-worker store) — production
+    layout dictates the legal read modes. (Observed row counts flow
+    separately, through cost/adapt.CarrierStats into the re-costing
+    overlay.)"""
+
+    stage: str
+    partition_keys: tuple[str, ...] | None
 
 
 @dataclasses.dataclass
@@ -101,7 +125,8 @@ class GeneralFragmentedPlan:
     def consumer_readers(self, nworkers: int) -> dict[str, int]:
         """Producer stage -> how many downstream tasks independently
         read EACH partition of its buffer: 1 in "part" mode (consumer
-        i owns partition i), ``nworkers`` in "all" (broadcast) mode —
+        i owns partition i) and in "own" mode (consumer i alone reads
+        producer i's store), ``nworkers`` in "all" (broadcast) mode —
         a page frees only when every reader acked past it. Shared by
         the streaming (_execute_general) and task-retry
         (_execute_general_ft) dispatchers, which must agree or a
@@ -122,7 +147,10 @@ class GeneralFragmentedPlan:
 
 
 def fragment_plan_general(plan: N.PlanNode, mode: str = "automatic",
-                          broadcast_threshold: int | None = None
+                          broadcast_threshold: int | None = None,
+                          exchange_sources: dict[str, ExchangeSource]
+                          | None = None,
+                          name_prefix: str = ""
                           ) -> GeneralFragmentedPlan | None:
     """Recursively stage an arbitrary join/semijoin/aggregate plan for
     multi-host execution (reference PlanFragmenter.createSubPlans +
@@ -132,15 +160,28 @@ def fragment_plan_general(plan: N.PlanNode, mode: str = "automatic",
     filter / scalar side becomes its own stage, broadcast when small,
     co-partitioned when large (the session's
     broadcast_join_threshold_rows when the coordinator passes it).
-    Returns None when the plan shape cannot distribute."""
+
+    ``exchange_sources`` (adaptive re-planning) maps carrier-scan
+    table names embedded in a REMAINDER plan to the completed stages
+    that already materialized them: partitioned carriers are consumed
+    per-partition (and reused verbatim as cut sides when the keys
+    match), per-worker stores are referenced broadcast when bare or
+    read "own" (split semantics) under transforms. ``name_prefix``
+    keeps replan-minted stage names collision-free against the
+    original graph's. Returns None when the plan shape cannot
+    distribute."""
     try:
-        return _fragment_general(plan, mode, broadcast_threshold)
+        return _fragment_general(plan, mode, broadcast_threshold,
+                                 exchange_sources, name_prefix)
     except NotDistributable:
         return None
 
 
 def _fragment_general(plan: N.PlanNode, mode: str = "automatic",
-                      broadcast_threshold: int | None = None
+                      broadcast_threshold: int | None = None,
+                      exchange_sources: dict[str, ExchangeSource]
+                      | None = None,
+                      name_prefix: str = ""
                       ) -> GeneralFragmentedPlan:
     # walk the coordinator-side root chain down to the top Aggregate /
     # window chain
@@ -232,14 +273,23 @@ def _fragment_general(plan: N.PlanNode, mode: str = "automatic",
 
     stages: list[GStage] = []
     counter = [0]
+    carriers = exchange_sources or {}
 
     def fresh(prefix: str) -> str:
         counter[0] += 1
-        return f"{prefix}{counter[0]}"
+        return f"{name_prefix}{prefix}{counter[0]}"
 
     def exchange_scan(name: str, types: dict) -> N.TableScan:
         return N.TableScan("__exchange__", name,
                            {s: s for s in types}, dict(types))
+
+    def bare_carrier(node: N.PlanNode) -> ExchangeSource | None:
+        """The completed stage a node references directly, when the
+        node IS a carrier scan (no transforms above it)."""
+        if isinstance(node, N.TableScan) \
+                and node.catalog == "__exchange__":
+            return carriers.get(node.table)
+        return None
 
     def lower_side(side: N.PlanNode) -> tuple[str, dict]:
         """Materialize a build/filter/scalar side as its own stage
@@ -247,11 +297,16 @@ def _fragment_general(plan: N.PlanNode, mode: str = "automatic",
         side may itself contain joins (its nested build sides become
         further broadcast stages): each worker contributes the rows
         its base-table split produces, and the union of worker buffers
-        is the full side relation."""
+        is the full side relation. A side that IS a completed
+        per-worker store carrier references that stage directly — no
+        pass-through copy."""
+        src = bare_carrier(side)
+        if src is not None and src.partition_keys is None:
+            return src.stage, side.output_types()
         srcs: dict[str, tuple[str, str]] = {}
         frag, _dist = lower(side, srcs, allow_cut=False)
         name = fresh("side")
-        stages.append(GStage(name, frag, srcs, None))
+        stages.append(GStage(name, frag, srcs, None, subtree=side))
         return name, frag.output_types()
 
     def lower(node: N.PlanNode, sources: dict, allow_cut: bool):
@@ -260,7 +315,19 @@ def _fragment_general(plan: N.PlanNode, mode: str = "automatic",
         "split" or ("part", keys). Appends stages depth-first."""
         if isinstance(node, N.TableScan):
             if node.catalog == "__exchange__":
-                raise NotDistributable()
+                src = carriers.get(node.table)
+                if src is None:
+                    raise NotDistributable()
+                if src.partition_keys is not None:
+                    # produced hash-partitioned: each worker owns its
+                    # partition — the carrier reads co-located
+                    sources[node.table] = (src.stage, "part")
+                    return node, ("part", list(src.partition_keys))
+                # per-worker store: each worker reads its OWN
+                # producer's buffers, which is exactly a split
+                # distribution (union over workers = full relation)
+                sources[node.table] = (src.stage, "own")
+                return node, "split"
             return node, "split"
         if isinstance(node, (N.Filter, N.Project)):
             src, dist = lower(node.sources()[0], sources, allow_cut)
@@ -332,16 +399,31 @@ def _fragment_general(plan: N.PlanNode, mode: str = "automatic",
                 return dataclasses.replace(node, left=left,
                                            right=scan), dist
             # big build: FIXED_HASH — cut both sides into
-            # key-partitioned stages, join co-partitions locally
+            # key-partitioned stages, join co-partitions locally. A
+            # side that IS a carrier already partitioned on exactly
+            # the join keys reuses the completed stage's buffers
+            # verbatim (no pass-through repartition copy).
             lkeys = [lk for lk, _ in node.criteria]
             rkeys = [rk for _, rk in node.criteria]
-            pname = fresh("probe")
-            stages.append(GStage(pname, left, dict(sources), lkeys))
+            pcar = bare_carrier(left)
+            if pcar is not None \
+                    and pcar.partition_keys == tuple(lkeys):
+                pname = pcar.stage
+            else:
+                pname = fresh("probe")
+                stages.append(GStage(pname, left, dict(sources),
+                                     lkeys, subtree=node.left))
             sources.clear()
             bsrcs: dict[str, tuple[str, str]] = {}
             bfrag, _bd = lower(node.right, bsrcs, allow_cut=False)
-            bname = fresh("build")
-            stages.append(GStage(bname, bfrag, bsrcs, rkeys))
+            bcar = bare_carrier(bfrag)
+            if bcar is not None \
+                    and bcar.partition_keys == tuple(rkeys):
+                bname = bcar.stage
+            else:
+                bname = fresh("build")
+                stages.append(GStage(bname, bfrag, bsrcs, rkeys,
+                                     subtree=node.right))
             pscan = exchange_scan(fresh("x"), left.output_types())
             bscan = exchange_scan(fresh("x"), bfrag.output_types())
             sources[pscan.table] = (pname, "part")
@@ -370,7 +452,8 @@ def _fragment_general(plan: N.PlanNode, mode: str = "automatic",
             # partition raw rows
             raise NotDistributable()
         pname = fresh("rows")
-        stages.append(GStage(pname, spine, final_sources, part_keys))
+        stages.append(GStage(pname, spine, final_sources, part_keys,
+                             subtree=spine_root))
         xscan = N.TableScan("__exchange__", fresh("x"),
                             {sym: sym for sym in
                              spine.output_types()},

@@ -629,28 +629,43 @@ class WorkerServer(HttpService):
             def do_DELETE(self):  # noqa: N802
                 if not self._authorized():
                     return
-                parts = self.path.strip("/").split("/")
+                path, _sep, query = self.path.partition("?")
+                parts = path.strip("/").split("/")
                 if len(parts) == 3 and parts[:2] == ["v1", "task"]:
                     # task-id prefix delete: one query's stages share
                     # a query-id prefix (ack/cleanup, the reference's
-                    # explicit DELETE on drained buffers)
+                    # explicit DELETE on drained buffers). ?exact=1
+                    # deletes ONE task id verbatim — the speculation
+                    # loser-cancel path, where a losing primary
+                    # "...0" must not prefix-wipe its winning
+                    # attempt-versioned duplicate "...0a1"
+                    from urllib.parse import parse_qs
                     prefix = parts[2]
+                    exact = parse_qs(query).get("exact") == ["1"]
+
+                    def hit(tid: str) -> bool:
+                        return (tid == prefix if exact
+                                else tid.startswith(prefix))
+
                     for tid in list(outer.buffers):
-                        if tid.startswith(prefix):
+                        if hit(tid):
                             buf = outer.buffers.pop(tid, None)
                             if buf is not None and not buf.complete:
                                 # unblock a producer still waiting on
                                 # a consumer that will never come
                                 buf.fail("task deleted")
                     for tid in list(outer.task_state):
-                        if tid.startswith(prefix):
+                        if hit(tid):
                             outer.task_state.pop(tid, None)
                     with outer._lock:
                         for tid in list(outer.task_stats):
-                            if tid.startswith(prefix):
+                            if hit(tid):
                                 outer.task_stats.pop(tid, None)
                     if outer.spool is not None:
-                        outer.spool.delete_prefix(prefix)
+                        if exact:
+                            outer.spool.delete_exact(prefix)
+                        else:
+                            outer.spool.delete_prefix(prefix)
                     self._send_json({})
                     return
                 self._send_json({"error": "not found"}, 404)

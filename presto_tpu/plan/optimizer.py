@@ -167,6 +167,64 @@ def unfuse_multijoin(plan: N.PlanNode) -> N.PlanNode:
     return N.rewrite_bottom_up(plan, visit)
 
 
+def substitute_materialized(plan: N.PlanNode,
+                            replacements: dict[int, N.PlanNode]
+                            ) -> N.PlanNode:
+    """Remainder construction for mid-query re-planning
+    (parallel/adaptive.py): rebuild ``plan`` with each node in
+    ``replacements`` (keyed by ``id(node)``) swapped for its
+    replacement — an ``__exchange__`` carrier scan standing in for an
+    already-materialized stage output. Top-down and identity-keyed:
+    the OUTERMOST completed subtree wins, so a stage nested inside
+    another completed stage's subtree never double-substitutes."""
+
+    def visit(node: N.PlanNode) -> N.PlanNode:
+        hit = replacements.get(id(node))
+        if hit is not None:
+            return hit
+        updates = {}
+        for f in dataclasses.fields(node):
+            v = getattr(node, f.name)
+            if isinstance(v, N.PlanNode):
+                nv = visit(v)
+                if nv is not v:
+                    updates[f.name] = nv
+            elif isinstance(v, list) and v \
+                    and isinstance(v[0], N.PlanNode):
+                nv = [visit(x) for x in v]
+                if any(a is not b for a, b in zip(nv, v)):
+                    updates[f.name] = nv
+        return dataclasses.replace(node, **updates) if updates else node
+
+    return visit(plan)
+
+
+def adapt_remainder(plan: N.PlanNode,
+                    replacements: dict[int, N.PlanNode],
+                    engine) -> N.PlanNode:
+    """Sub-plan re-optimization for the within-query feedback loop:
+    substitute already-materialized stage outputs as carrier-scan
+    leaves, then give the multi-way fusion decision a second chance —
+    every MultiJoin in the remainder expands back into its binary
+    cascade (so the re-annotation pass, cost/adapt.reannotate, can
+    re-decide each leg's distribution from ACTUALS) and
+    :func:`collapse_multiway` re-fuses exactly the chains that still
+    qualify. A spine estimate that was wrong therefore de-fuses (one
+    leg now rides the partitioned cut) or re-fuses (all legs turned
+    out broadcast-sized) mid-flight, with annotations carrying over
+    per leg either way."""
+    plan = substitute_materialized(plan, replacements)
+    return unfuse_multijoin(plan)
+
+
+def refuse_multiway(plan: N.PlanNode, engine) -> N.PlanNode:
+    """The re-fusion half of :func:`adapt_remainder`, applied AFTER
+    the remainder's annotations have been re-derived from actuals
+    (cost/adapt.reannotate) so the fused legs carry corrected
+    build_rows/distributions."""
+    return collapse_multiway(plan, engine)
+
+
 def _expr_refs(*exprs) -> set[str]:
     out: set[str] = set()
     for e in exprs:

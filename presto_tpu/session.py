@@ -258,6 +258,41 @@ SYSTEM_SESSION_PROPERTIES: dict[str, tuple[Any, type, str]] = {
     "heartbeat_timeout_s": (2.0, float,
                             "HTTP deadline for failure-detector "
                             "pings (was hard-coded 2)"),
+    # -- adaptive execution (parallel/adaptive.py, ft/speculate.py) ----
+    # Host-side control-plane properties: none of them are read at
+    # trace time, so they deliberately stay OUT of the program-cache
+    # key (exec/progcache.TRACE_RELEVANT_PROPERTIES) — flipping them
+    # must not re-key compiled programs.
+    "adaptive_replanning": (True, bool,
+                            "mid-query adaptive re-planning in the "
+                            "retry_policy=TASK stage walk: after each "
+                            "stage completes, materially divergent "
+                            "(>=4x) actual row counts re-optimize the "
+                            "not-yet-dispatched remainder — "
+                            "broadcast<->partitioned flips, capacity "
+                            "re-bucketing, MultiJoin de/re-fusion — "
+                            "with decisions audited in "
+                            "system.adaptive_decisions"),
+    "speculative_execution": (False, bool,
+                              "dispatch a duplicate attempt of a "
+                              "straggling TASK-mode stage task on "
+                              "another schedulable worker and take "
+                              "the first finisher (the loser's task "
+                              "is DELETEd); ft/speculate.py"),
+    "speculation_quantile": (0.75, float,
+                             "fraction of a stage's sibling tasks "
+                             "that must have completed before a "
+                             "still-running task can be judged a "
+                             "straggler (also the completion-time "
+                             "quantile the threshold is taken at)"),
+    "speculation_threshold": (2.0, float,
+                              "straggler runtime threshold as a "
+                              "multiple of the sibling quantile "
+                              "completion time"),
+    "speculation_min_runtime_s": (0.5, float,
+                                  "floor on the straggler threshold: "
+                                  "tasks never speculate before "
+                                  "running at least this long"),
 }
 
 

@@ -250,18 +250,17 @@ def test_worker_results_endpoint_transcodes_for_npz_only_consumer():
 # -- 2-worker TPC-H Q5 cluster oracle: arrow vs npz --------------------------
 
 
-def test_q5_cluster_byte_identical_across_codecs():
+def test_q5_cluster_byte_identical_across_codecs(tpch_tiny):
     """TPC-H Q5 (dictionary varchar nation names, decimal revenue,
     partitioned multi-stage exchange) over a 2-worker HTTP cluster
     answers byte-identically whether the exchange runs arrow or npz,
     and both match the local engine."""
     from presto_tpu import Engine
-    from presto_tpu.connectors import TpchConnector
     from presto_tpu.parallel.coordinator import ClusterCoordinator
     from presto_tpu.parallel.worker import WorkerServer
     from tests.tpch_queries import QUERIES
 
-    cats = {"tpch": TpchConnector(scale=0.01)}
+    cats = {"tpch": tpch_tiny}
     workers = [WorkerServer(cats).start() for _ in range(2)]
     arrow_bytes = REGISTRY.counter("presto_tpu_exchange_bytes_total")
     try:
@@ -304,13 +303,12 @@ def test_q5_cluster_byte_identical_across_codecs():
 
 
 @pytest.fixture(scope="module")
-def stream_server(request):
+def stream_server(request, tpch_tiny):
     from presto_tpu import Engine
-    from presto_tpu.connectors.tpch import TpchConnector
     from presto_tpu.server import CoordinatorServer
 
     engine = Engine()
-    engine.register_catalog("tpch", TpchConnector(scale=0.01))
+    engine.register_catalog("tpch", tpch_tiny)
     srv = CoordinatorServer(engine).start()
     request.addfinalizer(srv.stop)
     return srv

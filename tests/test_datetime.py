@@ -132,14 +132,13 @@ def test_date_format(engine):
     assert v == "Jul 2020"
 
 
-def test_timestamp_through_server_and_dbapi():
+def test_timestamp_through_server_and_dbapi(tpch_tiny):
     from presto_tpu import Engine
-    from presto_tpu.connectors.tpch import TpchConnector
     from presto_tpu.dbapi import connect
     from presto_tpu.server import CoordinatorServer
 
     e = Engine()
-    e.register_catalog("tpch", TpchConnector(scale=0.01))
+    e.register_catalog("tpch", tpch_tiny)
     srv = CoordinatorServer(e).start()
     try:
         conn = connect("127.0.0.1", srv.port)

@@ -81,7 +81,7 @@ def test_failed_buffer_raises_for_consumer_and_unblocks_producer():
         buf.page(0, 0)
 
 
-def test_stage_output_streams_through_small_buffer():
+def test_stage_output_streams_through_small_buffer(tpch_tiny):
     """A cluster query whose intermediate stage output is far larger
     than the producer buffer cap still answers correctly: pages stream
     through the bounded buffer while the consumer drains (end-to-end
@@ -94,7 +94,7 @@ def test_stage_output_streams_through_small_buffer():
 
     saved = wk.PAGE_BYTES, wk.BUFFER_BYTES
     wk.PAGE_BYTES, wk.BUFFER_BYTES = 4 << 10, 16 << 10  # 4KB/16KB
-    cats = {"tpch": TpchConnector(scale=0.01)}
+    cats = {"tpch": tpch_tiny}
     workers = [WorkerServer(cats).start() for _ in range(2)]
     try:
         local = Engine()

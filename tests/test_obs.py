@@ -249,13 +249,12 @@ def test_metric_name_lint_clean_code_passes(tmp_path):
 # -- coordinator endpoints --------------------------------------------------
 
 @pytest.fixture(scope="module")
-def obs_server(request):
+def obs_server(request, tpch_tiny):
     from presto_tpu import Engine
-    from presto_tpu.connectors.tpch import TpchConnector
     from presto_tpu.server import CoordinatorServer
 
     engine = Engine()
-    engine.register_catalog("tpch", TpchConnector(scale=0.01))
+    engine.register_catalog("tpch", tpch_tiny)
     srv = CoordinatorServer(engine).start()
     request.addfinalizer(srv.stop)
     return srv

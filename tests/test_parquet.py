@@ -128,13 +128,11 @@ def test_sql_over_parquet(pq_dir):
         assert n_ == mn[int(g)]
 
 
-def test_tpch_query_from_parquet_files(tmp_path):
+def test_tpch_query_from_parquet_files(tmp_path, tpch_tiny):
     """A TPC-H query runs from Parquet files end to end: the synthetic
     connector's tables round-trip through pyarrow-written parquet and
     Q6 matches the in-memory answer."""
-    from presto_tpu.connectors import TpchConnector
-
-    tpch = TpchConnector(scale=0.01)
+    tpch = tpch_tiny
     li = tpch.table("lineitem")
     arrays = {}
     for cname in ("l_quantity", "l_extendedprice", "l_discount",

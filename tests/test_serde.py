@@ -24,9 +24,9 @@ QUERIES = [
 
 
 @pytest.fixture(scope="module")
-def engine():
+def engine(tpch_tiny):
     e = Engine()
-    e.register_catalog("tpch", TpchConnector(scale=0.01))
+    e.register_catalog("tpch", tpch_tiny)
     return e
 
 

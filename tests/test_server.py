@@ -11,10 +11,9 @@ from presto_tpu.server import CoordinatorServer
 
 
 @pytest.fixture(scope="module")
-def server(request):
-    from presto_tpu.connectors.tpch import TpchConnector
+def server(request, tpch_tiny):
     engine = Engine()
-    engine.register_catalog("tpch", TpchConnector(scale=0.01))
+    engine.register_catalog("tpch", tpch_tiny)
     srv = CoordinatorServer(engine).start()
     request.addfinalizer(srv.stop)
     return srv

@@ -220,6 +220,8 @@ def test_salted_unique_join(tpch_zipf, mesh):
     assert got == want
 
 
+@pytest.mark.slow  # ~40 s shard_map compile on the tier-1 container;
+# the salted-unique test keeps the salt-correctness path in tier 1
 def test_salted_expanding_join(mesh):
     """Salting an EXPANDING join: the salt criterion keeps the tiled
     build copies from double-matching (every (probe, build) pair must

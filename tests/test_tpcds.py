@@ -5197,7 +5197,29 @@ def _sqlite_override(qname: str) -> str | None:
     return sq.replace(div, f"ROUND({div}, 2)")
 
 
-@pytest.mark.parametrize("qname", sorted(QUERIES))
+# queries whose single-query compile+run exceeded ~3 s on the 2-vCPU
+# tier-1 container (profiled 2026-08; together ~870 s of the old
+# 1740 s full-suite wall): they ride the `slow` (nightly) tier so the
+# whole tier-1 suite completes inside its 870 s budget instead of
+# being cut off mid-file. The fast remainder keeps a broad TPC-DS
+# oracle sweep in tier 1 (q97 keeps the FULL JOIN dialect-rewrite
+# coverage there; q51's twin is slow-only).
+SLOW = {
+    "q01", "q02", "q04", "q05", "q08", "q09", "q10", "q11", "q12",
+    "q14", "q15", "q16", "q17", "q18", "q20", "q21", "q22", "q23",
+    "q24", "q25", "q26", "q27", "q28", "q29", "q30", "q31", "q32",
+    "q33", "q34", "q35", "q36", "q38", "q39", "q44", "q47", "q49",
+    "q50", "q51", "q53", "q54", "q56", "q57", "q58", "q59", "q60",
+    "q61", "q62", "q63", "q64", "q65", "q66", "q67", "q69", "q70",
+    "q71", "q72", "q74", "q75", "q76", "q77", "q78", "q80", "q81",
+    "q82", "q83", "q84", "q85", "q86", "q87", "q88", "q89", "q90",
+    "q95", "q98",
+}
+
+
+@pytest.mark.parametrize("qname", [
+    pytest.param(q, marks=pytest.mark.slow) if q in SLOW else q
+    for q in sorted(QUERIES)])
 def test_tpcds_query(qname, ds_engine, ds_oracle):
     assert_query(ds_engine, ds_oracle, QUERIES[qname],
                  sqlite_sql=_sqlite_override(qname))

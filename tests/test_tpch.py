@@ -10,7 +10,15 @@ from presto_tpu.testing.oracle import assert_query
 
 from tpch_queries import QUERIES
 
+# queries whose single-query compile+run exceeded ~10 s on the 2-vCPU
+# tier-1 container (profiled 2026-08): they ride the `slow` (nightly)
+# tier so the full tier-1 suite fits its 870 s budget. The remaining
+# 20 TPC-H shapes keep the oracle sweep's coverage in tier 1.
+SLOW = {"q19", "q21"}
 
-@pytest.mark.parametrize("qname", sorted(QUERIES))
+
+@pytest.mark.parametrize("qname", [
+    pytest.param(q, marks=pytest.mark.slow) if q in SLOW else q
+    for q in sorted(QUERIES)])
 def test_tpch_query(qname, engine, oracle):
     assert_query(engine, oracle, QUERIES[qname])
