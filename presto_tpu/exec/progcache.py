@@ -105,6 +105,16 @@ _LOAD_SECONDS = REGISTRY.histogram(
 # entries for a knob the trace never sees. (A replan changes plan
 # ANNOTATIONS — capacities, distributions — which already participate
 # via the plan fingerprint and capacity buckets.)
+#
+# This tuple is machine-checked both ways by the ``tracekey`` lint
+# rule (lint/tracekey.py): a trace-reachable session read missing
+# here is an ``unsound-read`` finding (stale-executable wrong
+# results), and an entry no trace-reachable code reads is a
+# ``stale-key-entry`` finding (spurious recompiles). PR 15 pruned
+# ``use_connector_partitioning`` on that analysis: it is read only
+# host-side by execute_plan_distributed, and the bucketing decision
+# it drives already rides the distributed cache key as the explicit
+# per-scan ``(part_cols, bucketed)`` component.
 TRACE_RELEVANT_PROPERTIES = (
     "broadcast_join_threshold_rows",
     "distributed_sort",
@@ -119,8 +129,28 @@ TRACE_RELEVANT_PROPERTIES = (
     "partial_aggregation",
     "partitioned_agg_min_groups",
     "skew_hot_key_threshold",
-    "use_connector_partitioning",
 )
+
+# Ambient reads the tracekey provenance analysis sees inside trace
+# scope that are DELIBERATELY not part of the canonical key, each with
+# the soundness argument. Ids are the rule's finding ids
+# (``session:<prop>``, ``env:<NAME>``, ``global:<relpath>:<NAME>``,
+# ``key:<prop>``); an entry that stops matching a finding becomes a
+# ``stale-exemption`` finding itself, so this registry cannot rot into
+# a blanket waiver.
+TRACE_KEY_EXEMPT = {
+    "global:presto_tpu/ops/hash.py:_DICT_HASH_CACHE":
+        "pure memoization: the cached hashes are a content-only "
+        "function of the dictionary array (identity-checked strong "
+        "ref), and dictionary CONTENT already rides every cache key "
+        "via scan_dictionary_key — a rebuilt cache yields bit-equal "
+        "values",
+    "global:presto_tpu/expr/compile.py:_DATE_FORMAT_CACHE":
+        "pure memoization keyed by the date_format literal: the LUT "
+        "is a content-only function of the format string, which is "
+        "structural (never hoisted by templates/analysis.py) and so "
+        "participates in the plan fingerprint",
+}
 
 DEFAULT_MAX_ENTRIES = 64
 DEFAULT_MAX_BYTES = int(os.environ.get(

@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import dataclasses
 import re
+import threading
 from typing import Callable
 
 import jax
@@ -140,8 +141,20 @@ def _bool(data, valid=None) -> Val:
     return Val(T.BOOLEAN, data, valid)
 
 
-# column bindings of the innermost _c_call in flight (lambda captures)
-_COMPILER_COLUMNS: list[dict] = []
+# column bindings of the innermost _c_call in flight (lambda
+# captures). Per-THREAD: parallel segment compilation traces
+# concurrent programs on pool threads, and a process-global stack
+# would interleave their push/pop and bind another trace's columns
+# into a lambda body (caught by the tracekey lint: a mutable module
+# global read at trace time is also a cache-key soundness hazard)
+_COMPILER_TLS = threading.local()
+
+
+def _compiler_columns() -> list[dict]:
+    stack = getattr(_COMPILER_TLS, "stack", None)
+    if stack is None:
+        stack = _COMPILER_TLS.stack = []
+    return stack
 
 
 # --- dictionary helpers (host side, trace time) ----------------------------
@@ -302,11 +315,12 @@ class ExprCompiler:
             raise NotImplementedError(f"scalar function {e.fn}")
         # higher-order kernels re-enter compilation for lambda bodies
         # and need this call's column bindings (outer captures)
-        _COMPILER_COLUMNS.append(self.columns)
+        stack = _compiler_columns()
+        stack.append(self.columns)
         try:
             return fn(e, args)
         finally:
-            _COMPILER_COLUMNS.pop()
+            stack.pop()
 
     def _c_lambda(self, e: "ir.Lambda") -> Val:
         # lambdas are not values: higher-order kernels read them from
@@ -1820,7 +1834,8 @@ def _bind_lambda(lam: ir.Lambda, arrays: list[Val],
     [n, cap] element values (outer columns broadcast to [n, 1]);
     returns the body's [n, cap] Val."""
     if columns is None:
-        columns = _COMPILER_COLUMNS[-1] if _COMPILER_COLUMNS else {}
+        stack = _compiler_columns()
+        columns = stack[-1] if stack else {}
     cap = arrays[0].data.shape[1]
     cols = _broadcast_cols_2d(columns, cap)
     for p, arr in zip(lam.params, arrays):
@@ -2004,7 +2019,8 @@ def _reduce_array(e, args):
     mask = v.elem_mask()
     for j in range(cap):
         elem = Val(v.dtype.element, v.data[:, j], None, v.dictionary)
-        cols = dict(_COMPILER_COLUMNS[-1]) if _COMPILER_COLUMNS else {}
+        stack = _compiler_columns()
+        cols = dict(stack[-1]) if stack else {}
         cols[lam.params[0]] = acc
         cols[lam.params[1]] = elem
         stepped = ExprCompiler(cols).compile(lam.body)
@@ -2023,7 +2039,8 @@ def _reduce_array(e, args):
             new_valid = jnp.where(take, sv, av)
         acc = Val(acc_t, new_data, new_valid)
     if out_lam is not None:
-        cols = dict(_COMPILER_COLUMNS[-1]) if _COMPILER_COLUMNS else {}
+        stack = _compiler_columns()
+        cols = dict(stack[-1]) if stack else {}
         cols[out_lam.params[0]] = acc
         acc = ExprCompiler(cols).compile(out_lam.body)
     return Val(e.dtype, acc.data, and_valid(v.valid, acc.valid))

@@ -1,8 +1,8 @@
 """Engine-specific static analysis (stdlib ``ast`` only).
 
-Three rule families guard the places where this engine's bugs ship
-silently (the reference defends the last with its PlanSanityChecker
-pipeline, sql/planner/sanity/PlanSanityChecker.java):
+Eleven rule families guard the places where this engine's bugs ship
+silently (the reference defends the analogous seams with its
+PlanSanityChecker pipeline, sql/planner/sanity/PlanSanityChecker.java):
 
 - **tracer hygiene** (``lint/tracer.py``): inside ``@jax.jit``-reachable
   functions, Python-level inspection of traced values either crashes at
@@ -26,6 +26,10 @@ pipeline, sql/planner/sanity/PlanSanityChecker.java):
   must be opened via ``with`` (or ``ExitStack.enter_context``) — a
   hand-entered span leaks both an unfinished span and the ambient
   trace context on any exception before close.
+- **pool discipline** (``lint/pools.py``): every ``MemoryPool.reserve``
+  call site must pair with a ``free`` on all exit paths (a ``finally``
+  in the same function) — a leaked reservation permanently shrinks the
+  pool under exactly the load it governs.
 - **field-level locksets** (``lint/races.py``): the Eraser-style
   refinement of lock discipline — every field's read/write sites must
   agree on WHICH lock guards it; written-under-A-read-under-B races
@@ -35,13 +39,26 @@ pipeline, sql/planner/sanity/PlanSanityChecker.java):
   context, cancel token, stats recorder, session override) must hand
   the state over explicitly or document why the thread is
   context-free.
+- **kernel parity** (``lint/kernels.py``): every Pallas kernel is
+  registered in the ``kernel_backend`` dispatch table beside a real
+  XLA fallback — an unregistered kernel is unreachable from the
+  session property and invisible to parity testing.
+- **trace-key provenance** (``lint/tracekey.py``): every ambient
+  input trace-reachable code reads (session property, env var,
+  mutable module global — tracked across aliases, parameters, and
+  helper calls) must participate in the program-cache key or carry a
+  justified ``TRACE_KEY_EXEMPT`` entry, and every
+  ``TRACE_RELEVANT_PROPERTIES`` entry must be genuinely read — the
+  compile-cache soundness contract, machine-checked both ways.
 
 Run ``python -m presto_tpu.lint presto_tpu/`` (exits nonzero on
 findings; ``--changed`` scopes reporting to files changed since HEAD
-for pre-commit runs); suppress a single line with
-``# lint: disable=rule-name`` plus a comment saying why. Stale
-suppressions — disables that no longer suppress anything — are
-reported as ``stale-suppression`` findings by the runner itself.
+for pre-commit runs; ``--sarif`` emits a SARIF 2.1.0 log for CI
+diff annotation, in-source waivers exported as suppressed results);
+suppress a single line with ``# lint: disable=rule-name`` plus a
+comment saying why. Stale suppressions — disables that no longer
+suppress anything — are reported as ``stale-suppression`` findings by
+the runner itself.
 """
 
 from presto_tpu.lint.core import (Finding, Project, available_rules,
@@ -58,5 +75,6 @@ from presto_tpu.lint import spans as _spans  # noqa: E402,F401
 from presto_tpu.lint import races as _races  # noqa: E402,F401
 from presto_tpu.lint import handoff as _handoff  # noqa: E402,F401
 from presto_tpu.lint import kernels as _kernels  # noqa: E402,F401
+from presto_tpu.lint import tracekey as _tracekey  # noqa: E402,F401
 
 __all__ = ["Finding", "Project", "available_rules", "run_lint"]

@@ -1,7 +1,11 @@
-"""CLI: ``python -m presto_tpu.lint [paths...] [--json] [--rules ...]``.
+"""CLI: ``python -m presto_tpu.lint [paths...] [--json | --sarif]
+[--rules ...] [--changed]``.
 
 Exits 0 when clean, 1 when there are unsuppressed findings, 2 on usage
 errors — so the lint can gate CI the way the tier-1 tests do.
+``--changed --sarif`` is the pre-commit/CI recipe: whole-tree
+analysis, reporting scoped to files touched since HEAD, output a
+SARIF 2.1.0 log standard diff-annotation tooling ingests verbatim.
 """
 
 from __future__ import annotations
@@ -60,6 +64,14 @@ def main(argv: list[str] | None = None) -> int:
                              f"(available: {', '.join(available_rules())})")
     parser.add_argument("--json", action="store_true", dest="as_json",
                         help="machine-readable JSON findings on stdout")
+    parser.add_argument("--sarif", action="store_true",
+                        dest="as_sarif",
+                        help="SARIF 2.1.0 log on stdout (rule ids, "
+                             "file/line regions, messages, in-source "
+                             "suppressions as suppressed results) — "
+                             "the CI/code-scanning format; combine "
+                             "with --changed for the pre-commit "
+                             "recipe")
     parser.add_argument("--changed", action="store_true",
                         help="report only findings in files changed "
                              "since HEAD (worktree + staged + "
@@ -71,7 +83,12 @@ def main(argv: list[str] | None = None) -> int:
     rules = None
     if args.rules:
         rules = [r.strip() for r in args.rules.split(",") if r.strip()]
+    if args.as_json and args.as_sarif:
+        print("--json and --sarif are mutually exclusive",
+              file=sys.stderr)
+        return 2
     only_files = None
+    suppressed: list | None = [] if args.as_sarif else None
     try:
         if args.changed:
             only_files = _changed_files(args.paths)
@@ -91,19 +108,29 @@ def main(argv: list[str] | None = None) -> int:
                         raise ValueError(
                             f"unknown lint rules: {unknown} "
                             f"(available: {available_rules()})")
-                if not args.as_json:
+                if args.as_json:
+                    print("[]")
+                elif args.as_sarif:
+                    from presto_tpu.lint.sarif import to_sarif
+                    print(json.dumps(to_sarif(
+                        [], [], rules or available_rules()), indent=2))
+                else:
                     print("no changed .py files; nothing to lint",
                           file=sys.stderr)
-                else:
-                    print("[]")
                 return 0
-        findings = run_lint(args.paths, rules, only_files=only_files)
+        findings = run_lint(args.paths, rules, only_files=only_files,
+                            collect_suppressed=suppressed)
     except ValueError as e:
         print(str(e), file=sys.stderr)
         return 2
 
     if args.as_json:
         print(json.dumps([f.to_dict() for f in findings], indent=2))
+    elif args.as_sarif:
+        from presto_tpu.lint.sarif import to_sarif
+        print(json.dumps(to_sarif(findings, suppressed,
+                                  rules or available_rules()),
+                         indent=2))
     else:
         for f in findings:
             print(f.format())

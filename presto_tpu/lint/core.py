@@ -254,13 +254,19 @@ def _stale_suppressions(project: Project, selected: list[str],
 
 def run_lint(paths: Iterable[str | Path],
              rules: Iterable[str] | None = None,
-             only_files: set[Path] | None = None) -> list[Finding]:
+             only_files: set[Path] | None = None,
+             collect_suppressed: list[Finding] | None = None
+             ) -> list[Finding]:
     """Run the selected rules (default: all) over ``paths``; returns
     unsuppressed findings — plus ``stale-suppression`` findings for
     disable comments that excused nothing — sorted by location.
     ``only_files`` (resolved paths) restricts REPORTING to those
     files while the analysis still sees the whole tree (the CLI's
-    ``--changed`` mode: cross-file rules stay sound)."""
+    ``--changed`` mode: cross-file rules stay sound).
+    ``collect_suppressed`` (a list, appended in place) receives the
+    findings an in-source ``# lint: disable`` excused — the SARIF
+    export reports them as suppressed results instead of dropping
+    them, so CI dashboards can audit the waivers."""
     import presto_tpu.lint  # noqa: F401 - ensure rules registered
     paths = list(paths)
     missing = [str(p) for p in paths if not Path(p).exists()]
@@ -289,6 +295,8 @@ def run_lint(paths: Iterable[str | Path],
                 else:
                     used.setdefault((f.path, f.line),
                                     set()).add(f.rule)
+                if collect_suppressed is not None:
+                    collect_suppressed.append(f)
                 continue
             findings.append(f)
     for f in _stale_suppressions(project, selected, used,
@@ -300,12 +308,22 @@ def run_lint(paths: Iterable[str | Path],
             # staleness report — the blanket being reported as stale
             # must not vouch for itself
             if names is not None and STALE_RULE in names:
+                if collect_suppressed is not None:
+                    collect_suppressed.append(f)
                 continue
         findings.append(f)
     if only_files is not None:
         findings = [f for f in findings
                     if (m := project.by_relpath.get(f.path)) is not None
                     and m.path.resolve() in only_files]
+        if collect_suppressed is not None:
+            collect_suppressed[:] = [
+                f for f in collect_suppressed
+                if (m := project.by_relpath.get(f.path)) is not None
+                and m.path.resolve() in only_files]
+    if collect_suppressed is not None:
+        collect_suppressed.sort(key=lambda f: (f.path, f.line, f.col,
+                                               f.rule))
     return sorted(findings, key=lambda f: (f.path, f.line, f.col,
                                            f.rule))
 
@@ -340,6 +358,31 @@ def walk_functions(tree: ast.AST):
             else:
                 yield from visit(child, path)
     yield from visit(tree, ())
+
+
+def literal_str_dict(mod: SourceModule, name: str
+                     ) -> dict[str, tuple[str, int]]:
+    """Module-level ``name = {"key": "reason", ...}`` assignments
+    (plain or annotated) -> {key: (reason, line)}. The shared parser
+    behind the exemption registries (KERNEL_DISPATCH_EXEMPT,
+    TRACE_KEY_EXEMPT): non-string reasons parse as "" so the owning
+    rule can demand a justification."""
+    out: dict[str, tuple[str, int]] = {}
+    for node in mod.tree.body:
+        targets = (node.targets if isinstance(node, ast.Assign)
+                   else [node.target]
+                   if isinstance(node, ast.AnnAssign) else [])
+        if not any(isinstance(t, ast.Name) and t.id == name
+                   for t in targets):
+            continue
+        if not isinstance(node.value, ast.Dict):
+            continue
+        for k, v in zip(node.value.keys, node.value.values):
+            if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                reason = (v.value if isinstance(v, ast.Constant)
+                          and isinstance(v.value, str) else "")
+                out[k.value] = (reason, k.lineno)
+    return out
 
 
 def import_aliases(tree: ast.AST,

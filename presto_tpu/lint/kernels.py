@@ -31,7 +31,7 @@ from __future__ import annotations
 import ast
 
 from presto_tpu.lint.core import (Finding, Project, SourceModule,
-                                  qual_name, rule)
+                                  literal_str_dict, qual_name, rule)
 
 REGISTRY_PATH = "presto_tpu/kernels/__init__.py"
 PACKAGE_PREFIX = "presto_tpu/kernels/"
@@ -79,20 +79,7 @@ def _module_functions(mod: SourceModule) -> set[str]:
 
 
 def _exempt(mod: SourceModule) -> dict[str, tuple[str, int]]:
-    out: dict[str, tuple[str, int]] = {}
-    for node in mod.tree.body:
-        if not (isinstance(node, ast.Assign)
-                and any(isinstance(t, ast.Name)
-                        and t.id == "KERNEL_DISPATCH_EXEMPT"
-                        for t in node.targets)
-                and isinstance(node.value, ast.Dict)):
-            continue
-        for k, v in zip(node.value.keys, node.value.values):
-            if isinstance(k, ast.Constant) and isinstance(k.value, str):
-                reason = (v.value if isinstance(v, ast.Constant)
-                          and isinstance(v.value, str) else "")
-                out[k.value] = (reason, k.lineno)
-    return out
+    return literal_str_dict(mod, "KERNEL_DISPATCH_EXEMPT")
 
 
 @rule("kernel-parity")

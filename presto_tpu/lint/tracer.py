@@ -119,8 +119,15 @@ def _collect_units(mods: list[SourceModule]
                    ) -> dict[tuple, _FnUnit]:
     units: dict[tuple, _FnUnit] = {}
     for mod in mods:
-        for path, fn in walk_functions(mod.tree):
-            units[(mod.relpath, path)] = _FnUnit(mod, path, fn)
+        own = getattr(mod, "_fn_units", None)
+        if own is None:
+            # cached on the SourceModule: the tracer family and the
+            # tracekey rule scope overlapping directories, and the
+            # function walk is the expensive half of graph building
+            own = mod._fn_units = {
+                (mod.relpath, path): _FnUnit(mod, path, fn)
+                for path, fn in walk_functions(mod.tree)}
+        units.update(own)
     return units
 
 
@@ -252,79 +259,127 @@ def _find_roots(mods: list[SourceModule], units: dict[tuple, _FnUnit],
     return roots, statics
 
 
-def _reachable(mods: list[SourceModule], units: dict[tuple, _FnUnit],
-               roots: set[tuple],
-               alias_cache: dict[str, dict[str, str]]) -> set[tuple]:
-    """BFS over the call graph from the jit roots. Edges: plain and
-    imported-module calls, same-module method calls by name, class
-    instantiation (all methods of the class), bare function references
-    (callbacks passed as values), and getattr-computed self dispatch
-    (all sibling methods)."""
-    mod_by_name = {m.modname: m for m in mods}
-    by_name: dict[tuple[str, str], list[_FnUnit]] = {}
-    for u in units.values():
-        by_name.setdefault((u.mod.relpath, u.name), []).append(u)
-    classes = _class_methods(mods)
+class CallGraph:
+    """The jit-reachability call graph over one scope set, shared by
+    the tracer family and the trace-key provenance rule (tracekey.py):
+    parsed function units, import aliases, name-resolution tables, and
+    the edge relation. Obtain via :func:`call_graph` (cached per
+    project so the two families never re-walk the tree)."""
 
-    def named(relpath: str, name: str) -> Iterator[_FnUnit]:
-        yield from by_name.get((relpath, name), [])
-        for key in classes.get((relpath, name), []):
-            if (relpath, key) in units:
-                yield units[(relpath, key)]
+    def __init__(self, mods: list[SourceModule]):
+        self.mods = mods
+        self.units = _collect_units(mods)
+        self.alias_cache = {m.relpath: m.aliases for m in mods}
+        self.mod_by_name: dict[str, SourceModule] = {}
+        for m in mods:
+            self.mod_by_name[m.modname] = m
+            if m.modname.endswith(".__init__"):
+                # a package's functions are addressed through the
+                # package name (`from presto_tpu import kernels as K;
+                # K.dispatch(...)`), never through ``.__init__``
+                self.mod_by_name[m.modname[:-len(".__init__")]] = m
+        self.by_name: dict[tuple[str, str], list[_FnUnit]] = {}
+        for u in self.units.values():
+            self.by_name.setdefault((u.mod.relpath, u.name),
+                                    []).append(u)
+        self.classes = _class_methods(mods)
 
-    def edges(u: _FnUnit) -> Iterator[_FnUnit]:
-        aliases = alias_cache[u.mod.relpath]
+    def named(self, relpath: str, name: str) -> Iterator[_FnUnit]:
+        """Units a bare name resolves to in ``relpath``: functions with
+        that name, plus every method of a class with that name
+        (instantiation makes the whole class live)."""
+        yield from self.by_name.get((relpath, name), [])
+        for key in self.classes.get((relpath, name), []):
+            if (relpath, key) in self.units:
+                yield self.units[(relpath, key)]
+
+    def find_roots(self) -> tuple[set[tuple], list[tuple]]:
+        return _find_roots(self.mods, self.units, self.alias_cache)
+
+    def resolve_call(self, u: _FnUnit,
+                     call: ast.Call) -> Iterator[_FnUnit]:
+        """Units one Call node may enter (same resolution the edge
+        relation uses; exposed for per-call-site analyses like the
+        tracekey argument-taint fixpoint)."""
+        aliases = self.alias_cache[u.mod.relpath]
+        fn = call.func
+        if isinstance(fn, ast.Name):
+            if fn.id == "getattr":
+                return
+            tq = aliases.get(fn.id)
+            if tq and "." in tq:
+                tmod, _, tname = tq.rpartition(".")
+                m = self.mod_by_name.get(tmod)
+                if m is not None:
+                    yield from self.named(m.relpath, tname)
+                    return
+            yield from self.named(u.mod.relpath, fn.id)
+        elif isinstance(fn, ast.Attribute):
+            base = _resolve(qual_name(fn.value), aliases)
+            m = self.mod_by_name.get(base) if base else None
+            if m is not None:
+                yield from self.named(m.relpath, fn.attr)
+            else:
+                yield from self.named(u.mod.relpath, fn.attr)
+
+    def edges(self, u: _FnUnit) -> Iterator[_FnUnit]:
+        """Callees of one unit: plain and imported-module calls,
+        same-module method calls by name, class instantiation (all
+        methods), bare function references (callbacks passed as
+        values), and getattr-computed self dispatch (all sibling
+        methods)."""
         class_wide = False
         for stmt in u.own_statements():
             if isinstance(stmt, ast.Name) and \
                     isinstance(stmt.ctx, ast.Load):
                 # bare reference: a callback handed to other code
-                yield from by_name.get((u.mod.relpath, stmt.id), [])
+                yield from self.by_name.get((u.mod.relpath, stmt.id),
+                                            [])
                 continue
             if not isinstance(stmt, ast.Call):
                 continue
             fn = stmt.func
-            if isinstance(fn, ast.Name):
+            if isinstance(fn, ast.Name) and fn.id == "getattr":
                 # computed dispatch: getattr(self, ...) marks every
                 # sibling method reachable (PlanInterpreter.run)
-                if fn.id == "getattr":
-                    if stmt.args and \
-                            isinstance(stmt.args[0], ast.Name) and \
-                            stmt.args[0].id == "self":
-                        class_wide = True
-                    continue
-                tq = aliases.get(fn.id)
-                if tq and "." in tq:
-                    # from presto_tpu.x import f -> cross-module
-                    tmod, _, tname = tq.rpartition(".")
-                    m = mod_by_name.get(tmod)
-                    if m is not None:
-                        yield from named(m.relpath, tname)
-                        continue
-                yield from named(u.mod.relpath, fn.id)
-            elif isinstance(fn, ast.Attribute):
-                base = _resolve(qual_name(fn.value), aliases)
-                m = mod_by_name.get(base) if base else None
-                if m is not None:
-                    yield from named(m.relpath, fn.attr)
-                else:
-                    yield from named(u.mod.relpath, fn.attr)
+                if stmt.args and \
+                        isinstance(stmt.args[0], ast.Name) and \
+                        stmt.args[0].id == "self":
+                    class_wide = True
+                continue
+            yield from self.resolve_call(u, stmt)
         if class_wide and len(u.path) >= 2:
             prefix = u.path[:-1]
-            for other in units.values():
+            for other in self.units.values():
                 if other.mod is u.mod and len(other.path) == \
                         len(u.path) and other.path[:-1] == prefix:
                     yield other
 
-    seen = set(roots)
-    frontier = [units[k] for k in roots if k in units]
-    while frontier:
-        u = frontier.pop()
-        for tgt in edges(u):
-            if tgt.key not in seen:
-                seen.add(tgt.key)
-                frontier.append(tgt)
-    return seen
+    def reachable(self, roots: set[tuple]) -> set[tuple]:
+        """BFS over the call graph from ``roots``."""
+        seen = set(roots)
+        frontier = [self.units[k] for k in roots if k in self.units]
+        while frontier:
+            u = frontier.pop()
+            for tgt in self.edges(u):
+                if tgt.key not in seen:
+                    seen.add(tgt.key)
+                    frontier.append(tgt)
+        return seen
+
+
+def call_graph(project: Project,
+               scopes: tuple[str, ...]) -> CallGraph:
+    """The CallGraph for ``scopes``, cached on the project instance
+    (like locks.class_analyses: the data dies with the run instead of
+    pinning the parsed package in a module global)."""
+    cache = getattr(project, "_callgraph_cache", None)
+    if cache is None:
+        cache = project._callgraph_cache = {}
+    graph = cache.get(scopes)
+    if graph is None:
+        graph = cache[scopes] = CallGraph(project.in_scope(scopes))
+    return graph
 
 
 def _check_unit(u: _FnUnit, findings: list[Finding],
@@ -457,20 +512,19 @@ def _run_family(project: Project, keep: set[str]) -> list[Finding]:
     if _family_cache and _family_cache[0]() is project:
         cached = _family_cache[1]
     else:
-        mods = project.in_scope(TRACE_SCOPES)
-        units = _collect_units(mods)
-        # one alias table per module (core.py caches it), shared by
-        # root finding, reachability, and the per-function checks:
-        # recomputing walks the whole module AST each time and
-        # dominates lint runtime
-        alias_cache = {m.relpath: m.aliases for m in mods}
-        roots, statics = _find_roots(mods, units, alias_cache)
-        reach = _reachable(mods, units, roots, alias_cache)
+        # one CallGraph per (project, scopes) — module alias tables and
+        # function units are cached on the modules themselves, so the
+        # tracekey rule riding the same graph machinery pays nothing
+        # extra for the shared directories
+        graph = call_graph(project, TRACE_SCOPES)
+        roots, statics = graph.find_roots()
+        reach = graph.reachable(roots)
         cached = []
         for key in sorted(reach):
-            u = units.get(key)
+            u = graph.units.get(key)
             if u is not None:
-                _check_unit(u, cached, alias_cache[u.mod.relpath])
+                _check_unit(u, cached,
+                            graph.alias_cache[u.mod.relpath])
         _check_static_args(statics, cached)
         _family_cache[:] = [weakref.ref(project), cached]
     return [f for f in cached if f.rule in keep]

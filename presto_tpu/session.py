@@ -262,7 +262,11 @@ SYSTEM_SESSION_PROPERTIES: dict[str, tuple[Any, type, str]] = {
     # Host-side control-plane properties: none of them are read at
     # trace time, so they deliberately stay OUT of the program-cache
     # key (exec/progcache.TRACE_RELEVANT_PROPERTIES) — flipping them
-    # must not re-key compiled programs.
+    # must not re-key compiled programs. Both directions of that
+    # contract are machine-checked by the `tracekey` lint rule
+    # (lint/tracekey.py): a trace-reachable read of an unkeyed
+    # property fails tier-1 as unsound-read, and a keyed property no
+    # trace-reachable code reads fails as stale-key-entry.
     "adaptive_replanning": (True, bool,
                             "mid-query adaptive re-planning in the "
                             "retry_policy=TASK stage walk: after each "

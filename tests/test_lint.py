@@ -1040,10 +1040,13 @@ def test_changed_mode_outside_git_is_usage_error(tmp_path, capsys):
 
 
 def test_full_suite_wall_time_budget():
-    """One shared parsed-AST project model serves every rule: the
+    """One shared parsed-AST project model serves every rule — the
+    tracekey provenance pass included, riding the tracer family's
+    cached call-graph machinery and per-module unit walks: the
     whole-package run must stay inside an interactive budget (locally
-    ~3 s; the bound leaves headroom for a loaded CI container but
-    catches the per-rule re-walk regression class, which tripled it)."""
+    ~3 s with all eleven families; the bound leaves headroom for a
+    loaded CI container but catches the per-rule re-walk regression
+    class, which tripled it)."""
     import time
     t0 = time.perf_counter()
     findings = run_lint([REPO / "presto_tpu"])
@@ -1147,6 +1150,307 @@ def test_kernel_parity_unregistered_pallas_kernel(tmp_path):
     findings = run_lint([pkg], rules=["kernel-parity"])
     assert any("rogue_pallas" in f.message and
                "not registered" in f.message for f in findings)
+
+
+# -- trace-key provenance (tracekey) ----------------------------------------
+
+# the retired tests/test_progcache.py drift guard scanned exactly this
+# shape: a direct `self.session.get("...")` lexically inside the
+# interpreter class — kept here as the subsumption proof that the
+# whole-tree rule still catches it
+TRACEKEY_DIRECT_FIXTURE = """
+    class PlanInterpreter:
+        def run(self, node):
+            return getattr(self, "_r_" + type(node).__name__)(node)
+
+        def _r_filter(self, node):
+            if self.session.get("mystery_prop"):
+                return node
+            return node
+"""
+
+
+def test_tracekey_subsumes_retired_direct_read_scan(tmp_path):
+    """The old two-class AST scan (direct session.get inside the
+    interpreter classes) is a strict subset of the provenance rule:
+    the same shape fires as an unsound-read, and adding the key to
+    TRACE_RELEVANT_PROPERTIES clears it."""
+    pkg = write_pkg(tmp_path, {
+        "presto_tpu/exec/broken.py": TRACEKEY_DIRECT_FIXTURE})
+    findings = run_lint([pkg], rules=["tracekey"])
+    assert len(findings) == 1, [f.format() for f in findings]
+    assert "unsound-read" in findings[0].message
+    assert "'mystery_prop'" in findings[0].message
+    keyed = write_pkg(tmp_path / "ok", {
+        "presto_tpu/exec/broken.py": TRACEKEY_DIRECT_FIXTURE,
+        "presto_tpu/exec/progcache.py": """
+            TRACE_RELEVANT_PROPERTIES = ("mystery_prop",)
+        """})
+    assert run_lint([keyed], rules=["tracekey"]) == []
+
+
+def test_tracekey_follows_aliases_and_helper_calls(tmp_path):
+    """The interprocedural half the retired scan could not see:
+    a local session alias and a helper taking the session under
+    ANOTHER parameter name both carry the taint to the read."""
+    pkg = write_pkg(tmp_path, {"presto_tpu/exec/broken.py": """
+        class PlanInterpreter:
+            def run(self, node):
+                return getattr(self, "_r_" + type(node).__name__)(node)
+
+            def _r_project(self, node):
+                s = self.session
+                return s.get("aliased_prop")
+
+            def _r_join(self, node):
+                return _threshold(self.session, node)
+
+        def _threshold(sess, node):
+            return sess.get("helper_prop")
+
+        def host_driver(engine):
+            # identical read, NOT trace-reachable: must stay silent
+            return engine.session.get("host_only_prop")
+    """})
+    findings = run_lint([pkg], rules=["tracekey"])
+    keys = {f.message.split("'")[1] for f in findings}
+    assert keys == {"aliased_prop", "helper_prop"}, \
+        [f.format() for f in findings]
+
+
+def test_tracekey_env_read_and_unkeyed_global(tmp_path):
+    pkg = write_pkg(tmp_path, {"presto_tpu/exec/broken.py": """
+        import os
+
+        _LIMITS = {}
+
+        def set_limit(k, v):
+            _LIMITS[k] = v  # runtime mutation, no key participation
+
+        class PlanInterpreter:
+            def run(self, node):
+                return getattr(self, "_r_" + type(node).__name__)(node)
+
+            def _r_scan(self, node):
+                return os.environ.get("PRESTO_TPU_SECRET_MODE")
+
+            def _r_aggregate(self, node):
+                return _LIMITS.get("cap")
+    """})
+    findings = run_lint([pkg], rules=["tracekey"])
+    msgs = " | ".join(f.message for f in findings)
+    assert len(findings) == 2, [f.format() for f in findings]
+    assert "'PRESTO_TPU_SECRET_MODE'" in msgs and \
+        "platform fingerprint" in msgs
+    assert "unkeyed-global" in msgs and "'_LIMITS'" in msgs \
+        and "set_limit" in msgs
+
+
+def test_tracekey_cross_module_mutation(tmp_path):
+    """Mutation sites are scanned over the WHOLE analyzed project: a
+    module OUTSIDE the trace scopes writing through an import alias
+    (`tables.LIMITS[k] = v`) is as unsound as the defining module
+    doing it."""
+    pkg = write_pkg(tmp_path, {
+        "presto_tpu/exec/tables.py": """
+            LIMITS = {}
+        """,
+        "presto_tpu/exec/broken.py": """
+            from presto_tpu.exec import tables
+
+            class PlanInterpreter:
+                def run(self, node):
+                    return getattr(self, "_r_x")(node)
+
+                def _r_x(self, node):
+                    return tables.LIMITS.get("cap")
+        """,
+        "presto_tpu/server/admin.py": """
+            from presto_tpu.exec import tables
+
+            def set_limit(k, v):
+                tables.LIMITS[k] = v
+        """})
+    findings = run_lint([pkg], rules=["tracekey"])
+    assert len(findings) == 1, [f.format() for f in findings]
+    assert "unkeyed-global" in findings[0].message
+    assert "'LIMITS'" in findings[0].message
+    assert "presto_tpu/server/admin.py:set_limit" in \
+        findings[0].message
+    assert findings[0].path == "presto_tpu/exec/tables.py"
+
+
+def test_tracekey_import_time_registry_not_flagged(tmp_path):
+    """The SCALARS pattern: a dispatch table mutated only by a
+    module-level registration decorator fills at import time — its
+    contents are process-constant, not an unkeyed input."""
+    pkg = write_pkg(tmp_path, {"presto_tpu/exec/broken.py": """
+        TABLE = {}
+
+        def register(name):
+            def deco(fn):
+                TABLE[name] = fn
+                return fn
+            return deco
+
+        @register("f")
+        def f(node):
+            return node
+
+        class PlanInterpreter:
+            def run(self, node):
+                return getattr(self, "_r_" + type(node).__name__)(node)
+
+            def _r_call(self, node):
+                return TABLE["f"](node)
+    """})
+    assert run_lint([pkg], rules=["tracekey"]) == []
+
+
+def test_tracekey_stale_key_entry(tmp_path):
+    """A TRACE_RELEVANT_PROPERTIES entry no trace-reachable code
+    reads recompiles warm programs for nothing and masks drift."""
+    pkg = write_pkg(tmp_path, {
+        "presto_tpu/exec/progcache.py": """
+            TRACE_RELEVANT_PROPERTIES = ("live_prop", "ghost_prop")
+        """,
+        "presto_tpu/exec/broken.py": """
+            class PlanInterpreter:
+                def run(self, node):
+                    return getattr(self, "_r_x")(node)
+
+                def _r_x(self, node):
+                    return self.session.get("live_prop")
+        """})
+    findings = run_lint([pkg], rules=["tracekey"])
+    assert len(findings) == 1, [f.format() for f in findings]
+    assert "stale-key-entry" in findings[0].message
+    assert "'ghost_prop'" in findings[0].message
+    assert findings[0].path == "presto_tpu/exec/progcache.py"
+
+
+def test_tracekey_exemption_and_staleness(tmp_path):
+    """TRACE_KEY_EXEMPT excuses a finding WITH a justification — and
+    an exemption that stops matching becomes a finding itself (the
+    kernel-parity staleness discipline), so the registry cannot rot
+    into a blanket waiver."""
+    files = {
+        "presto_tpu/exec/broken.py": TRACEKEY_DIRECT_FIXTURE,
+        "presto_tpu/exec/progcache.py": """
+            TRACE_RELEVANT_PROPERTIES = ()
+            TRACE_KEY_EXEMPT = {
+                "session:mystery_prop": "host control plane only: "
+                                        "steers the stage walk",
+            }
+        """}
+    pkg = write_pkg(tmp_path, files)
+    assert run_lint([pkg], rules=["tracekey"]) == []
+    stale = dict(files)
+    stale["presto_tpu/exec/progcache.py"] = """
+        TRACE_RELEVANT_PROPERTIES = ("mystery_prop",)
+        TRACE_KEY_EXEMPT = {
+            "session:mystery_prop": "now keyed: exemption is dead",
+        }
+    """
+    pkg2 = write_pkg(tmp_path / "stale", stale)
+    findings = run_lint([pkg2], rules=["tracekey"])
+    assert len(findings) == 1, [f.format() for f in findings]
+    assert "stale-exemption" in findings[0].message
+    assert "session:mystery_prop" in findings[0].message
+
+
+def test_tracekey_shares_project_model_and_call_graph():
+    """Budget mechanics: the tracekey rule rides the SAME cached
+    per-module function units as the tracer family (one parsed-AST
+    project model, one unit walk per module) instead of re-walking
+    the tree — the regression class the wall-time budget exists to
+    catch."""
+    from presto_tpu.lint import tracekey as TK
+    from presto_tpu.lint import tracer as TR
+    from presto_tpu.lint.core import Project
+    project = Project.load([REPO / "presto_tpu"])
+    TR.tracer_branch(project)
+    TK.tracekey(project)
+    graphs = project._callgraph_cache
+    assert set(graphs) == {TR.TRACE_SCOPES, TK.SCOPES}
+    g1, g2 = graphs[TR.TRACE_SCOPES], graphs[TK.SCOPES]
+    shared = set(g1.units) & set(g2.units)
+    assert shared, "scopes stopped overlapping?"
+    assert all(g1.units[k] is g2.units[k] for k in shared)
+
+
+# -- SARIF output -----------------------------------------------------------
+
+
+def test_sarif_schema_shape_and_suppressions(tmp_path, capsys):
+    """--sarif emits SARIF 2.1.0: versioned log, tool driver rule
+    table, results with ruleId + physicalLocation, and in-source
+    waivers exported as SUPPRESSED results (not dropped) while the
+    exit code still ignores them."""
+    pkg = write_pkg(tmp_path, {"presto_tpu/exec/broken.py": """
+        import urllib.request
+
+        def bad(req):
+            return urllib.request.urlopen(req)
+
+        def waived(req):
+            return urllib.request.urlopen(req)  # lint: disable=timeout-discipline
+    """})
+    assert lint_main([str(pkg), "--sarif",
+                      "--rules", "timeout-discipline"]) == 1
+    log = json.loads(capsys.readouterr().out)
+    assert log["version"] == "2.1.0"
+    assert "sarif-schema-2.1.0" in log["$schema"]
+    (run,) = log["runs"]
+    rules = {r["id"] for r in run["tool"]["driver"]["rules"]}
+    assert "timeout-discipline" in rules
+    active = [r for r in run["results"] if not r["suppressions"]]
+    waived = [r for r in run["results"] if r["suppressions"]]
+    assert len(active) == 1 and len(waived) == 1
+    for r in run["results"]:
+        assert r["ruleId"] == "timeout-discipline"
+        loc = r["locations"][0]["physicalLocation"]
+        assert loc["artifactLocation"]["uri"] == \
+            "presto_tpu/exec/broken.py"
+        assert loc["region"]["startLine"] > 0
+        assert r["message"]["text"]
+    assert waived[0]["suppressions"] == [{"kind": "inSource"}]
+    # suppressed-only tree: exit 0, results still exported — a waived
+    # stale-suppression report included (every rule's waivers export,
+    # stale-suppression is not special-cased out of the audit trail)
+    clean = write_pkg(tmp_path / "c", {"presto_tpu/exec/only.py": """
+        import urllib.request
+
+        def waived(req):
+            return urllib.request.urlopen(req)  # lint: disable=timeout-discipline
+
+        x = 1  # lint: disable=stale-suppression,rule-that-never-existed
+    """})
+    assert lint_main([str(clean), "--sarif",
+                      "--rules", "timeout-discipline"]) == 0
+    log = json.loads(capsys.readouterr().out)
+    results = log["runs"][0]["results"]
+    assert [r["suppressions"] for r in results] == \
+        [[{"kind": "inSource"}]] * 2
+    assert {r["ruleId"] for r in results} == \
+        {"timeout-discipline", "stale-suppression"}
+
+
+def test_sarif_changed_mode_fast_exit_is_valid_sarif(tmp_path, capsys):
+    """The pre-commit recipe is `--changed --sarif`: a clean worktree
+    must still print a VALID empty SARIF log (CI uploads it verbatim),
+    and --json/--sarif together is a usage error."""
+    pkg = write_pkg(tmp_path,
+                    {"presto_tpu/exec/nothing.py": "x = 1\n"})
+    _git(tmp_path, "init", "-q")
+    _git(tmp_path, "add", "-A")
+    _git(tmp_path, "commit", "-qm", "seed")
+    assert lint_main([str(pkg), "--changed", "--sarif"]) == 0
+    log = json.loads(capsys.readouterr().out)
+    assert log["version"] == "2.1.0" and \
+        log["runs"][0]["results"] == []
+    assert lint_main([str(pkg), "--json", "--sarif"]) == 2
+    assert "mutually exclusive" in capsys.readouterr().err
 
 
 def test_kernel_parity_dangling_reference_and_exemption(tmp_path):
