@@ -145,6 +145,7 @@ for _ in range(reps):
     np.asarray(run_plan_live(engine, plan))
     times.append(time.perf_counter() - t0)
 top_ops = None
+device_syncs = None
 if reps:
     # ONE extra steady run under a qstats scope, OUTSIDE the timed
     # samples, so the child can report the top operators by
@@ -152,8 +153,14 @@ if reps:
     # system.operator_stats' per-kernel split) without the stats
     # recording ever inflating steady_s
     from presto_tpu.obs import qstats as QS
+    syncs = REGISTRY.counter("presto_tpu_device_syncs_total")
+    s0 = int(syncs.total())
     with QS.query("bench-" + name, QUERIES[name], "bench") as qr:
         np.asarray(run_plan_live(engine, plan))
+    # host round-trips per steady execute, through the counted
+    # exec/hostsync boundary (lint/devicesync.py proves there are no
+    # uncounted ones): each is ~a full device round-trip of latency
+    device_syncs = int(syncs.total()) - s0
     snap = qr.snapshot()
     ops = [o for st in snap["stages"] for t in st["tasks"]
            for o in t["operators"]]
@@ -182,6 +189,8 @@ if times:  # reps=0 = warm-start probe: first_s is the measurement
     out["steady_s"] = min(times)
 if top_ops is not None:
     out["top_operators"] = top_ops
+if device_syncs is not None:
+    out["device_syncs"] = device_syncs
 variant = sys.argv[4] if len(sys.argv) > 4 else ""
 if variant:
     # literal-variant warm measurement (plan templates): the same
@@ -798,6 +807,7 @@ def main() -> None:
                                     round(r["first_s"] - q1_steady, 1))
     detail["q01_execute_s"] = round(q1_steady, 2)
     detail["q01_programs_compiled"] = r.get("programs_compiled")
+    detail["q01_device_syncs"] = r.get("device_syncs")
     rows_per_sec = nrows / q1_steady
 
     # single-thread NumPy Q1 baseline (config-1 stand-in)
@@ -885,6 +895,7 @@ def main() -> None:
             "compile_s", round(r["first_s"] - r["steady_s"], 1))
         detail[f"{name}_execute_s"] = round(r["steady_s"], 2)
         detail[f"{name}_programs_compiled"] = r.get("programs_compiled")
+        detail[f"{name}_device_syncs"] = r.get("device_syncs")
         detail[f"{name}_capacity_overflow_retries"] = r.get(
             "capacity_overflow_retries")
         # which kernel backend the child resolved (auto = pallas on

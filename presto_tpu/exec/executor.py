@@ -24,6 +24,7 @@ import numpy as np
 
 from presto_tpu import types as T
 from presto_tpu.block import Column, Table
+from presto_tpu.exec import hostsync as HS
 from presto_tpu.exec import operators as OP
 from presto_tpu.exec.operators import DTable
 from presto_tpu.expr.compile import Val
@@ -721,7 +722,7 @@ def prepare_plan(engine, plan: N.PlanNode, scan_inputs: list[ScanInput]):
             # ONE host sync for every flag — also the point the async
             # dispatch actually finishes, so the span covers real
             # device time, not just call overhead
-            oks_np = np.asarray(oks)
+            oks_np = HS.fetch(oks, site="ok-ladder")
         execute_s = time.perf_counter() - _t1
         if oks_np.all():
             if not cache_hit:
@@ -939,7 +940,7 @@ def device_outputs(meta, res, live, cap_floor: int | None = None):
         dicts[sym] = dictionary
         types[sym] = dtype
     n = int(live.shape[0])
-    cnt = int(np.asarray(jnp.sum(live)))
+    cnt = HS.fetch_int(jnp.sum(live), site="segment-width")
     if cap_floor is None:
         cap = max(128, next_pow2(max(cnt, 1)))
     elif cap_floor and cnt <= cap_floor:
@@ -1318,7 +1319,7 @@ def run_plan(engine, plan: N.PlanNode,
 
         # one batched device->host transfer for every output column:
         # per-array np.asarray pays a tunnel round-trip each
-        live_np, res_np = jax.device_get((live, res))
+        live_np, res_np = HS.fetch((live, res), site="result-demux")
         cols: dict[str, Column] = {}
         i = 0
         for sym, dtype, dictionary, has_valid in meta["out"]:

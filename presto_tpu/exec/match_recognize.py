@@ -23,6 +23,7 @@ import numpy as np
 
 from presto_tpu import types as T
 from presto_tpu.block import Column, Table
+from presto_tpu.exec import hostsync as HS
 from presto_tpu.sql import ast as A
 
 
@@ -147,9 +148,9 @@ def evaluate(table: Table, node) -> Table:
     for s in node.partition_by:
         d = np.asarray(table.columns[s].data)[ridx]
         new_part[1:] |= d[1:] != d[:-1]
-        v = table.columns[s].valid
-        if v is not None:
-            vv = np.asarray(v)[ridx]
+        pvalid = table.columns[s].valid
+        if pvalid is not None:
+            vv = np.asarray(pvalid)[ridx]
             new_part[1:] |= vv[1:] != vv[:-1]
     part_start_idx = np.nonzero(new_part)[0]
 
@@ -203,6 +204,13 @@ def evaluate(table: Table, node) -> Table:
     for sym, kind, expr, _dtype in node.measures:
         if expr is not None:
             measure_vals[sym] = c.compile(expr)
+    # one batched device->host fetch for ALL measures up front: reading
+    # v.data / v.valid inside the per-match loop below would pay one
+    # round-trip per match
+    meas_host = {
+        sym: HS.fetch((v.data, v.valid), site="match-measures")
+        for sym, v in measure_vals.items()
+    }
 
     out_rows: dict[str, list] = {s: [] for s in node.partition_by}
     out_meas: dict[str, list] = {sym: [] for sym, *_ in node.measures}
@@ -233,10 +241,10 @@ def evaluate(table: Table, node) -> Table:
                     out_valid[sym].append(True)
                 else:
                     row = first_row if kind == "first" else last_row
-                    v = measure_vals[sym]
-                    out_meas[sym].append(np.asarray(v.data)[row])
-                    ok = (True if v.valid is None
-                          else bool(np.asarray(v.valid)[row]))
+                    data, vmask = meas_host[sym]
+                    out_meas[sym].append(data[row])
+                    ok = (True if vmask is None
+                          else bool(vmask[row]))
                     out_valid[sym].append(ok)
             i = end  # AFTER MATCH SKIP PAST LAST ROW
 

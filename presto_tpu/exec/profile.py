@@ -25,6 +25,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from presto_tpu.cost import row_estimates
+from presto_tpu.exec import hostsync as HS
 from presto_tpu.exec.executor import (collect_scans, device_outputs,
                                       make_traced, preorder_index)
 from presto_tpu.obs.trace import TRACER
@@ -39,7 +40,7 @@ def _rows_by_node_id(plan, meta, counts) -> dict[int, int]:
     ANALYZE's printer annotations key by object id, so invert the
     preorder walk."""
     inv = {pos: nid for nid, pos in preorder_index(plan).items()}
-    counts_np = np.asarray(counts)
+    counts_np = HS.fetch(counts, site="profile-counts")
     return {inv.get(key, key): int(c)
             for key, c in zip(meta["count_nodes"], counts_np)}
 
@@ -85,6 +86,9 @@ def _profiled_compile_run(engine, plan, scans):
         t0 = time.perf_counter()
         with TRACER.span("execute", analyze=True):
             res, live, oks, counts = compiled(*flat)
+            # raw measurement syncs (DEVICE_SYNC_EXEMPT, exec/hostsync):
+            # the profile measures the readback itself, and must not
+            # count into the hot-path device-sync counter
             jax.block_until_ready(live)
             oks_np = np.asarray(oks)
         run_s = time.perf_counter() - t0
@@ -137,7 +141,8 @@ def explain_analyze(engine, plan: N.PlanNode) -> str:
     total_t0 = time.perf_counter()
 
     def observe(seg, mat, arrays, n, wall_s, node_rows):
-        live = int(np.asarray(jnp.sum(arrays["__live__"])))
+        live = HS.fetch_int(jnp.sum(arrays["__live__"]),
+                            site="profile-live")
         seg_lines.append(
             f"Segment {seg} ({wall_s * 1e3:.1f} ms, "
             f"{live} live rows -> s{seg}[{n}])\n"

@@ -152,6 +152,20 @@ TRACE_KEY_EXEMPT = {
         "participates in the plan fingerprint",
 }
 
+# retrace-hazard exemptions (lint/retrace.py): deliberate
+# data-dependent control flow / shape construction in trace scope,
+# id ("<relpath>:<dotted.unit.path>:<kind>", kind in branch | shape |
+# key) -> justification. Stale entries are findings, like
+# TRACE_KEY_EXEMPT above.
+RETRACE_EXEMPT = {
+    "presto_tpu/exec/executor.py:device_outputs:branch":
+        "the branch on the live count IS the bucketing helper: both "
+        "arms produce bucketed carrier widths (the remembered "
+        "template width when the count fits, pow2-with-margin "
+        "regrowth when it overflows), so the data dependence is "
+        "confined to choosing between two cache-stable shapes",
+}
+
 DEFAULT_MAX_ENTRIES = 64
 DEFAULT_MAX_BYTES = int(os.environ.get(
     "PRESTO_TPU_PROGRAM_CACHE_MEM_BYTES", 2 << 30))
@@ -229,8 +243,10 @@ def scan_dictionary_key(scan_inputs) -> tuple:
 # traced-program output-format version: participates in the platform
 # fingerprint so persisted entries from an engine with a different
 # output contract (e.g. before the always-on per-node row counts
-# became a fourth program output) miss instead of mis-unpacking
-PROGRAM_FORMAT = "rows1"
+# became a fourth program output, or before the distributed path
+# stacked its ok flags into one (k,) array) miss instead of
+# mis-unpacking
+PROGRAM_FORMAT = "oks1"
 
 
 @functools.lru_cache(maxsize=32)

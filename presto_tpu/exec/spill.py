@@ -20,6 +20,7 @@ import numpy as np
 
 from presto_tpu import types as T
 from presto_tpu.block import Column, Table
+from presto_tpu.exec import hostsync as HS
 from presto_tpu.memory import MemoryLimitExceeded, estimate_plan_memory
 from presto_tpu.ops.hash import next_pow2
 from presto_tpu.plan import nodes as N
@@ -170,7 +171,7 @@ def _run_partitions(engine, jp: N.Join, part_inputs: list) -> list[Table]:
             feed = [pinput.arrays[s] for s in pinput0.arrays] + \
                    [binput.arrays[s] for s in binput0.arrays]
             res, live, oks = compiled(*feed)
-            oks_np = np.asarray(oks)
+            oks_np = HS.fetch(oks, site="spill-ok-ladder")
             if not oks_np.all():
                 for key, okv in zip(meta["ok_keys"], oks_np):
                     if not okv:
@@ -185,17 +186,19 @@ def _run_partitions(engine, jp: N.Join, part_inputs: list) -> list[Table]:
 
     outs = []
     for res, live in results:
+        # one batched transfer per partition, not one per column
+        res_np, live_np = HS.fetch((list(res), live),
+                                   site="spill-demux")
         cols: dict[str, Column] = {}
         i = 0
         for sym, dtype, dictionary, has_valid in meta["out"]:
-            data = np.asarray(res[i])
-            valid = np.asarray(res[i + 1])
+            data = res_np[i]
+            valid = res_np[i + 1]
             i += 2
             cols[sym] = Column(
                 dtype, data,
                 valid if has_valid or not valid.all() else None,
                 dictionary)
-        live_np = np.asarray(live)
         outs.append(Table(cols, len(live_np), live_np))
     return outs
 
@@ -229,7 +232,11 @@ def _spill_aggregate(engine, plan: N.PlanNode, agg: N.Aggregate,
     part = (h % np.uint64(nparts)).astype(np.int64)
     counts = np.bincount(part, minlength=nparts)
     live_parts = [p for p in range(nparts) if counts[p] > 0]
-    pmax = max(int(counts.max()), 1)
+    # pow2-bucket the partition width (lint/retrace.py): the raw
+    # bincount max is a data-dependent int that would otherwise set
+    # every carrier-scan shape, retracing the partition program per
+    # dataset; dead padding rows are masked by the carrier's __live__
+    pmax = next_pow2(max(int(counts.max()), 1))
 
     part_inputs = []
     ap = None
@@ -288,7 +295,7 @@ def _run_partition_plans(engine, root: N.PlanNode,
             for inp, inp0 in zip(inputs, inputs0):
                 feed.extend(inp.arrays[s] for s in inp0.arrays)
             res, live, oks = compiled(*feed)
-            oks_np = np.asarray(oks)
+            oks_np = HS.fetch(oks, site="spill-ok-ladder")
             if not oks_np.all():
                 for key, okv in zip(meta["ok_keys"], oks_np):
                     if not okv:
@@ -303,17 +310,19 @@ def _run_partition_plans(engine, root: N.PlanNode,
 
     outs = []
     for res, live in results:
+        # one batched transfer per partition, not one per column
+        res_np, live_np = HS.fetch((list(res), live),
+                                   site="spill-demux")
         cols: dict[str, Column] = {}
         i = 0
         for sym, dtype, dictionary, has_valid in meta["out"]:
-            data = np.asarray(res[i])
-            valid = np.asarray(res[i + 1])
+            data = res_np[i]
+            valid = res_np[i + 1]
             i += 2
             cols[sym] = Column(
                 dtype, data,
                 valid if has_valid or not valid.all() else None,
                 dictionary)
-        live_np = np.asarray(live)
         outs.append(Table(cols, len(live_np), live_np))
     return outs
 
@@ -417,9 +426,14 @@ def _partitioned_join_exec(engine, join: N.Join, nparts: int):
     # one operator pipeline per spilled partition too)
     pcounts = np.bincount(ppart[ppart >= 0], minlength=nparts)
     live_parts = [p for p in range(nparts) if pcounts[p] > 0]
-    pmax = max(int(pcounts.max()), 1)
-    bmax = max(int(np.bincount(bpart[bpart >= 0], minlength=nparts)
-                   .max()), 1)
+    # pow2-bucket the carrier widths (lint/retrace.py): the raw
+    # bincount maxes are data-dependent ints that would otherwise set
+    # every partition carrier's shape, compiling one join program per
+    # dataset; dead padding rows are masked by the carrier's __live__
+    pmax = next_pow2(max(int(pcounts.max()), 1))
+    bmax = next_pow2(max(int(np.bincount(bpart[bpart >= 0],
+                                         minlength=nparts)
+                             .max()), 1))
     part_inputs = []
     jp = None
     for p in live_parts:

@@ -24,6 +24,7 @@ import jax
 import numpy as np
 
 from presto_tpu import types as T
+from presto_tpu.exec import hostsync as HS
 from presto_tpu.plan import nodes as N
 
 
@@ -130,7 +131,7 @@ def try_execute_streamed(engine, plan: N.PlanNode):
                 compiled = jax.jit(traced_fn)
             res, live, oks = compiled(
                 *[arrays[sym] for sym in scan.arrays], arrays["__live__"])
-            oks_np = np.asarray(oks)
+            oks_np = HS.fetch(oks, site="streaming-ok-ladder")
             if oks_np.all():
                 break
             from presto_tpu.ops.hash import grow_overflowed
@@ -142,8 +143,11 @@ def try_execute_streamed(engine, plan: N.PlanNode):
             raise HashChainOverflow(
                 "hash table capacity retry limit exceeded")
         out_schema = meta["out"]
-        partial_cols.append([np.asarray(r) for r in res])
-        partial_live.append(np.asarray(live))
+        # one batched transfer per block, not one per output column
+        res_np, live_np = HS.fetch((list(res), live),
+                                   site="streaming-demux")
+        partial_cols.append(res_np)
+        partial_live.append(live_np)
 
     # -- phase 2: rest of the plan over the concatenated partials --------
     carrier_syms = [sym for sym, _t, _d, _v in out_schema]

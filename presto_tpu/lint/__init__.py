@@ -1,6 +1,6 @@
 """Engine-specific static analysis (stdlib ``ast`` only).
 
-Eleven rule families guard the places where this engine's bugs ship
+Thirteen rule families guard the places where this engine's bugs ship
 silently (the reference defends the analogous seams with its
 PlanSanityChecker pipeline, sql/planner/sanity/PlanSanityChecker.java):
 
@@ -9,7 +9,11 @@ PlanSanityChecker pipeline, sql/planner/sanity/PlanSanityChecker.java):
   trace time on a rarely-hit path or silently forces a retrace per call.
 - **lock discipline** (``lint/locks.py``): an attribute written under
   ``with self._lock`` in one method and read bare in another is a latent
-  race that only fires under load.
+  race that only fires under load. The same lockset analysis powers
+  **blocking-under-lock**: no network round-trip, plan compile, or
+  device sync while holding a lock in ``server/``/``parallel/``/
+  ``ft/`` — a multi-second XLA compile inside a coordinator lock
+  serializes the whole serve path.
 - **dispatch exhaustiveness** (``lint/dispatch.py``): a new ``PlanNode``
   subclass that one of the visitors (serde, printer, sanity,
   fingerprint, executor) forgets fails only on the query shape that
@@ -50,6 +54,18 @@ PlanSanityChecker pipeline, sql/planner/sanity/PlanSanityChecker.java):
   justified ``TRACE_KEY_EXEMPT`` entry, and every
   ``TRACE_RELEVANT_PROPERTIES`` entry must be genuinely read — the
   compile-cache soundness contract, machine-checked both ways.
+- **device-sync boundary** (``lint/devicesync.py``): every
+  host-blocking device read reachable from the execute-path roots
+  (``.item()``, ``np.asarray`` of a jit output, ``jax.device_get``,
+  ``block_until_ready``, ``int()`` of a device scalar) must go through
+  the counted ``exec/hostsync`` boundary or carry a justified
+  ``DEVICE_SYNC_EXEMPT`` entry — one stray sync in a stage walk
+  serializes every dispatch behind a ~90ms round-trip.
+- **retrace hazards** (``lint/retrace.py``): data-dependent integers
+  (``bincount().max()``, ``fetch_int`` readbacks) must pass through
+  ``next_pow2``/``bucket_*`` before reaching a shape constructor, a
+  Python branch, or a cache-key component — an unbucketed value
+  compiles one program per dataset and the cache never hits.
 
 Run ``python -m presto_tpu.lint presto_tpu/`` (exits nonzero on
 findings; ``--changed`` scopes reporting to files changed since HEAD
@@ -76,5 +92,7 @@ from presto_tpu.lint import races as _races  # noqa: E402,F401
 from presto_tpu.lint import handoff as _handoff  # noqa: E402,F401
 from presto_tpu.lint import kernels as _kernels  # noqa: E402,F401
 from presto_tpu.lint import tracekey as _tracekey  # noqa: E402,F401
+from presto_tpu.lint import devicesync as _devicesync  # noqa: E402,F401
+from presto_tpu.lint import retrace as _retrace  # noqa: E402,F401
 
 __all__ = ["Finding", "Project", "available_rules", "run_lint"]

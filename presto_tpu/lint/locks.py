@@ -29,7 +29,9 @@ import ast
 import dataclasses
 import re
 
-from presto_tpu.lint.core import (Finding, Project, SourceModule, rule)
+from presto_tpu.lint.core import (Finding, Project, SourceModule,
+                                  qual_name, rule)
+from presto_tpu.lint.tracer import _resolve
 
 LOCK_SCOPES = (
     "presto_tpu/parallel/",
@@ -96,7 +98,6 @@ def _lock_name(node: ast.AST) -> str:
     sharing a final name therefore pool — a false negative, which is
     the safe direction for a rule enforced at zero findings; distinct
     locks in this codebase carry distinct attribute names."""
-    from presto_tpu.lint.core import qual_name
     q = qual_name(node)
     if q is not None:
         return q.rsplit(".", 1)[-1]
@@ -139,6 +140,9 @@ class _CallSite:
     callee: str  # bare method name
     locks: frozenset  # canonical lock names held lexically
     unit: "_Unit"
+    line: int = 0
+    col: int = 0
+    qual: str | None = None  # dotted call path, for alias resolution
 
     @property
     def locked(self) -> bool:
@@ -289,10 +293,12 @@ class _UnitVisitor(ast.NodeVisitor):
                     for sub in ast.walk(node.func.value):
                         self._claimed.add(id(sub))
             self.unit.call_sites.append(_CallSite(
-                node.func.attr, self.locks, self.unit))
+                node.func.attr, self.locks, self.unit,
+                node.lineno, node.col_offset, qual_name(node.func)))
         elif isinstance(node.func, ast.Name):
             self.unit.call_sites.append(_CallSite(
-                node.func.id, self.locks, self.unit))
+                node.func.id, self.locks, self.unit,
+                node.lineno, node.col_offset, node.func.id))
         self.generic_visit(node)
 
     def visit_Attribute(self, node: ast.Attribute) -> None:
@@ -499,4 +505,88 @@ def lock_discipline(project: Project) -> list[Finding]:
                         f"elsewhere (e.g. line {guarded[acc.attr]}); "
                         "either lock this path or document the "
                         "invariant and suppress"))
+    return findings
+
+
+# -- blocking-under-lock -----------------------------------------------------
+
+# scope: the subsystems where a held lock serializes OTHER threads
+# (coordinator/worker RPC, serve-path handlers, failure detection)
+_BLOCKING_SCOPES = (
+    "presto_tpu/server/",
+    "presto_tpu/parallel/",
+    "presto_tpu/ft/",
+)
+
+# call names that block for network/compile/device time: a lock held
+# across one stalls every thread contending for it (an ~90ms device
+# round-trip or a multi-second XLA compile inside a coordinator lock
+# turns the whole serve path lock-step)
+_BLOCKING_NAMES = {
+    "urlopen": "a network round-trip",
+    "_urlopen": "a network round-trip",
+    "prepare_plan": "plan compilation (XLA trace+compile)",
+    "execute_plan": "full plan execution",
+    "execute_plan_distributed": "full distributed execution",
+    "run_plan": "full plan execution",
+    "explain_analyze": "profiled plan execution",
+    "explain_analyze_distributed": "profiled plan execution",
+    "block_until_ready": "a device drain",
+    "device_get": "a device->host transfer",
+}
+
+# resolved-qual prefixes that block: the counted hostsync boundary
+# (fetch/fetch_int/wait all stall on the device). Matched by RESOLVED
+# name so that cv.wait()/event.wait() — correct under a lock — and
+# unrelated fetch() helpers stay clean.
+_BLOCKING_QUAL_PREFIX = "presto_tpu.exec.hostsync."
+
+
+@rule("blocking-under-lock")
+def blocking_under_lock(project: Project) -> list[Finding]:
+    """No network, compile, or device-sync call while holding a lock.
+
+    Reuses the lock-discipline lockset analysis: a call site is "under
+    a lock" when a lock is held lexically (``with self._lock:``) or
+    when the enclosing private helper's inferred entry lockset is
+    non-empty (every observed caller holds the lock). ``re.compile``
+    and condition-variable ``wait`` are excluded by alias resolution.
+    """
+    findings: list[Finding] = []
+    for relpath, (mod, analyses, entry) in sorted(
+            class_analyses(project).items()):
+        if not relpath.startswith(_BLOCKING_SCOPES):
+            continue
+        aliases = mod.aliases
+        for a in analyses:
+            for u in a.units:
+                if u.is_init_body:
+                    continue
+                held_at_entry = u.is_method and bool(
+                    entry.get((u.cls_name, u.name)))
+                for cs in u.call_sites:
+                    if not cs.locks and not held_at_entry:
+                        continue
+                    resolved = None
+                    if cs.qual is not None:
+                        resolved = _resolve(cs.qual, aliases)
+                    what = None
+                    if resolved is not None and resolved.startswith(
+                            _BLOCKING_QUAL_PREFIX):
+                        what = "a device->host sync (hostsync boundary)"
+                    elif cs.callee in _BLOCKING_NAMES:
+                        what = _BLOCKING_NAMES[cs.callee]
+                    if what is None:
+                        continue
+                    lock = (sorted(cs.locks)[0] if cs.locks
+                            else sorted(entry[(u.cls_name,
+                                               u.name)])[0])
+                    findings.append(Finding(
+                        "blocking-under-lock", relpath, cs.line,
+                        cs.col,
+                        f"`{u.cls_name}.{u.name}` calls "
+                        f"`{cs.callee}` — {what} — while holding "
+                        f"`{lock}`: every thread contending for the "
+                        "lock stalls behind it; snapshot state under "
+                        "the lock, release it, then block"))
     return findings

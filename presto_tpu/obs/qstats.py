@@ -424,8 +424,6 @@ def record_program(engine, plan, meta: dict, counts,
 def _record_program(engine, rec: TaskRecorder, plan, meta, counts,
                     compile_s, execute_s, cache_hit, template,
                     template_hit) -> None:
-    import numpy as np
-
     from presto_tpu.exec.executor import preorder_index
     from presto_tpu.memory import _row_bytes
 
@@ -449,7 +447,10 @@ def _record_program(engine, rec: TaskRecorder, plan, meta, counts,
 
     actual: dict[object, int] = {}
     if counts is not None:
-        counts_np = np.asarray(counts)
+        # device counts (prepare_plan passes the stacked per-node
+        # array) cross the boundary here; host counts pass through
+        from presto_tpu.exec import hostsync as _HS
+        counts_np = _HS.fetch(counts, site="qstats-counts")
         for key, c in zip(meta.get("count_nodes") or [], counts_np):
             pos = key[0] if isinstance(key, tuple) else key
             actual[pos] = int(c)

@@ -49,6 +49,7 @@ _SHARD_MAP_NOCHECK = (
 from presto_tpu import types as T
 from presto_tpu.block import Column, Table
 from presto_tpu.cost.model import decide_join_distribution
+from presto_tpu.exec import hostsync as HS
 from presto_tpu.exec import operators as OP
 from presto_tpu.exec.executor import (PlanInterpreter, ScanInput,
                                       collect_scans, preorder_index)
@@ -936,7 +937,11 @@ def _shard_scan_arrays(scan: ScanInput, nshards: int,
         # dead padding rows go to bucket 0 as dead rows
         bucket = np.where(base_live, bucket, 0)
     counts = np.bincount(bucket, minlength=nshards)
-    per = max(int(counts.max()), 1)
+    # pow2-bucket the per-shard width (lint/retrace.py): the raw
+    # bincount max is a data-dependent int that flows into every
+    # sharded input shape, so two datasets with different skew would
+    # retrace the same plan; the live mask keeps padding rows inert
+    per = next_pow2(max(int(counts.max()), 1))
     order = np.argsort(bucket, kind="stable")
     starts = np.zeros(nshards, dtype=np.int64)
     starts[1:] = np.cumsum(counts)[:-1]
@@ -1086,8 +1091,12 @@ def execute_plan_distributed(engine, plan: N.PlanNode,
                                      interp.row_counts])
                           if interp.row_counts
                           else jnp.zeros((0,), dtype=jnp.int32))
-                return (tuple(res), out.live_mask(),
-                        tuple(interp.ok_flags), counts)
+                # ok flags stacked like the local make_traced: a tuple
+                # of device scalars costs one host round-trip EACH on
+                # the overflow ladder, a (k,) bool array costs one
+                oks = (jnp.stack(interp.ok_flags) if interp.ok_flags
+                       else jnp.zeros((0,), dtype=bool))
+                return tuple(res), out.live_mask(), oks, counts
 
             sharded = _shard_map(
                 traced_fn, mesh=mesh,
@@ -1111,9 +1120,12 @@ def execute_plan_distributed(engine, plan: N.PlanNode,
             with mesh:
                 res, live, oks, node_counts = compiled(
                     *flat_arrays, *pargs)
-            jax.block_until_ready(live)
+            HS.wait(live, site="dist-execute")
         run_s = _time.perf_counter() - t0
-        if all(bool(np.asarray(o)) for o in oks):
+        # ONE host sync for every flag (the stacked (k,) array), not
+        # one ~90ms round-trip per overflow flag
+        oks_np = HS.fetch(oks, site="dist-ok-ladder")
+        if oks_np.all():
             if use_cache:
                 if lowered is not None:
                     # as_text materializes the whole module — pay it
@@ -1128,7 +1140,7 @@ def execute_plan_distributed(engine, plan: N.PlanNode,
                 engine._caps_memory[base_key] = dict(capacities)
             break
         from presto_tpu.ops.hash import grow_overflowed
-        grow_overflowed(capacities, meta["ok_keys"], oks,
+        grow_overflowed(capacities, meta["ok_keys"], oks_np,
                         meta["used_capacity"])
     else:
         from presto_tpu.ops.hash import HashChainOverflow
@@ -1143,25 +1155,28 @@ def execute_plan_distributed(engine, plan: N.PlanNode,
     # fold into the ambient stats tree (obs/qstats.py): the distributed
     # path reports per-node mesh-global actuals on cache/template hits
     # exactly like cold compiles
+    # ONE batched device->host transfer for the result demux, the
+    # per-node actuals, and the live mask: per-array np.asarray pays a
+    # tunnel round-trip each
+    live_np, res_np, counts_np = HS.fetch(
+        (live, list(res), node_counts), site="dist-demux")
     from presto_tpu.obs import qstats as QS
-    QS.record_program(engine, orig_plan, meta, node_counts, compile_s,
+    QS.record_program(engine, orig_plan, meta, counts_np, compile_s,
                       run_s, cache_hit=cache_hit,
                       template=tpl is not None,
                       template_hit=tpl is not None and cache_hit)
     if profile is not None:
-        counts_np = np.asarray(node_counts)
         profile["compile_s"] = compile_s
         profile["run_s"] = run_s
         profile["node_rows"] = {
             pos: (int(c), dist)
             for (pos, dist), c in zip(meta["count_nodes"], counts_np)}
 
-    live_np = np.asarray(live)
     cols: dict[str, Column] = {}
     i = 0
     for sym, dtype, dictionary, has_valid in meta["out"]:
-        data = np.asarray(res[i])
-        valid = np.asarray(res[i + 1])
+        data = res_np[i]
+        valid = res_np[i + 1]
         i += 2
         cols[sym] = Column(dtype, data,
                            valid if has_valid or not valid.all() else None,

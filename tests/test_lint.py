@@ -1044,7 +1044,7 @@ def test_full_suite_wall_time_budget():
     tracekey provenance pass included, riding the tracer family's
     cached call-graph machinery and per-module unit walks: the
     whole-package run must stay inside an interactive budget (locally
-    ~3 s with all eleven families; the bound leaves headroom for a
+    ~3-4 s with all thirteen families; the bound leaves headroom for a
     loaded CI container but catches the per-rule re-walk regression
     class, which tripled it)."""
     import time
@@ -1451,6 +1451,291 @@ def test_sarif_changed_mode_fast_exit_is_valid_sarif(tmp_path, capsys):
         log["runs"][0]["results"] == []
     assert lint_main([str(pkg), "--json", "--sarif"]) == 2
     assert "mutually exclusive" in capsys.readouterr().err
+
+
+# -- device-sync boundary (devicesync) ---------------------------------------
+
+DEVICESYNC_FIXTURE = """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    def prepare_plan(engine, plan):
+        res, oks = _run(plan)
+        for o in oks:
+            if bool(np.asarray(o)):
+                pass
+        n = int(jnp.sum(res))
+        jax.block_until_ready(res)
+        return res, n
+
+    def _run(plan):
+        fn = jax.jit(lambda x: x)
+        out = fn(plan)
+        return out, [out]
+
+    def host_helper(plan):
+        # identical sins, NOT reachable from an execute-path root:
+        # must stay silent
+        out, oks = _run(plan)
+        jax.block_until_ready(out)
+        return int(jnp.sum(out))
+"""
+
+
+def test_devicesync_flags_syncs_on_execute_path_only(tmp_path):
+    """The three hidden-sync shapes — implicit ``__array__`` via
+    ``np.asarray`` of a device value, ``int()`` concretization, and
+    ``block_until_ready`` — fire in root-reachable code (provenance
+    follows the jit-wrapped callable through the helper's return and
+    tuple unpacking) and stay silent in unreachable code."""
+    pkg = write_pkg(tmp_path, {
+        "presto_tpu/exec/executor.py": DEVICESYNC_FIXTURE})
+    findings = run_lint([pkg], rules=["device-sync"])
+    assert len(findings) == 3, [f.format() for f in findings]
+    msgs = " | ".join(f.message for f in findings)
+    assert "np.asarray" in msgs
+    assert "`int()` of a device value" in msgs
+    assert "block_until_ready" in msgs
+    assert all("prepare_plan" in f.message for f in findings)
+
+
+def test_devicesync_metadata_and_boundary_are_clean(tmp_path):
+    """Attribute reads (shape math) kill taint, and fetches routed
+    through the exec/hostsync boundary are the sanctioned path — both
+    lint clean, including inside the boundary module itself."""
+    pkg = write_pkg(tmp_path, {
+        "presto_tpu/exec/hostsync.py": """
+            import jax
+
+            DEVICE_SYNC_EXEMPT = {}
+
+            def fetch(tree, site):
+                return jax.device_get(tree)
+        """,
+        "presto_tpu/exec/executor.py": """
+            import jax
+            import jax.numpy as jnp
+            import numpy as np
+
+            from presto_tpu.exec import hostsync as HS
+
+            def prepare_plan(engine, plan):
+                out = jax.jit(lambda x: x)(plan)
+                rows = out.shape[0] * out.nbytes  # metadata: host-side
+                host = HS.fetch(out, site="demux")
+                return np.asarray(host), rows
+        """})
+    assert run_lint([pkg], rules=["device-sync"]) == [], \
+        [f.format() for f in run_lint([pkg], rules=["device-sync"])]
+
+
+def test_devicesync_suppression_and_exemption_staleness(tmp_path):
+    """An in-source waiver works through the central runner; a
+    DEVICE_SYNC_EXEMPT entry excuses its finding, and one that stops
+    matching becomes a stale-exemption finding itself."""
+    files = {
+        "presto_tpu/exec/hostsync.py": """
+            DEVICE_SYNC_EXEMPT = {
+                "presto_tpu/exec/executor.py:prepare_plan:"
+                "block_until_ready":
+                    "measurement IS the sync: profiling readback",
+            }
+        """,
+        "presto_tpu/exec/executor.py": """
+            import jax
+            import jax.numpy as jnp
+
+            def prepare_plan(engine, plan):
+                out = jax.jit(lambda x: x)(plan)
+                jax.block_until_ready(out)
+                n = int(jnp.sum(out))  # lint: disable=device-sync
+                return n
+        """}
+    pkg = write_pkg(tmp_path, files)
+    assert run_lint([pkg], rules=["device-sync"]) == [], \
+        [f.format() for f in run_lint([pkg], rules=["device-sync"])]
+    stale = dict(files)
+    stale["presto_tpu/exec/executor.py"] = """
+        def prepare_plan(engine, plan):
+            return plan
+    """
+    pkg2 = write_pkg(tmp_path / "stale", stale)
+    findings = run_lint([pkg2], rules=["device-sync"])
+    assert len(findings) == 1, [f.format() for f in findings]
+    assert "stale-exemption" in findings[0].message
+
+
+# -- retrace hazards (retrace) -----------------------------------------------
+
+RETRACE_FIXTURE = """
+    import jax.numpy as jnp
+    import numpy as np
+
+    from presto_tpu.ops.hash import next_pow2
+
+    def run(counts):
+        width = int(counts.max())
+        buf = jnp.zeros(width)
+        if width > 4:
+            pass
+        cache_key = ("q", width)
+        ok = jnp.zeros(next_pow2(width))  # bucketed: clean
+        return buf, ok, cache_key
+"""
+
+
+def test_retrace_shape_branch_and_key_sinks(tmp_path):
+    """A raw ``.max()`` reduction reaching a shape constructor, a
+    Python branch, and a cache-key tuple fires once per sink kind —
+    and the same value routed through ``next_pow2`` is clean."""
+    pkg = write_pkg(tmp_path, {
+        "presto_tpu/exec/broken.py": RETRACE_FIXTURE})
+    findings = run_lint([pkg], rules=["retrace"])
+    assert len(findings) == 3, [f.format() for f in findings]
+    msgs = " | ".join(f.message for f in findings)
+    assert "zeros` shape" in msgs
+    assert "Python branch" in msgs
+    assert "cache-key" in msgs
+
+
+def test_retrace_interprocedural_and_shape_derived_clean(tmp_path):
+    """Taint crosses helper parameters (the tracekey least-fixpoint);
+    sizes derived from ``len()``/``.shape`` are cache-stable by
+    construction (input shapes already ride the program-cache key) and
+    must stay silent."""
+    pkg = write_pkg(tmp_path, {"presto_tpu/exec/broken.py": """
+        import jax.numpy as jnp
+        import numpy as np
+
+        def driver(counts):
+            return _alloc(int(counts.max()))
+
+        def _alloc(n):
+            return jnp.zeros(n)
+
+        def clean(x):
+            n = len(x)
+            m = x.shape[0]
+            return jnp.zeros((n, m))
+    """})
+    findings = run_lint([pkg], rules=["retrace"])
+    assert len(findings) == 1, [f.format() for f in findings]
+    assert "_alloc" in findings[0].message
+    assert "zeros` shape" in findings[0].message
+
+
+def test_retrace_exemption_and_staleness(tmp_path):
+    """RETRACE_EXEMPT excuses a justified hazard; an entry that stops
+    matching becomes a finding (same registry discipline as tracekey/
+    devicesync)."""
+    files = {
+        "presto_tpu/exec/broken.py": """
+            import numpy as np
+
+            def pick(counts):
+                w = int(counts.max())
+                if w > 128:
+                    return 256
+                return 128
+        """,
+        "presto_tpu/exec/progcache.py": """
+            RETRACE_EXEMPT = {
+                "presto_tpu/exec/broken.py:pick:branch":
+                    "both arms yield fixed bucket widths",
+            }
+        """}
+    pkg = write_pkg(tmp_path, files)
+    assert run_lint([pkg], rules=["retrace"]) == [], \
+        [f.format() for f in run_lint([pkg], rules=["retrace"])]
+    stale = dict(files)
+    stale["presto_tpu/exec/broken.py"] = "x = 1\n"
+    pkg2 = write_pkg(tmp_path / "stale", stale)
+    findings = run_lint([pkg2], rules=["retrace"])
+    assert len(findings) == 1, [f.format() for f in findings]
+    assert "stale-exemption" in findings[0].message
+
+
+# -- blocking-under-lock -----------------------------------------------------
+
+
+def test_blocking_under_lock_lexical_and_entry_lockset(tmp_path):
+    """A network round-trip lexically under ``with self._lock`` fires;
+    the same call after snapshot-and-release is clean; a private
+    helper whose every caller holds the lock inherits the lockset and
+    its device drain fires too. Condition-variable ``wait`` — correct
+    under a lock by design — stays silent."""
+    pkg = write_pkg(tmp_path, {"presto_tpu/parallel/broken.py": """
+        import threading
+        import urllib.request
+
+        import jax
+
+        class Coordinator:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._cv = threading.Condition()
+                self._peers = []
+
+            def poll(self, req):
+                with self._lock:
+                    return urllib.request.urlopen(req, timeout=1)
+
+            def snapshot_then_poll(self, req):
+                with self._lock:
+                    peers = list(self._peers)
+                return urllib.request.urlopen(req, timeout=1)
+
+            def park(self):
+                with self._cv:
+                    self._cv.wait()
+
+            def entry_a(self):
+                with self._lock:
+                    self._drain()
+
+            def entry_b(self):
+                with self._lock:
+                    self._drain()
+
+            def _drain(self):
+                jax.block_until_ready(self._peers)
+    """})
+    findings = run_lint([pkg], rules=["blocking-under-lock"])
+    assert len(findings) == 2, [f.format() for f in findings]
+    msgs = " | ".join(f.message for f in findings)
+    assert "urlopen" in msgs and "poll" in msgs
+    assert "block_until_ready" in msgs and "_drain" in msgs
+    assert "snapshot_then_poll" not in msgs
+    assert "park" not in msgs
+
+
+def test_blocking_under_lock_hostsync_by_resolution(tmp_path):
+    """The counted hostsync boundary calls are matched by RESOLVED
+    module path — an unrelated ``fetch`` method on another object
+    under the same lock must not pool with them."""
+    pkg = write_pkg(tmp_path, {"presto_tpu/server/broken.py": """
+        import threading
+
+        from presto_tpu.exec import hostsync as HS
+
+        class Results:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._queue = None
+
+            def page(self, arrays):
+                with self._lock:
+                    return HS.fetch(arrays, site="serve-page")
+
+            def other(self):
+                with self._lock:
+                    return self._queue.fetch()
+    """})
+    findings = run_lint([pkg], rules=["blocking-under-lock"])
+    assert len(findings) == 1, [f.format() for f in findings]
+    assert "hostsync" in findings[0].message
+    assert "page" in findings[0].message
 
 
 def test_kernel_parity_dangling_reference_and_exemption(tmp_path):
