@@ -70,6 +70,15 @@ round-trip MB/s per codec (parallel/wire.py). Knobs:
 PRESTO_TPU_BENCH_SERVE_CLIENTS (4), PRESTO_TPU_BENCH_SERVE_S (20),
 PRESTO_TPU_BENCH_SERVE_SF (0.01).
 
+Each measured query also reports its compile-time device-cost totals
+(``qNN_flops``/``qNN_hbm_bytes``/``qNN_roofline`` — obs/devprof
+harvest of XLA cost_analysis, attributed over the plan and summed), so
+a wall regression is attributable: costs moved = the plan changed,
+costs flat = runtime/scheduling. ``bench.py --compare OLD.json
+NEW.json [threshold]`` diffs two BENCH files and prints per-key
+regressions beyond the threshold (default 10%), exiting nonzero for
+CI gating.
+
 ``PRESTO_TPU_BENCH_SKEW=zipf:<s>`` additionally measures q05/q09
 against a Zipf(s)-skewed datagen variant (lineitem part/supplier FKs
 and orders custkeys follow bounded Zipf over the key space),
@@ -146,6 +155,7 @@ for _ in range(reps):
     times.append(time.perf_counter() - t0)
 top_ops = None
 device_syncs = None
+cost_totals = None
 if reps:
     # ONE extra steady run under a qstats scope, OUTSIDE the timed
     # samples, so the child can report the top operators by
@@ -169,6 +179,21 @@ if reps:
                 "wall_ms": o.get("wallMillis"),
                 "kernel": o.get("kernel") or ""}
                for o in ops[:3]]
+    # device-cost totals from the new operator attribution
+    # (obs/devprof.py): query flops, bytes moved, and the roofline
+    # ratio of the whole query's arithmetic intensity against the
+    # configured device peaks
+    qflops = sum(int(o.get("flops") or 0) for o in ops)
+    qbytes = sum(int(o.get("hbmBytes") or 0) for o in ops)
+    if qflops:
+        from presto_tpu.obs import devprof
+        pf, pb = devprof.device_peaks()
+        cost_totals = {
+            "flops": qflops, "hbm_bytes": qbytes,
+            "roofline": round((qflops / max(1, qbytes)) / (pf / pb),
+                              4)}
+    else:
+        cost_totals = None
 _cap_total = int(REGISTRY.counter(
     "presto_tpu_capacity_overflow_retries_total").total())
 out = {
@@ -191,6 +216,8 @@ if top_ops is not None:
     out["top_operators"] = top_ops
 if device_syncs is not None:
     out["device_syncs"] = device_syncs
+if cost_totals is not None:
+    out.update(cost_totals)
 variant = sys.argv[4] if len(sys.argv) > 4 else ""
 if variant:
     # literal-variant warm measurement (plan templates): the same
@@ -730,7 +757,105 @@ def numpy_q5(li, orders, cust, supp, asia_nations) -> float:
     return time.perf_counter() - t0
 
 
+# -- BENCH-file regression compare (bench.py --compare) ----------------------
+
+# direction by key suffix/substring: throughput-like keys regress when
+# they FALL, cost/latency-like keys regress when they RISE. Keys that
+# match neither pattern (backends, paths, ratios like vs_baseline) are
+# informational and never gate.
+_HIGHER_BETTER = ("rows_per_sec", "mb_per_sec", "_qps", "qps",
+                  "template_hits")
+_LOWER_BETTER = ("_s", "_flops", "_hbm_bytes", "_compiles",
+                 "_programs_compiled", "_device_syncs", "_page_bytes",
+                 "_retries", "_errors", "_misses")
+
+
+def _compare_direction(key: str) -> int:
+    """+1 higher-is-better, -1 lower-is-better, 0 ungated."""
+    for pat in _HIGHER_BETTER:
+        if key.endswith(pat) or pat in key:
+            return 1
+    for pat in _LOWER_BETTER:
+        if key.endswith(pat):
+            return -1
+    return 0
+
+
+def _bench_detail(path: str) -> dict:
+    """Load a BENCH_rXX.json file: either the bare final JSON object
+    or JSON-lines output (last object with a detail wins)."""
+    detail: dict = {}
+    with open(path) as f:
+        text = f.read()
+    try:
+        obj = json.loads(text)
+        objs = obj if isinstance(obj, list) else [obj]
+    except ValueError:
+        objs = []
+        for line in text.splitlines():
+            line = line.strip()
+            if line.startswith("{"):
+                try:
+                    objs.append(json.loads(line))
+                except ValueError:
+                    continue
+    for obj in objs:
+        if isinstance(obj, dict) and isinstance(obj.get("detail"), dict):
+            detail = obj["detail"]
+            if "metric" in obj and isinstance(
+                    obj.get("value"), (int, float)):
+                detail = {**detail, obj["metric"]: obj["value"]}
+    return detail
+
+
+def run_compare(baseline_path: str, current_path: str,
+                threshold: float) -> int:
+    """Print per-key regressions beyond ``threshold`` (fractional
+    change in the bad direction); return the regression count so the
+    CI caller can gate on a nonzero exit."""
+    base = _bench_detail(baseline_path)
+    cur = _bench_detail(current_path)
+    regressions = 0
+    for key in sorted(set(base) & set(cur)):
+        b, c = base[key], cur[key]
+        if not isinstance(b, (int, float)) \
+                or not isinstance(c, (int, float)) \
+                or isinstance(b, bool) or isinstance(c, bool):
+            continue
+        direction = _compare_direction(key)
+        if direction == 0 or b == 0:
+            continue
+        change = (c - b) / abs(b)
+        bad = -change if direction > 0 else change
+        if bad > threshold:
+            regressions += 1
+            print(f"REGRESSION {key}: {b:g} -> {c:g} "
+                  f"({change * 100:+.1f}%, "
+                  f"{'higher' if direction > 0 else 'lower'}-is-better,"
+                  f" threshold {threshold * 100:.0f}%)")
+    missing = sorted(k for k in base if k not in cur
+                     and _compare_direction(k) != 0
+                     and isinstance(base[k], (int, float)))
+    for key in missing:
+        print(f"MISSING {key}: present in baseline, absent in current")
+    print(f"compared {baseline_path} -> {current_path}: "
+          f"{regressions} regression(s), {len(missing)} missing key(s)")
+    return regressions
+
+
 def main() -> None:
+    if "--compare" in sys.argv[1:]:
+        # bench.py --compare BASELINE.json CURRENT.json [threshold]
+        # CI gate: nonzero exit when any gated key moved in the bad
+        # direction beyond the threshold (default 10%)
+        i = sys.argv.index("--compare")
+        rest = sys.argv[i + 1:]
+        if len(rest) < 2:
+            print("usage: bench.py --compare BASELINE.json "
+                  "CURRENT.json [threshold]", file=sys.stderr)
+            sys.exit(2)
+        thr = float(rest[2]) if len(rest) > 2 else 0.10
+        sys.exit(1 if run_compare(rest[0], rest[1], thr) else 0)
     if "--serve" in sys.argv[1:]:
         out = run_serve_bench()
         print(json.dumps({
@@ -808,6 +933,10 @@ def main() -> None:
     detail["q01_execute_s"] = round(q1_steady, 2)
     detail["q01_programs_compiled"] = r.get("programs_compiled")
     detail["q01_device_syncs"] = r.get("device_syncs")
+    if r.get("flops"):
+        detail["q01_flops"] = r["flops"]
+        detail["q01_hbm_bytes"] = r.get("hbm_bytes")
+        detail["q01_roofline"] = r.get("roofline")
     rows_per_sec = nrows / q1_steady
 
     # single-thread NumPy Q1 baseline (config-1 stand-in)
@@ -903,6 +1032,14 @@ def main() -> None:
         detail[f"{name}_kernel_backend"] = r.get("kernel_backend")
         if r.get("top_operators"):
             detail[f"{name}_top_operators"] = r["top_operators"]
+        # compile-time XLA cost totals (obs/devprof harvest summed over
+        # the query's operator attribution) + query-level roofline
+        # ratio: a perf regression that does not move these is a
+        # runtime/scheduling regression, one that does is a plan change
+        if r.get("flops"):
+            detail[f"{name}_flops"] = r["flops"]
+            detail[f"{name}_hbm_bytes"] = r.get("hbm_bytes")
+            detail[f"{name}_roofline"] = r.get("roofline")
         if "variant_s" in r:
             # literal-variant warm rerun inside the cold child: with
             # plan templates on, variant_compiles MUST be 0 — the

@@ -61,7 +61,28 @@ class _RemoteBackend:
         self.client = Client(url, user)
 
     def execute(self, sql: str):
-        columns, rows = self.client.execute(sql)
+        # live progress on the poll loop (the coordinator's monotonic
+        # qstats stage-walk estimate), drawn on stderr and erased when
+        # the result lands so piped stdout stays clean
+        shown = [False]
+
+        def on_progress(p: float) -> None:
+            if not sys.stderr.isatty():
+                return
+            filled = int(round(20 * p))
+            sys.stderr.write(
+                f"\r[{'#' * filled}{'.' * (20 - filled)}] "
+                f"{p * 100:3.0f}%")
+            sys.stderr.flush()
+            shown[0] = True
+
+        try:
+            columns, rows = self.client.execute(
+                sql, on_progress=on_progress)
+        finally:
+            if shown[0]:
+                sys.stderr.write("\r" + " " * 28 + "\r")
+                sys.stderr.flush()
         return [c["name"] for c in columns], rows
 
 

@@ -44,6 +44,9 @@ class Client:
         self.password = password
         self.result_format = result_format
         self.warnings: list = []
+        # monotonic 0..1 progress of the last execute() (the protocol
+        # stats blob's qstats stage-walk estimate)
+        self.last_progress: float = 0.0
         # session properties accumulated from SET SESSION statements,
         # replayed on every request via X-Trino-Session (the reference
         # client's session accumulation, StatementClientV1)
@@ -115,17 +118,28 @@ class Client:
             out["nextUri"] = re.sub(r"/\d+$", f"/{nxt}", url)
         return out
 
-    def execute(self, sql: str, poll_interval: float = 0.02):
+    def execute(self, sql: str, poll_interval: float = 0.02,
+                on_progress=None):
         """Run SQL; returns (columns, rows). Blocks until the result
         stream drains. Server-side diagnostics accumulate in
         ``self.warnings`` (reference StatementClientV1
-        currentStatusInfo().getWarnings)."""
+        currentStatusInfo().getWarnings). ``on_progress`` (when given)
+        is called with the protocol stats blob's monotonic 0..1
+        ``progress`` estimate whenever it advances; the latest value
+        is also kept on ``self.last_progress``."""
         out = self._request("POST", f"{self.base_url}/v1/statement",
                             sql.encode())
         columns = None
         rows: list[list] = []
         self.warnings = []
+        self.last_progress = 0.0
         while True:
+            progress = out.get("stats", {}).get("progress")
+            if progress is not None \
+                    and progress > self.last_progress:
+                self.last_progress = float(progress)
+                if on_progress is not None:
+                    on_progress(self.last_progress)
             if "error" in out and out["error"]:
                 raise QueryFailed(out["error"].get("message", "failed"),
                                   out["error"].get("errorName"))

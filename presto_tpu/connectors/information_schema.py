@@ -149,9 +149,15 @@ class SystemConnector(_ReflectiveConnector):
             "output_bytes": T.BIGINT, "est_rows": T.BIGINT,
             # per-operator kernel attribution (presto_tpu/kernels/):
             # which backend:kernel pairs the operator dispatched, and
-            # its rows-weighted share of the program's execute wall —
+            # its cost-weighted share of the program's execute wall —
             # "which operator dominates" is answerable from SQL
             "kernel": T.VARCHAR, "wall_ms": T.BIGINT,
+            # device-cost attribution (obs/devprof.py): the program's
+            # XLA cost_analysis/memory_analysis split across its plan
+            # nodes, plus arithmetic intensity (flops/byte) and the
+            # roofline ratio against PRESTO_TPU_DEVICE_PEAK_FLOPS/_BW
+            "flops": T.BIGINT, "hbm_bytes": T.BIGINT,
+            "intensity": T.DOUBLE, "roofline": T.DOUBLE,
         },
         "plan_divergence": {
             "query_id": T.VARCHAR, "stage": T.VARCHAR,
@@ -287,6 +293,9 @@ class SystemConnector(_ReflectiveConnector):
              op["nodeType"], op["label"], int(op["inputRows"]),
              int(op["outputRows"]), int(op["outputBytes"]),
              int(op["estRows"]), str(op.get("kernel") or ""),
-             int(op.get("wallMillis") or 0))
+             int(op.get("wallMillis") or 0),
+             int(op.get("flops") or 0), int(op.get("hbmBytes") or 0),
+             float(op.get("intensity") or 0.0),
+             float(op.get("roofline") or 0.0))
             for qid, stage, t in self._stage_tasks()
             for op in t["operators"]]

@@ -113,16 +113,27 @@ def monitored(engine, sql: str, run: Callable):
     the stats id and the trace id coincide). The completed event fires
     INSIDE the stats scope: history listeners snapshot the finished
     tree off the ambient recorder. Returns run()'s result."""
+    from presto_tpu.obs import devprof
     from presto_tpu.obs import qstats as QS
 
     mgr: EventListenerManager = engine.events
     qid = mgr.next_query_id()
     t0 = time.time()
+    want_profile = False
+    try:
+        want_profile = bool(engine.session.get("device_profile"))
+    except Exception:  # noqa: BLE001 - sessions without the property
+        pass
     mgr.query_created(QueryCreatedEvent(qid, sql, engine.session.user, t0))
     with QS.query_or_current(qid, sql, engine.session.user) as qr, \
             TRACER.root_or_span(qid, "query", query_id=qid,
                                 user=engine.session.user,
-                                sql=sql[:200]) as sp:
+                                sql=sql[:200]) as sp, \
+            devprof.maybe_capture(want_profile, qid) as prof_dir:
+        if prof_dir is not None:
+            # known up front: history/UI snapshots taken mid-query
+            # already link the artifact directory
+            qr.profile_artifact = prof_dir
         try:
             result = run()
         except Exception as exc:

@@ -690,6 +690,13 @@ def prepare_plan(engine, plan: N.PlanNode, scan_inputs: list[ScanInput]):
             last_compile_s = compile_s
             _COMPILES.inc()
             _COMPILE_SECONDS.observe(compile_s)
+            # device-cost summary rides the meta into the program
+            # cache (and its disk tier): warm hits in a fresh process
+            # still attribute flops/bytes without a live Compiled
+            from presto_tpu.obs import devprof
+            cost = devprof.harvest(compiled)
+            if cost is not None:
+                meta["cost"] = cost
             if os.environ.get("PRESTO_TPU_LOG_COMPILES"):
                 print(f"[compile] {compile_s:.1f}s "
                       f"caps={dict(capacities)} "

@@ -71,4 +71,15 @@ DEVICE_SYNC_EXEMPT = {
         "profile's run_s includes the same readback the production "
         "ladder pays, without counting profiling syncs as hot-path "
         "syncs",
+    "presto_tpu/obs/devprof.py:harvest:float":
+        "compile-time cost harvest: the floats come from "
+        "compiled.cost_analysis()'s host-side dict (XLA's static "
+        "analysis), never from a device array — no transfer happens",
+    "presto_tpu/obs/devprof.py:program_bytes:float":
+        "arithmetic over the plain-dict cost summary harvest() "
+        "produced (host floats persisted in progcache meta); no "
+        "device value can reach here",
+    "presto_tpu/obs/devprof.py:attribute:float":
+        "attribution math over the harvested host-side cost summary "
+        "and Python int row counts; no device value can reach here",
 }

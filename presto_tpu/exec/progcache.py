@@ -245,8 +245,10 @@ def scan_dictionary_key(scan_inputs) -> tuple:
 # output contract (e.g. before the always-on per-node row counts
 # became a fourth program output, or before the distributed path
 # stacked its ok flags into one (k,) array) miss instead of
-# mis-unpacking
-PROGRAM_FORMAT = "oks1"
+# mis-unpacking. "cost1": meta carries the compile-time device-cost
+# summary (obs/devprof.harvest) — pre-cost entries would report zero
+# flops forever on warm hits, so they miss and recompile once
+PROGRAM_FORMAT = "cost1"
 
 
 @functools.lru_cache(maxsize=32)

@@ -12,6 +12,11 @@ flags:
 - the same metric name registered under two different kinds anywhere
   in the project (the registry raises on whichever loads second —
   which module wins then depends on import order);
+- a registration with missing or empty HELP text — ``/metrics`` only
+  renders ``# HELP`` when the text is non-empty, and an undocumented
+  metric is unusable the moment its author context is gone (Prometheus
+  exposition best practice); help passed as a non-literal expression
+  is left to the author;
 - a negative literal passed to ``.inc(...)`` — counters are monotonic
   by contract; gauges have ``.dec()``.
 
@@ -54,6 +59,20 @@ def metric_name(project: Project) -> list[Finding]:
                     findings.append(Finding(
                         "metric-name", mod.relpath, node.lineno,
                         node.col_offset, err))
+                help_node = node.args[1] if len(node.args) > 1 else None
+                for kw in node.keywords:
+                    if kw.arg == "help_text":
+                        help_node = kw.value
+                if help_node is None or (
+                        isinstance(help_node, ast.Constant)
+                        and isinstance(help_node.value, str)
+                        and not help_node.value.strip()):
+                    findings.append(Finding(
+                        "metric-name", mod.relpath, node.lineno,
+                        node.col_offset,
+                        f"metric {name!r} registered without HELP "
+                        "text; /metrics only renders # HELP when "
+                        "non-empty — pass a description"))
                 prev = seen.get(name)
                 if prev is None:
                     seen[name] = (attr, f"{mod.relpath}:{node.lineno}")

@@ -266,10 +266,66 @@ class QueryRecorder:
         self.local = TaskRecorder(f"{query_id}.coordinator.0",
                                   "coordinator", "coordinator")
         self.remote_stages: list[dict] = []
+        # live-progress state (coordinator stage walks feed it): the
+        # current stage-weight plan plus dispatch/complete marks. The
+        # floor makes the estimate monotonic across adaptive replans —
+        # a re-weight may shrink the instantaneous fraction, but the
+        # reported value never goes backwards.
+        self._stage_weights: dict[str, float] = {}
+        self._stages_dispatched: set[str] = set()
+        self._stages_done: set[str] = set()
+        self._progress_floor = 0.0
+        # device-profile artifact directory (obs/devprof.maybe_capture)
+        self.profile_artifact: str | None = None
 
     def add_stages(self, stages: list[dict]) -> None:
         with self._lock:
             self.remote_stages.extend(stages)
+
+    # -- live progress (tentpole 3) --------------------------------------
+
+    def progress_plan(self, weights: dict[str, float]) -> None:
+        """Install (or, on an adaptive replan, replace) the stage
+        weight table — est-rows per stage name. Completed/dispatched
+        marks for stages that survive the replan keep counting; the
+        monotonic floor absorbs any shrink from re-weighting."""
+        with self._lock:
+            self._stage_weights = {
+                str(k): max(1.0, float(v)) for k, v in weights.items()}
+
+    def note_stage_dispatched(self, name: str) -> None:
+        with self._lock:
+            self._stages_dispatched.add(str(name))
+
+    def note_stage_completed(self, name: str) -> None:
+        with self._lock:
+            self._stages_dispatched.add(str(name))
+            self._stages_done.add(str(name))
+
+    def _progress_locked(self) -> float:
+        if self.t1 is not None and self.state == "FINISHED":
+            return 1.0
+        names = (set(self._stage_weights)
+                 | self._stages_dispatched | self._stages_done)
+        p = 0.0
+        total = sum(self._stage_weights.get(n, 1.0) for n in names)
+        if total > 0:
+            done = sum(self._stage_weights.get(n, 1.0)
+                       for n in self._stages_done)
+            # a dispatched-but-unfinished stage counts half its weight
+            inflight = sum(self._stage_weights.get(n, 1.0)
+                           for n in self._stages_dispatched
+                           - self._stages_done)
+            p = (done + 0.5 * inflight) / total
+        # never report 1.0 while the query is still running
+        p = max(self._progress_floor, min(p, 0.99))
+        self._progress_floor = p
+        return p
+
+    def progress(self) -> float:
+        """Monotonic 0..1 completion estimate (1.0 only on FINISHED)."""
+        with self._lock:
+            return round(self._progress_locked(), 4)
 
     def note_task_retry(self) -> None:
         with self._lock:
@@ -305,6 +361,8 @@ class QueryRecorder:
                 "outputRows": self.output_rows,
                 "taskRetries": self.task_retries,
                 "queryRetries": self.query_retries,
+                "progress": round(self._progress_locked(), 4),
+                "profile": self.profile_artifact,
                 "stages": stages,
             }
 
@@ -467,6 +525,7 @@ def _record_program(engine, rec: TaskRecorder, plan, meta, counts,
     kernels_by_pos = meta.get("kernels") or {}
     ops: list[dict] = []
     weights: list[int] = []
+    node_shapes: list[tuple[str, int, int, int]] = []
     for pos, node in by_pos.items():
         rows = actual.get(pos)
         if rows is None:
@@ -491,19 +550,32 @@ def _record_program(engine, rec: TaskRecorder, plan, meta, counts,
         })
         weights.append((0 if in_rows is None else int(in_rows))
                        + int(rows) + 1)
+        node_shapes.append((ntype,
+                            0 if in_rows is None else int(in_rows),
+                            int(rows), int(nbytes)))
         if ntype in _DIVERGENCE_NODES and est is not None:
             ratio = (rows + 1) / (est + 1)
             _DIVERGENCE_RATIO.observe(ratio, node_type=ntype)
             DIVERGENCE.observe(qid, rec.stage, f"{program}.{pos}",
                                ntype, _subtree_table(node), est, rows)
 
-    # split this program's execute wall across its operators,
-    # proportional to rows-through (in+out; XLA fuses the chain, so a
+    # attribute the program's compile-time device cost across its
+    # operators (obs/devprof.py — the summary rides progcache meta, so
+    # warm disk hits in a fresh process attribute too), then split the
+    # execute wall by flops share. XLA fuses the chain, so a
     # per-operator device timer does not exist — the weighting makes
     # "which operator dominates" answerable from SQL; rounding means
-    # the parts sum to the program wall only approximately)
-    total_w = sum(weights) or 1
-    for op, w in zip(ops, weights):
+    # the parts sum to the program wall only approximately. Without a
+    # cost summary (pre-cost1 meta, backend without cost_analysis) the
+    # split falls back to rows-through (in+out), which let a
+    # cheap-wide node absorb an expensive-narrow node's wall
+    from presto_tpu.obs import devprof
+    per_node, flop_w = devprof.attribute(meta.get("cost"), node_shapes)
+    for op, costs in zip(ops, per_node):
+        op.update(costs)
+    wall_w = flop_w if flop_w is not None else weights
+    total_w = sum(wall_w) or 1
+    for op, w in zip(ops, wall_w):
         op["wallMillis"] = round(execute_s * 1000.0 * w / total_w)
 
     _observe_shapes(by_pos, order, actual)
@@ -966,6 +1038,8 @@ class QueryHistory:
             stats["endTime"] = event.end_time
             stats["wallMillis"] = int(event.elapsed_ms)
             stats["outputRows"] = event.output_rows
+            if event.state == "FINISHED":
+                stats["progress"] = 1.0
             for stage in stats["stages"]:
                 if stage["stage"] == "coordinator":
                     for t in stage["tasks"]:
@@ -983,6 +1057,10 @@ class QueryHistory:
             "elapsed_ms": round(event.elapsed_ms, 3),
             "output_rows": event.output_rows,
             "error": event.error,
+            # device-profile artifact directory when the query ran
+            # under SET SESSION device_profile = true (devprof)
+            "profile": (qr.profile_artifact if qr is not None
+                        else None),
             "stats": stats,
         }
         with self._lock:

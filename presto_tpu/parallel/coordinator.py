@@ -601,6 +601,27 @@ class ClusterCoordinator:
         except Exception:  # noqa: BLE001 - stats never fail the query
             pass
 
+    def _progress_weights(self, stages) -> dict[str, float]:
+        """Est-rows weight per stage name for the live progress
+        estimate (QueryRecorder.progress_plan): each stage counts its
+        fragment root's CBO row estimate, so completing a bulk scan
+        stage moves the bar further than a narrow join stage. Stages
+        without a fragment or stats weigh 1. Never raises."""
+        weights: dict[str, float] = {}
+        for st in stages:
+            w = 1.0
+            frag = getattr(st, "fragment", None)
+            if frag is not None:
+                try:
+                    from presto_tpu.cost import row_estimates
+                    ests = row_estimates(frag, self.engine)
+                    w = float(ests.get(id(frag))
+                              or (max(ests.values()) if ests else 0.0))
+                except Exception:  # noqa: BLE001 - statless fragments
+                    pass
+            weights[str(st.name)] = max(1.0, w)
+        return weights
+
     def _finish_with_partials(self, plan, agg, boundary,
                               buffers: list[bytes], meta: dict,
                               adapt=None):
@@ -679,10 +700,16 @@ class ClusterCoordinator:
                      "task_id": f"{qid}.partial.{i}",
                      "wire": wire_codec}
                     for i in range(nshards)]
+        qr = QS.current_query()
+        if qr is not None:
+            qr.progress_plan({"partial": float(nshards)})
+            qr.note_stage_dispatched("partial")
         try:
             results = self._dispatch_splits(payloads, workers)
         finally:
             self._collect_stage_stats(workers, qid, {})
+        if qr is not None:
+            qr.note_stage_completed("partial")
 
         cols, total = pages_to_columns(results)
         carrier = N.TableScan("__cluster__", "__partials__",
@@ -733,12 +760,17 @@ class ClusterCoordinator:
             st.name: {t: {"stage": p, "mode": m}
                       for t, (p, m) in st.sources.items()}
             for st in g.stages}
+        qr = QS.current_query()
+        if qr is not None:
+            qr.progress_plan(self._progress_weights(g.stages))
         try:
             inline: list | None = None
             for st in g.stages:
                 # host-side seam: a canceled/reaped query stops
                 # dispatching further stages here
                 CANCEL.checkpoint()
+                if qr is not None:
+                    qr.note_stage_dispatched(st.name)
                 frag = fragment_to_dict(st.fragment)
                 last = st.name == g.last_stage
                 payloads = []
@@ -786,6 +818,8 @@ class ClusterCoordinator:
                 nparts_of[st.name] = (W if st.partition_keys is not None
                                       else 1)
                 outs = self._run_stage(workers, payloads)
+                if qr is not None:
+                    qr.note_stage_completed(st.name)
                 if last:
                     inline = outs
             assert inline is not None
@@ -1117,12 +1151,16 @@ class ClusterCoordinator:
         stages = list(g.stages)
         last_name = g.last_stage
         sources_of: dict[str, dict] = {}
+        if qr is not None:
+            qr.progress_plan(self._progress_weights(stages))
         try:
             inline: list | None = None
             idx = 0
             while idx < len(stages):
                 st = stages[idx]
                 CANCEL.checkpoint()
+                if qr is not None:
+                    qr.note_stage_dispatched(st.name)
                 stage_by_name[st.name] = st
                 sources_of[st.name] = {
                     t: {"stage": p, "mode": m}
@@ -1134,6 +1172,8 @@ class ClusterCoordinator:
                     placed.setdefault(st.name, {})
                 last = st.name == last_name
                 outs = run_stage(st, last)
+                if qr is not None:
+                    qr.note_stage_completed(st.name)
                 if last:
                     inline = outs
                 elif adapt is not None and idx + 1 < len(stages):
@@ -1145,6 +1185,12 @@ class ClusterCoordinator:
                     if revised is not None:
                         stages = stages[:idx + 1] + list(revised.stages)
                         last_name = revised.last_stage
+                        # re-weight the progress plan for the revised
+                        # remainder (the recorder's monotonic floor
+                        # absorbs any shrink)
+                        if qr is not None:
+                            qr.progress_plan(
+                                self._progress_weights(stages))
                         for st2 in revised.stages:
                             for _t, (prod, m) in st2.sources.items():
                                 readers_of[prod] = max(
@@ -1202,10 +1248,16 @@ class ClusterCoordinator:
         def run_stage(payloads: list[dict]) -> list:
             return self._run_stage(workers, payloads)
 
+        qr = QS.current_query()
+        if qr is not None:
+            qr.progress_plan(self._progress_weights(
+                list(fragged.scan_stages) + list(fragged.join_stages)))
         try:
             # -- scan stages: leg fragments partition into buffers -----
             stage_types: dict[str, dict] = {}
             for st in fragged.scan_stages:
+                if qr is not None:
+                    qr.note_stage_dispatched(st.name)
                 stage_types[st.name] = st.fragment.output_types()
                 frag = fragment_to_dict(st.fragment)
                 run_stage([{
@@ -1216,11 +1268,17 @@ class ClusterCoordinator:
                                   "keys": st.partition_keys},
                     "async": True,
                 } for i in range(W)])
+                if qr is not None:
+                    # async dispatch: accepted = produced-or-producing;
+                    # the consuming join stage gates actual completion
+                    qr.note_stage_completed(st.name)
 
             # -- join stages -------------------------------------------
             inline_results: list[bytes] | None = None
             for js in fragged.join_stages:
                 CANCEL.checkpoint()
+                if qr is not None:
+                    qr.note_stage_dispatched(js.name)
                 probe_scan = exchange_scan("probe",
                                            stage_types[js.probe_name])
                 build_scan = exchange_scan("build",
@@ -1256,6 +1314,8 @@ class ClusterCoordinator:
                         p["async"] = True
                     payloads.append(p)
                 outs = run_stage(payloads)
+                if qr is not None:
+                    qr.note_stage_completed(js.name)
                 if js.out_partition_keys is None:
                     inline_results = outs  # bytes per worker
 

@@ -1112,6 +1112,13 @@ def execute_plan_distributed(engine, plan: N.PlanNode,
             compile_s = _time.perf_counter() - t0
             _COMPILES.inc()
             _COMPILE_SECONDS.observe(compile_s)
+            # harvest the whole-mesh device cost into meta before the
+            # success-path cache insert below: warm (disk-tier) hits
+            # in a fresh process attribute flops/bytes from here
+            from presto_tpu.obs import devprof
+            cost = devprof.harvest(compiled)
+            if cost is not None:
+                meta["cost"] = cost
         if tpl is not None:
             pargs = tpl.bind(meta.get("param_bindings"))
         t0 = _time.perf_counter()

@@ -701,6 +701,20 @@ class WorkerServer(HttpService):
             def do_POST(self):  # noqa: N802
                 if not self._authorized():
                     return
+                if self.path in ("/v1/profile/start",
+                                 "/v1/profile/stop"):
+                    # on-demand device profiler on THIS worker's
+                    # process (obs/devprof.py): task execution between
+                    # start and stop lands in the programmatic trace
+                    from presto_tpu.obs import devprof
+                    if self.path.endswith("/start"):
+                        res = devprof.start_capture(
+                            f"worker-{outer.node_id}")
+                    else:
+                        res = devprof.stop_capture()
+                    self._send_json(res,
+                                    503 if res.get("error") else 200)
+                    return
                 if self.path != "/v1/task":
                     self._send_json({"error": "not found"}, 404)
                     return

@@ -28,6 +28,14 @@ class JsonHandler(BaseHTTPRequestHandler):
         length = int(self.headers.get("Content-Length", 0))
         return json.loads(self.rfile.read(length))
 
+    def _send_html(self, html: str, status: int = 200) -> None:
+        body = html.encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "text/html; charset=utf-8")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
     def _send_bytes(self, body, status: int = 200,
                     extra_headers: dict | None = None,
                     content_type: str | None = None) -> None:

@@ -130,7 +130,22 @@ class QueryInfo:
             "scheduled": self.state in ("RUNNING", "FINISHED"),
             "elapsedTimeMillis": int(wall * 1000),
             "processedRows": self.rows_done(),
+            "progress": self.progress(),
         }
+
+    def progress(self) -> float:
+        """Monotonic 0..1 completion estimate for the protocol stats
+        blob and the Web UI (the qstats recorder's stage-walk estimate
+        when the query is recording, else state-derived)."""
+        if self.state == "FINISHED":
+            return 1.0
+        if self.state in ("FAILED", "CANCELED"):
+            return 0.0
+        from presto_tpu.obs import qstats as QS
+        rec = QS.STORE.get(self.query_id)
+        if rec is not None:
+            return rec.progress()
+        return 0.0
 
 
 def _classify_error(e: BaseException) -> str | None:
@@ -853,6 +868,20 @@ class _Handler(JsonHandler):
                 return
             self._send_json(self._query_results(q, 0))
             return
+        if self.path in ("/v1/profile/start", "/v1/profile/stop"):
+            # on-demand device profiler (obs/devprof.py): wraps
+            # whatever executes between start and stop in a
+            # programmatic jax.profiler trace under
+            # PRESTO_TPU_PROFILE_DIR
+            if self._authenticated_user() is None:
+                return
+            from presto_tpu.obs import devprof
+            if self.path.endswith("/start"):
+                res = devprof.start_capture("coordinator")
+            else:
+                res = devprof.stop_capture()
+            self._send_json(res, 503 if res.get("error") else 200)
+            return
         self._send_json({"error": "not found"}, 404)
 
     def _session_properties(self) -> dict:
@@ -894,12 +923,30 @@ class _Handler(JsonHandler):
     def do_GET(self):  # noqa: N802
         parts = self.path.strip("/").split("/")
         if self.path in ("/", "/ui", "/ui/"):
-            body = _UI_HTML.encode()
-            self.send_response(200)
-            self.send_header("Content-Type", "text/html; charset=utf-8")
-            self.send_header("Content-Length", str(len(body)))
-            self.end_headers()
-            self.wfile.write(body)
+            from presto_tpu.server import ui
+            self._send_html(ui.dashboard_html())
+            return
+        if len(parts) == 3 and parts[:2] == ["ui", "query"]:
+            # per-query observatory page: the Stage->Task->Operator
+            # tree with the device-cost columns, progress, and the
+            # trace/profile export links. The current snapshot is
+            # embedded server-side (and re-polled by the page's JS).
+            from presto_tpu.server import ui
+            user = self._authenticated_user()
+            if user is None:
+                return
+            qid = parts[2]
+            q = self.manager.get(qid)
+            info = None
+            if q is not None and self._can_view(user, q):
+                info = {"queryId": q.query_id, "state": q.state,
+                        "query": q.sql, "user": q.user,
+                        "stats": q.stats(), "error": q.error}
+                rec = QS.STORE.get(q.query_id)
+                if rec is not None:
+                    info["queryStats"] = rec.snapshot()
+            self._send_html(ui.query_page_html(qid, info),
+                            200 if info is not None else 404)
             return
         if self.path == "/v1/cluster":
             qs = self.manager.snapshot()
@@ -962,7 +1009,9 @@ class _Handler(JsonHandler):
                 return
             self._send_json([
                 {"queryId": q.query_id, "state": q.state,
-                 "query": q.sql, "user": q.user}
+                 "query": q.sql, "user": q.user,
+                 "progress": q.progress(),
+                 "elapsedMillis": q.stats()["elapsedTimeMillis"]}
                 for q in self.manager.snapshot()
                 if self._can_view(user, q)])
             return
@@ -1083,53 +1132,8 @@ class _Handler(JsonHandler):
         self._send_json({"error": "not found"}, 404)
 
 
-# Minimal cluster/query dashboard (reference Web UI, server/ui/ +
-# webapp React app, reduced to one self-contained page polling the
-# JSON APIs this coordinator already serves).
-_UI_HTML = """<!doctype html>
-<html><head><title>presto-tpu</title><style>
-body{font-family:system-ui,sans-serif;margin:2em;background:#111;
-color:#eee}
-h1{font-size:1.3em} h2{font-size:1.05em;margin-top:1.4em}
-table{border-collapse:collapse;width:100%;font-size:.85em}
-td,th{border:1px solid #333;padding:.35em .6em;text-align:left}
-th{background:#1c2733} .st-RUNNING{color:#6cf} .st-FINISHED{color:#6f6}
-.st-FAILED{color:#f66} .st-QUEUED{color:#fc6} .st-CANCELED{color:#999}
-.cards{display:flex;gap:1em} .card{background:#1c2733;padding:.8em
-1.2em;border-radius:6px;min-width:7em}
-.card b{font-size:1.6em;display:block}
-</style></head><body>
-<h1>presto-tpu coordinator</h1>
-<div class="cards" id="cards"></div>
-<h2>Queries</h2><table id="queries"><thead><tr><th>id</th><th>state
-</th><th>user</th><th>query</th></tr></thead><tbody></tbody></table>
-<h2>Resource groups</h2><table id="groups"><thead><tr><th>group</th>
-<th>policy</th><th>running</th><th>queued</th><th>limit</th>
-</tr></thead><tbody></tbody></table>
-<script>
-async function j(u){return (await fetch(u)).json()}
-function esc(s){const d=document.createElement('span');
-d.textContent=s;return d.innerHTML}
-function groupRows(gs,prefix){let out='';for(const g of gs){
-out+=`<tr><td>${esc(g.name)}</td><td>${esc(g.schedulingPolicy||'fair')}
-</td><td>${g.running}</td><td>${g.queued}</td>
-<td>${g.hardConcurrencyLimit}</td></tr>`;
-if(g.subGroups)out+=groupRows(g.subGroups)}return out}
-async function tick(){
-const c=await j('/v1/cluster');
-document.getElementById('cards').innerHTML=
-['runningQueries','queuedQueries','finishedQueries','failedQueries']
-.map(k=>`<div class="card"><b>${c[k]}</b>${k.replace('Queries','')}
-</div>`).join('');
-const qs=await j('/v1/query');
-document.querySelector('#queries tbody').innerHTML=qs.slice(-50)
-.reverse().map(q=>`<tr><td>${esc(q.queryId)}</td>
-<td class="st-${q.state}">${q.state}</td><td>${esc(q.user)}</td>
-<td><code>${esc(q.query.slice(0,120))}</code></td></tr>`).join('');
-const gs=await j('/v1/resourceGroup');
-document.querySelector('#groups tbody').innerHTML=groupRows(gs);}
-tick();setInterval(tick,2000);
-</script></body></html>"""
+# The Web UI pages live in presto_tpu/server/ui.py (single-file
+# no-dependency HTML+JS dashboard + per-query observatory page).
 
 
 class CoordinatorServer(HttpService):

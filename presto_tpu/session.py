@@ -297,6 +297,17 @@ SYSTEM_SESSION_PROPERTIES: dict[str, tuple[Any, type, str]] = {
                                   "floor on the straggler threshold: "
                                   "tasks never speculate before "
                                   "running at least this long"),
+    # -- device observatory (obs/devprof.py) ---------------------------
+    # Host-side only, like the adaptive block above: the profiler wrap
+    # happens around execution (events.monitored), never at trace
+    # time, so this stays OUT of TRACE_RELEVANT_PROPERTIES — toggling
+    # profiling must not re-key compiled programs.
+    "device_profile": (False, bool,
+                       "wrap each query's execution in a programmatic "
+                       "jax.profiler device trace written under "
+                       "PRESTO_TPU_PROFILE_DIR; the artifact directory "
+                       "is stamped into the query's history record and "
+                       "surfaced in the Web UI"),
 }
 
 

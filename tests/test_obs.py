@@ -200,33 +200,40 @@ def test_metric_name_lint_flags_violations(tmp_path):
 
     pkg = write_pkg(tmp_path, {"presto_tpu/mod.py": """
         from presto_tpu.obs.metrics import REGISTRY
-        BAD1 = REGISTRY.counter("presto_tpu_rows")         # no _total
-        BAD2 = REGISTRY.gauge("presto_tpu_depth_total")    # _total gauge
-        BAD3 = REGISTRY.histogram("presto_tpu_wait")       # no unit
-        BAD4 = REGISTRY.counter("widgets_total")           # no prefix
-        OK = REGISTRY.counter("presto_tpu_widgets_total")
+        BAD1 = REGISTRY.counter("presto_tpu_rows", "h")    # no _total
+        BAD2 = REGISTRY.gauge("presto_tpu_depth_total",
+                              "h")                         # _total gauge
+        BAD3 = REGISTRY.histogram("presto_tpu_wait", "h")  # no unit
+        BAD4 = REGISTRY.counter("widgets_total", "h")      # no prefix
+        BAD5 = REGISTRY.counter(
+            "presto_tpu_undoc_total")                      # no HELP
+        BAD6 = REGISTRY.counter(
+            "presto_tpu_blank_total", help_text="  ")      # blank HELP
+        OK = REGISTRY.counter("presto_tpu_widgets_total", "widgets")
 
         def f():
             OK.inc(-1)                                     # decrement
     """, "presto_tpu/other.py": """
         from presto_tpu.obs.metrics import REGISTRY
         # same name, different kind than mod.py
-        CLASH = REGISTRY.gauge("presto_tpu_widgets")
-        CLASH2 = REGISTRY.histogram("presto_tpu_widgets_seconds")
+        CLASH = REGISTRY.gauge("presto_tpu_widgets", "h")
+        CLASH2 = REGISTRY.histogram("presto_tpu_widgets_seconds", "h")
     """, "presto_tpu/clash.py": """
         from presto_tpu.obs.metrics import REGISTRY
-        X = REGISTRY.gauge("presto_tpu_widgets_seconds")   # kind clash
+        X = REGISTRY.gauge("presto_tpu_widgets_seconds",
+                           "h")                            # kind clash
     """})
     findings = [f for f in run_lint([pkg])
                 if f.rule == "metric-name"]
     messages = "\n".join(f.message for f in findings)
-    assert len(findings) == 6, messages
+    assert len(findings) == 8, messages
     assert "must end in _total" in messages
     assert "must not end in _total" in messages
     assert "unit suffix" in messages
     assert "must match" in messages
     assert "negative literal" in messages
     assert "the registry raises on whichever loads second" in messages
+    assert messages.count("without HELP") == 2
 
 
 def test_metric_name_lint_clean_code_passes(tmp_path):
@@ -235,8 +242,10 @@ def test_metric_name_lint_clean_code_passes(tmp_path):
     pkg = write_pkg(tmp_path, {"presto_tpu/mod.py": """
         from presto_tpu.obs.metrics import REGISTRY
         C = REGISTRY.counter("presto_tpu_rows_total", "rows")
-        G = REGISTRY.gauge("presto_tpu_pool_bytes")
-        H = REGISTRY.histogram("presto_tpu_wait_seconds")
+        G = REGISTRY.gauge("presto_tpu_pool_bytes", help_text="bytes")
+        H = REGISTRY.histogram("presto_tpu_wait_seconds", "wait")
+        # non-literal help is left to the author (runtime carries it)
+        D = REGISTRY.counter("presto_tpu_dyn_total", "x" * 3)
 
         def f(n):
             C.inc(n)

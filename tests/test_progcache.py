@@ -292,6 +292,48 @@ def test_disk_hit_then_corruption_fallback(tmp_path, monkeypatch):
     assert _DISK_ERRORS.value(op="load") >= err0 + 1
 
 
+def test_old_format_entry_misses_and_recompiles(tmp_path, monkeypatch):
+    """PROGRAM_FORMAT ("cost1": meta carries the device-cost summary)
+    rides the platform fingerprint, so entries persisted by a
+    pre-cost engine land at a DIFFERENT digest — a clean miss, never a
+    mis-unpack. And an old-shape blob that somehow sits at the current
+    digest (hand-copied store, digest collision) degrades to
+    disk_error + miss + live compile, not a crash."""
+    # the format string participates in the digest
+    key = ("fp", (), ())
+    fp = PC.platform_fingerprint()
+    assert PC.PROGRAM_FORMAT == "cost1"
+    assert PC.PROGRAM_FORMAT in fp
+    old_fp = tuple("oks1" if x == PC.PROGRAM_FORMAT else x for x in fp)
+    assert PC.entry_digest(key, fp) != PC.entry_digest(key, old_fp)
+
+    monkeypatch.setenv(PC.ENV_DIR, str(tmp_path))
+    sql = "select k, sum(v) from t group by k order by k"
+    want = mem_engine().execute(sql)
+    progs = [f for f in os.listdir(tmp_path) if f.endswith(".prog")]
+    assert progs
+    # rewrite every stored entry as an "old-format" blob: a valid
+    # pickle whose shape predates the {key, payload, in_tree,
+    # out_tree, meta} contract
+    import pickle
+    for f in progs:
+        with open(os.path.join(tmp_path, f), "wb") as fh:
+            pickle.dump(("payload", "in_tree", "out_tree"), fh)
+    err0 = _DISK_ERRORS.value(op="load")
+    m0 = _MISSES.value()
+    c0 = _COMPILED.value()
+    got = mem_engine().execute(sql)  # fresh engine: no memory tier
+    assert got == want
+    assert _COMPILED.value() - c0 >= 1  # live compile fallback
+    assert _MISSES.value() - m0 >= 1
+    assert _DISK_ERRORS.value(op="load") >= err0 + 1
+    # the poisoned files were unlinked and re-stored by the fallback
+    # compile, so the NEXT engine disk-hits again
+    d0 = _HITS.value(tier="disk")
+    assert mem_engine().execute(sql) == want
+    assert _HITS.value(tier="disk") - d0 >= 1
+
+
 # -- cross-worker sharing ----------------------------------------------------
 
 def test_two_worker_cluster_shares_disk_store(tmp_path, monkeypatch):
