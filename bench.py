@@ -476,6 +476,210 @@ def _quantile_ms(sorted_s: list, q: float) -> float:
     return round(sorted_s[idx] * 1e3, 2)
 
 
+def _serve_repeat_phase(base: str, repeat: float, nclients: int,
+                        duration: float) -> dict:
+    """Tenant-scale repeated-query mix (server/serving.py): a
+    ``repeat`` fraction of each client's issues re-run an IDENTICAL
+    SELECT — protocol-layer result-cache hits after the first pass —
+    and the rest are template VARIANTS of one parameterized shape,
+    issued under a small ``batch_window_ms`` so concurrent arrivals
+    stack into vmapped cross-query batches (exec/batch.py). Reports
+    the hit/variant split, batch mean size, and cache hit ratios."""
+    import threading
+
+    from presto_tpu.client import Client
+    from presto_tpu.obs.metrics import REGISTRY
+
+    hits0 = REGISTRY.counter(
+        "presto_tpu_result_cache_hits_total").value()
+    miss0 = REGISTRY.counter(
+        "presto_tpu_result_cache_misses_total").value()
+    hit_lat: list[list] = [[] for _ in range(nclients)]
+    var_lat: list[list] = [[] for _ in range(nclients)]
+    errors = [0] * nclients
+    deadline = time.perf_counter() + duration
+
+    def drive(i: int) -> None:
+        c = Client(base, user=f"repeat{i}")
+        # variants ride the cross-query batch window; identical
+        # re-issues fast-path out of the cache before ever seeing it
+        c.session_properties = {"batch_window_ms": 4.0}
+        n = 0
+        while time.perf_counter() < deadline:
+            identical = (n % 100) < int(repeat * 100)
+            if identical:
+                sql = SERVE_QUERIES[(i + n) % len(SERVE_QUERIES)]
+            else:
+                # per-client, per-issue literal: same template
+                # fingerprint, (almost) never the same cache key
+                v = ((i * 9973 + n * 37) % 100000) / 10.0
+                sql = ("select count(*) from supplier "
+                       f"where s_acctbal > {v}")
+            t0 = time.perf_counter()
+            try:
+                c.execute(sql, poll_interval=0.005)
+                (hit_lat if identical else var_lat)[i].append(
+                    time.perf_counter() - t0)
+            except Exception:  # noqa: BLE001 - keep driving
+                errors[i] += 1
+            n += 1
+
+    threads = [threading.Thread(target=drive, args=(i,))
+               for i in range(nclients)]
+    t_start = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t_start
+    all_hit = sorted(x for per in hit_lat for x in per)
+    all_var = sorted(x for per in var_lat for x in per)
+    hits = REGISTRY.counter(
+        "presto_tpu_result_cache_hits_total").value() - hits0
+    misses = REGISTRY.counter(
+        "presto_tpu_result_cache_misses_total").value() - miss0
+    batch_hist = REGISTRY.histogram("presto_tpu_batch_size_queries")
+    batch_count = batch_hist.count()
+    completed = len(all_hit) + len(all_var)
+    return {
+        "serve_repeat_fraction": repeat,
+        "serve_repeat_seconds": round(wall, 1),
+        "serve_repeat_queries": completed,
+        "serve_repeat_qps": round(completed / max(wall, 1e-9), 1),
+        "serve_hit_qps": round(len(all_hit) / max(wall, 1e-9), 1),
+        "serve_hit_p50_ms": _quantile_ms(all_hit, 0.50),
+        "serve_hit_p99_ms": _quantile_ms(all_hit, 0.99),
+        "serve_variant_qps": round(len(all_var) / max(wall, 1e-9), 1),
+        "serve_batched_queries": int(REGISTRY.counter(
+            "presto_tpu_batched_queries_total").value()),
+        "serve_batch_mean_size": (
+            round(batch_hist.sum() / batch_count, 2)
+            if batch_count else 0.0),
+        "serve_result_cache_hits": int(hits),
+        "serve_result_cache_misses": int(misses),
+        "serve_result_cache_hit_ratio": round(
+            hits / max(1.0, hits + misses), 3),
+        "serve_repeat_errors": sum(errors),
+    }
+
+
+def _serve_scaleout_phase(sf: float, duration: float) -> dict:
+    """Elastic scale-out: drive a 2-worker cluster through the HTTP
+    coordinator, then JOIN two standby workers mid-run via PUT
+    /v1/node (the drain API's mirror image — exactly an autoscaler's
+    move) and report first-half vs second-half QPS. The scheduler
+    consults live workers per dispatch, so the joined pair picks up
+    shards as soon as their first heartbeat flips them active."""
+    import threading
+    import urllib.request
+
+    from presto_tpu import Engine
+    from presto_tpu.client import Client
+    from presto_tpu.connectors.tpch import TpchConnector
+    from presto_tpu.parallel.coordinator import ClusterCoordinator
+    from presto_tpu.parallel.worker import WorkerServer
+    from presto_tpu.server import CoordinatorServer
+
+    # below SF 0.1 a shard is ~30k rows and per-task dispatch overhead
+    # swamps the shard work, reading as a spurious QPS cliff at the
+    # join; >= 0.1 the per-query cost is shard-count-invariant and the
+    # halves compare cleanly
+    sf = max(sf, 0.1)
+    nclients = 4
+    workers = [
+        WorkerServer({"tpch": TpchConnector(scale=sf)},
+                     node_id=f"bw{i}").start()
+        for i in range(4)]
+    local = Engine()
+    local.register_catalog("tpch", TpchConnector(scale=sf))
+    coord = ClusterCoordinator(local, heartbeat_interval_s=0.2).start()
+    for w in workers:
+        coord.add_worker(w.uri)
+    srv = CoordinatorServer(local, cluster=coord).start()
+
+    def _put(url: str, payload: dict) -> None:
+        req = urllib.request.Request(
+            url, method="PUT", data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json",
+                     "X-Trino-User": "scale"})
+        urllib.request.urlopen(req, timeout=10).close()
+
+    def _wait_live(n: int) -> None:
+        deadline = time.perf_counter() + 10
+        while len(coord.live_workers()) != n \
+                and time.perf_counter() < deadline:
+            time.sleep(0.05)
+
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        sql = ("select l_returnflag, count(*) as c from lineitem "
+               "group by l_returnflag order by l_returnflag")
+        warm = Client(base, user="scale")
+        _wait_live(4)
+        warm.execute(sql)  # 4-shard fragment programs compile here
+        # drain two workers back out (graceful worker-side drain) so
+        # the timed run STARTS at 2 and both shard configurations are
+        # warm — the mid-run JOIN then measures rebalancing, not XLA
+        for w in workers[2:]:
+            _put(w.uri + "/v1/info/state", {"state": "SHUTTING_DOWN"})
+        _wait_live(2)
+        warm.execute(sql)  # 2-shard fragment programs compile here
+        done: list[list] = [[] for _ in range(nclients)]
+        t0 = time.perf_counter()
+        t_mid = t0 + duration / 2
+        t_end = t0 + duration
+
+        def drive(i: int) -> None:
+            c = Client(base, user=f"scale{i}")
+            while time.perf_counter() < t_end:
+                try:
+                    c.execute(sql, poll_interval=0.005)
+                    done[i].append(time.perf_counter())
+                except Exception:  # noqa: BLE001 - keep driving
+                    pass
+
+        threads = [threading.Thread(target=drive, args=(i,))
+                   for i in range(nclients)]
+        for t in threads:
+            t.start()
+        time.sleep(max(0.0, t_mid - time.perf_counter()))
+        # the autoscaler's move: the worker re-activates, then
+        # announces itself to the running coordinator over PUT
+        # /v1/node (joining -> active on its next heartbeat)
+        for w in workers[2:]:
+            _put(w.uri + "/v1/info/state", {"state": "ACTIVE"})
+            _put(base + "/v1/node", {"uri": w.uri})
+        for t in threads:
+            t.join()
+        stamps = [x for per in done for x in per]
+        first = sum(1 for x in stamps if x <= t_mid)
+        second = len(stamps) - first
+        half = max(duration / 2, 1e-9)
+        # structural evidence the rebalance happened: the final query
+        # fanned out across the grown cluster. On a single-core
+        # container the sharded work time-slices one CPU, so the
+        # visible scale-out signal is membership-follow at QPS parity
+        # (a real core/chip per worker is what turns it into speedup);
+        # serve_scaleout_cpus makes that context part of the record.
+        return {
+            "serve_scaleout_sf": sf,
+            "serve_scaleout_qps_2w": round(first / half, 1),
+            "serve_scaleout_qps_4w": round(second / half, 1),
+            "serve_scaleout_live_workers": len(coord.live_workers()),
+            "serve_scaleout_final_nshards":
+                (coord.last_distribution or {}).get("nshards"),
+            "serve_scaleout_cpus": len(os.sched_getaffinity(0)),
+        }
+    finally:
+        srv.stop()
+        coord.stop()
+        for w in workers:
+            try:
+                w.stop()
+            except Exception:  # noqa: BLE001 - teardown best-effort
+                pass
+
+
 def run_serve_bench() -> dict:
     """The --serve mode body: returns (and prints) the serve detail."""
     import threading
@@ -592,6 +796,25 @@ def run_serve_bench() -> dict:
             })
         except Exception as exc:  # noqa: BLE001 - additive metric
             out["qstream_error"] = repr(exc)[:200]
+
+        # tenant-scale serving phases (server/serving.py): the
+        # repeated-query mix re-uses the warm in-process server; the
+        # scale-out phase boots its own 4-worker cluster
+        repeat = float(os.environ.get("PRESTO_TPU_BENCH_SERVE_REPEAT",
+                                      "0.8"))
+        if repeat > 0:
+            try:
+                out.update(_serve_repeat_phase(
+                    base, repeat, nclients, min(duration, 10.0)))
+            except Exception as exc:  # noqa: BLE001 - additive
+                out["serve_repeat_error"] = repr(exc)[:200]
+        if os.environ.get("PRESTO_TPU_BENCH_SERVE_SCALEOUT",
+                          "1") != "0":
+            try:
+                out.update(_serve_scaleout_phase(sf, min(duration,
+                                                         12.0)))
+            except Exception as exc:  # noqa: BLE001 - additive
+                out["serve_scaleout_error"] = repr(exc)[:200]
         return out
     finally:
         srv.stop()
@@ -768,10 +991,18 @@ _HIGHER_BETTER = ("rows_per_sec", "mb_per_sec", "_qps", "qps",
 _LOWER_BETTER = ("_s", "_flops", "_hbm_bytes", "_compiles",
                  "_programs_compiled", "_device_syncs", "_page_bytes",
                  "_retries", "_errors", "_misses")
+# deliberately ungated: the result cache answers the serve mix at the
+# protocol layer, so serve-mode template hits collapsing is the cache
+# WORKING, not template sharing regressing (the q*_template_hits keys
+# still gate — those phases run with the cache cold)
+_UNGATED = ("serve_template_hits", "serve_template_misses",
+            "serve_result_cache_misses")
 
 
 def _compare_direction(key: str) -> int:
     """+1 higher-is-better, -1 lower-is-better, 0 ungated."""
+    if key in _UNGATED:
+        return 0
     for pat in _HIGHER_BETTER:
         if key.endswith(pat) or pat in key:
             return 1
@@ -799,6 +1030,18 @@ def _bench_detail(path: str) -> dict:
                     objs.append(json.loads(line))
                 except ValueError:
                     continue
+    # the hand-recorded BENCH_rXX.json wrappers carry the run's final
+    # JSON line as a STRING under "tail" — unwrap it, else the compare
+    # sees zero keys and the gate is vacuous
+    for obj in list(objs):
+        if isinstance(obj, dict) and isinstance(obj.get("tail"), str):
+            for line in obj["tail"].splitlines():
+                line = line.strip()
+                if line.startswith("{"):
+                    try:
+                        objs.append(json.loads(line))
+                    except ValueError:
+                        continue
     for obj in objs:
         if isinstance(obj, dict) and isinstance(obj.get("detail"), dict):
             detail = obj["detail"]

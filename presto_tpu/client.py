@@ -16,13 +16,14 @@ values the JSON path yields.
 
 from __future__ import annotations
 
+import http.client
+import io
 import json
 import re
+import threading
 import time
 import urllib.error
-import urllib.request
-
-from presto_tpu.server.httpbase import urlopen as _urlopen
+from urllib.parse import urlsplit
 
 
 class QueryFailed(Exception):
@@ -55,66 +56,153 @@ class Client:
         # replayed via X-Trino-Prepared-Statement (the reference's
         # addedPreparedStatements round-trip)
         self.prepared_statements: dict[str, str] = {}
+        # per-thread persistent HTTP/1.1 connections: one TCP connect
+        # (and one server handler thread) per client thread instead of
+        # per request — the serving fast path answers a repeated
+        # SELECT in a single round trip on an already-open socket
+        self._conns: dict[int, http.client.HTTPConnection] = {}
+        self._conns_lock = threading.Lock()
 
-    def _request(self, method: str, url: str, body: bytes | None = None):
-        req = urllib.request.Request(url, data=body, method=method)
-        req.add_header("X-Trino-User", self.user)
+    def _new_conn(self) -> http.client.HTTPConnection:
+        from presto_tpu.server.httpbase import client_ssl_context
+        sp = urlsplit(self.base_url)
+        if sp.scheme == "https":
+            import ssl
+            ctx = client_ssl_context()
+            if ctx is None:
+                ctx = ssl.create_default_context()
+            conn: http.client.HTTPConnection = \
+                http.client.HTTPSConnection(
+                    sp.hostname, sp.port, timeout=300, context=ctx)
+        else:
+            conn = http.client.HTTPConnection(sp.hostname, sp.port,
+                                              timeout=300)
+        conn.connect()
+        # request/response pairs ping-pong on this socket: Nagle +
+        # delayed ACK would add ~40ms to every exchange
+        import socket
+        conn.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        return conn
+
+    def _drop_conn(self, tid: int) -> None:
+        with self._conns_lock:
+            conn = self._conns.pop(tid, None)
+        if conn is not None:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _headers(self) -> dict:
+        headers = {"X-Trino-User": self.user}
         if self.result_format != "json":
-            req.add_header("X-Presto-TPU-Result", self.result_format)
+            headers["X-Presto-TPU-Result"] = self.result_format
         if self.session_properties:
             from urllib.parse import quote
             # values are URL-encoded so a comma/equals inside a value
             # cannot corrupt the comma-separated header (the reference
             # protocol encodes the same way)
-            req.add_header("X-Trino-Session", ",".join(
+            headers["X-Trino-Session"] = ",".join(
                 f"{k}={quote(str(v))}"
-                for k, v in self.session_properties.items()))
+                for k, v in self.session_properties.items())
         if self.prepared_statements:
             from urllib.parse import quote
-            req.add_header("X-Trino-Prepared-Statement", ",".join(
+            headers["X-Trino-Prepared-Statement"] = ",".join(
                 f"{quote(k)}={quote(v)}"
-                for k, v in self.prepared_statements.items()))
+                for k, v in self.prepared_statements.items())
         if self.password is not None:
             import base64
             cred = base64.b64encode(
                 f"{self.user}:{self.password}".encode()).decode()
-            req.add_header("Authorization", f"Basic {cred}")
-        try:
-            with _urlopen(req, timeout=300) as resp:
-                ctype = resp.headers.get("Content-Type", "")
-                if ctype.startswith("application/vnd.presto-tpu"):
-                    return self._binary_result(resp, url)
-                return json.loads(resp.read() or b"{}")
-        except urllib.error.HTTPError as e:
+            headers["Authorization"] = f"Basic {cred}"
+        return headers
+
+    def _request(self, method: str, url: str, body: bytes | None = None):
+        sp = urlsplit(url)
+        path = sp.path + (f"?{sp.query}" if sp.query else "")
+        headers = self._headers()
+        tid = threading.get_ident()
+        resp = None
+        for attempt in (0, 1):
+            with self._conns_lock:
+                conn = self._conns.get(tid)
+            if conn is None:
+                conn = self._new_conn()
+                with self._conns_lock:
+                    self._conns[tid] = conn
+            try:
+                conn.request(method, path, body=body, headers=headers)
+            except (http.client.HTTPException, OSError):
+                # send-phase failure: the server processed nothing, so
+                # a fresh-connection retry is safe for ANY method (the
+                # usual cause is the far end closing an idle socket)
+                self._drop_conn(tid)
+                if attempt:
+                    raise
+                continue
+            try:
+                resp = conn.getresponse()
+            except (http.client.HTTPException, OSError):
+                self._drop_conn(tid)
+                # the request reached the wire: only retry methods the
+                # server may safely see twice (a POSTed statement could
+                # otherwise double-submit)
+                if attempt or method not in ("GET", "DELETE"):
+                    raise
+                continue
+            break
+        status = resp.status
+        data = resp.read()  # always drain: keep-alive needs EOF
+        if status >= 400:
+            # the connection may hold an unread request body (e.g. a
+            # 401 sent before the server read our POST data): never
+            # reuse it after an error response
+            self._drop_conn(tid)
+        if status == 429:
             # overload shedding answers 429 with the QueryResults JSON
             # (QUERY_QUEUE_FULL + Retry-After); surface it as a result
             # so execute() raises the classified QueryFailed. Other
-            # statuses (401 auth, 404 ownership) propagate untouched.
-            if e.code != 429:
-                raise
-            body = e.read()
+            # statuses (401 auth, 404 ownership) raise like urllib did.
             try:
-                return json.loads(body)
+                return json.loads(data)
             except (ValueError, TypeError):
-                raise e from None
+                pass
+        if status >= 400:
+            raise urllib.error.HTTPError(url, status, resp.reason,
+                                         resp.headers, io.BytesIO(data))
+        ctype = resp.headers.get("Content-Type", "")
+        if ctype.startswith("application/vnd.presto-tpu"):
+            return self._binary_result(data, resp.headers, url)
+        return json.loads(data or b"{}")
 
-    def _binary_result(self, resp, url: str) -> dict:
+    def close(self) -> None:
+        """Close this client's persistent connections (optional; idle
+        server threads also time out on their own)."""
+        with self._conns_lock:
+            conns = list(self._conns.values())
+            self._conns.clear()
+        for conn in conns:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _binary_result(self, body: bytes, headers, url: str) -> dict:
         """An arrow result page -> the SAME QueryResults shape the
         JSON envelope carries: the body's wire bytes decode to rows
         byte-identical to the buffered/JSON path, state/token/columns
         come off the response headers."""
         from presto_tpu.server.results import rows_from_wire_page
 
-        body = resp.read()
         out: dict = {"stats": {
-            "state": resp.headers.get("X-PrestoTpu-State", "RUNNING")}}
-        cols = resp.headers.get("X-PrestoTpu-Columns")
+            "state": headers.get("X-PrestoTpu-State", "RUNNING")}}
+        cols = headers.get("X-PrestoTpu-Columns")
         if cols:
             out["columns"] = json.loads(cols)
         if body:
             out["data"] = rows_from_wire_page(body)
-        if resp.headers.get("X-PrestoTpu-Complete") != "1":
-            nxt = resp.headers.get("X-PrestoTpu-Next-Token", "0")
+        if headers.get("X-PrestoTpu-Complete") != "1":
+            nxt = headers.get("X-PrestoTpu-Next-Token", "0")
             out["nextUri"] = re.sub(r"/\d+$", f"/{nxt}", url)
         return out
 

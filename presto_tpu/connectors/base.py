@@ -76,6 +76,17 @@ class Connector:
         those keys skip the FIXED_HASH exchange entirely."""
         return None
 
+    def table_version(self, name: str) -> int | None:
+        """Monotonic per-table data version for result caching. A
+        connector whose tables can change under it must bump the
+        version on every write; ``None`` (the default) declares the
+        table's contents unversioned, which makes any query touching
+        it ineligible for the result cache — stale hits are
+        structurally impossible, not merely unlikely (analog of the
+        reference's ConnectorMetadata.getTableHandle freshness
+        contract used by materialized-view staleness checks)."""
+        return None
+
     def apply_filter(self, name: str, conjuncts) -> str | None:
         """Offer pushable filter conjuncts
         (connectors/expression.ComparisonExpr). A connector that can

@@ -187,6 +187,14 @@ class SystemConnector(_ReflectiveConnector):
             "default": T.VARCHAR, "type": T.VARCHAR,
             "description": T.VARCHAR,
         },
+        # the serving result cache (server/serving.py), entry by
+        # entry: which plan fingerprints are cached against which
+        # table versions, and how hard each entry is working
+        "result_cache": {
+            "fingerprint": T.VARCHAR, "tables": T.VARCHAR,
+            "rows": T.BIGINT, "bytes": T.BIGINT,
+            "hits": T.BIGINT, "age_ms": T.BIGINT,
+        },
     }
 
     def _rows(self, name: str) -> list[tuple]:
@@ -232,6 +240,11 @@ class SystemConnector(_ReflectiveConnector):
                      t.__name__, desc)
                     for n, (d, t, desc) in sorted(
                         SYSTEM_SESSION_PROPERTIES.items())]
+        if name == "result_cache":
+            serving = getattr(self.engine, "_serving_view", None)
+            if serving is None:
+                return []
+            return serving.cache.snapshot()
         raise KeyError(name)
 
     def _node_rows(self) -> list[tuple]:
@@ -247,7 +260,11 @@ class SystemConnector(_ReflectiveConnector):
         if cluster is None:
             return rows
         for w in list(cluster.workers):
-            if not w.alive:
+            if w.state == "joining":
+                # a joining node has no heartbeat history yet; its
+                # decayed failure ratio must not label it dead
+                state = "joining"
+            elif not w.alive:
                 state = "dead"
             elif w.state == "shutting_down":
                 state = "draining"

@@ -23,6 +23,16 @@ class MemoryConnector(Connector):
         self._schemas: dict[str, dict[str, T.DataType]] = {}
         self._data: dict[str, dict[str, np.ndarray]] = {}
         self._valid: dict[str, dict[str, np.ndarray | None]] = {}
+        # monotonic per-table write counters backing table_version();
+        # bumped by every mutation INCLUDING drop (a re-created table
+        # must not resurrect cached results for its predecessor)
+        self._versions: dict[str, int] = {}
+
+    def _bump(self, name: str) -> None:
+        self._versions[name] = self._versions.get(name, 0) + 1
+
+    def table_version(self, name: str) -> int | None:
+        return self._versions.get(name, 0)
 
     def create_table(
         self, name: str, schema: Mapping[str, T.DataType],
@@ -39,6 +49,7 @@ class MemoryConnector(Connector):
             for c, v in data.items()}
         self._valid[name] = {c: (None if valid is None else valid.get(c))
                              for c in schema}
+        self._bump(name)
 
     def insert(self, name: str, data: Mapping[str, np.ndarray],
                valid: Mapping[str, np.ndarray | None] | None = None) -> None:
@@ -56,6 +67,7 @@ class MemoryConnector(Connector):
                     new_valid = np.ones(len(new), dtype=bool)
                 self._valid[name][c] = np.concatenate(
                     [old_valid, new_valid])
+        self._bump(name)
 
     def delete_rows(self, name: str, mask) -> int:
         n = len(next(iter(self._data[name].values()), []))
@@ -67,6 +79,7 @@ class MemoryConnector(Connector):
             v = self._valid[name].get(c)
             if v is not None:
                 self._valid[name][c] = v[keep]
+        self._bump(name)
         return int(mask.sum())
 
     def update_rows(self, name: str, values, valids, mask) -> int:
@@ -86,6 +99,7 @@ class MemoryConnector(Connector):
                 new_v = nv if nv is not None else np.ones(n, dtype=bool)
                 old_v[m] = np.asarray(new_v)[m]
                 self._valid[name][c] = old_v
+        self._bump(name)
         return int(m.sum())
 
     def snapshot(self):
@@ -102,14 +116,20 @@ class MemoryConnector(Connector):
 
     def restore(self, snap) -> None:
         schemas, data, valid = snap
+        touched = set(self._schemas) | set(schemas)
         self._schemas = {t: dict(cols) for t, cols in schemas.items()}
         self._data = {t: dict(cols) for t, cols in data.items()}
         self._valid = {t: dict(cols) for t, cols in valid.items()}
+        # counters stay monotonic across rollback: restored contents
+        # differ from the post-write state, so the version must move
+        for t in touched:
+            self._bump(t)
 
     def drop_table(self, name: str) -> None:
         self._schemas.pop(name, None)
         self._data.pop(name, None)
         self._valid.pop(name, None)
+        self._bump(name)
 
     def table_names(self) -> list[str]:
         return list(self._schemas)

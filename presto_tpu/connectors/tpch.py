@@ -472,6 +472,11 @@ class TpchConnector(Connector):
     def table_schema(self, name: str):
         return SCHEMAS[name]
 
+    def table_version(self, name: str) -> int | None:
+        # generated data is immutable for the connector's lifetime:
+        # one constant version makes every tpch scan result-cacheable
+        return 0
+
     def _raw(self, name: str) -> dict[str, np.ndarray]:
         if name not in self._cache:
             loaded = self._disk_load(name)

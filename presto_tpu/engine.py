@@ -82,6 +82,13 @@ class Engine:
         # size its memory reservation; the execution path on the same
         # thread reuses that plan instead of planning twice
         self._preplanned_tl = _threading.local()
+        # data-change listeners: the serving layer's result cache
+        # registers here so DML actively purges entries built on the
+        # pre-write table versions (connector SPI table_version keys
+        # make stale hits impossible even without the purge; the
+        # listener keeps the cache small and the invalidation counter
+        # honest)
+        self._invalidation_listeners: list = []
         # query lifecycle events + history (events.py)
         self.events = EventListenerManager()
         # persisted query history + divergence-ledger persistence
@@ -107,6 +114,11 @@ class Engine:
 
     def register_catalog(self, name: str, connector: Connector) -> None:
         self.catalogs[name] = connector
+
+    def add_invalidation_listener(self, fn) -> None:
+        """``fn()`` runs after every statement that may change table
+        data (the same set that invalidates the device cache)."""
+        self._invalidation_listeners.append(fn)
 
     @property
     def last_warnings(self) -> list:
@@ -356,6 +368,8 @@ class Engine:
                                  A.DropTable, A.CommitStatement,
                                  A.RollbackStatement)):
                 self.invalidate_device_cache()
+                for fn in list(self._invalidation_listeners):
+                    fn()
 
     def invalidate_device_cache(self) -> None:
         with self._dev_cache_lock:

@@ -25,8 +25,9 @@ import threading
 _NAME_RE = re.compile(r"^presto_tpu_[a-z0-9_]+$")
 
 # unit suffixes accepted on histogram names (Prometheus base units;
-# _ratio is the dimensionless unit — e.g. actual/estimated rows)
-HISTOGRAM_UNITS = ("_seconds", "_bytes", "_rows", "_ratio")
+# _ratio is the dimensionless unit — e.g. actual/estimated rows,
+# _queries counts whole queries — e.g. cross-query batch sizes)
+HISTOGRAM_UNITS = ("_seconds", "_bytes", "_rows", "_ratio", "_queries")
 
 DEFAULT_BUCKETS = (0.001, 0.005, 0.025, 0.1, 0.25, 1.0, 2.5, 10.0,
                    30.0, 120.0)

@@ -292,6 +292,32 @@ class ClusterCoordinator:
     def add_worker(self, uri: str) -> None:
         self.workers.append(RemoteWorker(uri))
 
+    def join_worker(self, uri: str) -> RemoteWorker:
+        """Elastic scale-out: admit a worker into a RUNNING cluster
+        (the JOIN counterpart to the worker-side drain). The node
+        enters in the ``joining`` lifecycle state — visible in
+        system.nodes and /v1/cluster but not schedulable — and becomes
+        eligible for dispatch when its first heartbeat reads an
+        ``active`` /v1/status, at most one detector interval later.
+        live_workers() is consulted per stage dispatch, so rebalancing
+        onto the newcomer needs no further plumbing. Idempotent by
+        uri: re-announcing a registered worker returns the existing
+        handle (its failure ratio recovers through ordinary pings) —
+        and REVIVES it through ``joining`` if it had drained or died,
+        which is exactly how an autoscaler returns capacity it
+        previously drained away."""
+        for w in self.workers:
+            if w.uri == uri:
+                if w.state != "active":
+                    w.state = "joining"
+                return w
+        w = RemoteWorker(uri)
+        # pre-publication write: the detector and scheduler only see
+        # the worker after the append below
+        w.state = "joining"
+        self.workers.append(w)
+        return w
+
     def start(self) -> "ClusterCoordinator":
         self.detector.start()
         return self

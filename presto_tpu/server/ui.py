@@ -29,7 +29,8 @@ td,th{border:1px solid #333;padding:.35em .6em;text-align:left}
 th{background:#1c2733} .st-RUNNING{color:#6cf} .st-FINISHED{color:#6f6}
 .st-FAILED{color:#f66} .st-QUEUED{color:#fc6} .st-CANCELED{color:#999}
 .st-alive{color:#6f6} .st-draining,.st-drained{color:#fc6}
-.st-dead{color:#f66}
+.st-dead{color:#f66} .st-joining{color:#6cf}
+.serving{color:#6f6;font-size:.8em;margin-left:.6em}
 .cards{display:flex;gap:1em} .card{background:#1c2733;padding:.8em
 1.2em;border-radius:6px;min-width:7em}
 .card b{font-size:1.6em;display:block}
@@ -118,10 +119,13 @@ if(!info||!info.queryId)return;
 const st=(info.stats&&info.stats.progress!=null)?info.stats.progress
 :(info.queryStats||{{}}).progress;
 const prof=(info.queryStats||{{}}).profile;
+const mark=info.cacheHit?'result-cache hit'
+:info.batched>1?`batched &times;${{info.batched}}`
+:info.deduped?'deduped':'';
 document.getElementById('head').innerHTML=
 `<div class="cards">
 <div class="card"><b class="st-${{info.state}}">${{info.state}}</b>
-state</div>
+state${{mark?`<span class="serving">${{mark}}</span>`:''}}</div>
 <div class="card"><b>${{bar(st)}}</b>progress</div>
 <div class="card"><b>${{(info.stats||{{}}).elapsedTimeMillis||0}}</b>
 elapsed ms</div>

@@ -308,6 +308,33 @@ SYSTEM_SESSION_PROPERTIES: dict[str, tuple[Any, type, str]] = {
                        "PRESTO_TPU_PROFILE_DIR; the artifact directory "
                        "is stamped into the query's history record and "
                        "surfaced in the Web UI"),
+    # -- tenant-scale serving (server/serving.py, exec/batch.py) --------
+    # Host-side serving-layer properties: consulted by the HTTP
+    # dispatcher BEFORE execution starts, never at trace time, so all
+    # three stay OUT of TRACE_RELEVANT_PROPERTIES (the batch axis that
+    # batching adds to a program is keyed explicitly by the executor,
+    # not through these toggles).
+    "result_cache": (True, bool,
+                     "serve-mode result-set cache keyed on (plan "
+                     "fingerprint x connector table versions): an "
+                     "identical re-issued SELECT whose input tables "
+                     "are unchanged replays the cached result pages "
+                     "through the protocol layer without executing. "
+                     "Tables whose connector reports no version "
+                     "(table_version None) are never cached, and DML "
+                     "actively purges stale entries"),
+    "subplan_dedup": (True, bool,
+                      "serve-mode in-flight dedup: concurrent queries "
+                      "whose optimized plans share a fingerprint (and "
+                      "table versions) await one leader execution "
+                      "instead of racing duplicate device dispatches"),
+    "batch_window_ms": (0.0, float,
+                        "serve-mode cross-query batching window in "
+                        "milliseconds: queries landing on the SAME "
+                        "plan template within the window stack their "
+                        "parameter vectors into one vmapped device "
+                        "dispatch, demuxed per query afterwards "
+                        "(0 disables batching)"),
 }
 
 

@@ -320,6 +320,74 @@ def test_system_nodes_reflects_live_cluster(stats_cluster):
             pass
 
 
+def test_worker_join_scale_out(stats_cluster, tpch_tiny):
+    """Elastic membership, the drain test's mirror image: a new worker
+    announced through PUT /v1/node enters ``joining`` (visible in
+    system.nodes and /v1/cluster), flips to ``active`` on its first
+    heartbeat, and the scheduler rebalances the next query onto it."""
+    srv, coord, workers, engine, _hist = stats_cluster
+    base = f"http://127.0.0.1:{srv.port}"
+    sql = ("select l_returnflag, count(*) as c from lineitem "
+           "group by l_returnflag order by l_returnflag")
+    want = engine.execute(sql)
+    assert coord.execute(sql) == want
+    assert coord.last_distribution["nshards"] == len(workers)
+
+    w3 = WorkerServer({"tpch": tpch_tiny}, node_id="statw2").start()
+    try:
+        req = urllib.request.Request(
+            f"{base}/v1/node", method="PUT",
+            data=json.dumps({"uri": w3.uri}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            out = json.loads(resp.read())
+        # the announcement itself lands in the joining state — the
+        # node is published but not yet schedulable
+        assert out == {"uri": w3.uri, "state": "joining",
+                       "workers": len(workers) + 1}
+        nodes = {r[0]: r[1] for r in engine.execute(
+            "select node_id, state from system.nodes")}
+        joined = nodes.get("statw2", nodes.get(w3.uri))
+        assert joined in ("joining", "active")
+
+        # first heartbeat reads the worker's active /v1/status and
+        # promotes it; /v1/cluster tracks the same lifecycle
+        deadline = time.time() + 10
+        state = None
+        while time.time() < deadline:
+            with urllib.request.urlopen(f"{base}/v1/cluster",
+                                        timeout=10) as resp:
+                view = json.loads(resp.read())
+            state = next((w["state"] for w in view["workers"]
+                          if w["uri"] == w3.uri), None)
+            if state == "active":
+                break
+            time.sleep(0.1)
+        assert state == "active"
+
+        # the scheduler consults live_workers() per dispatch: the very
+        # next query fans out across the grown cluster, same rows
+        assert coord.execute(sql) == want
+        assert coord.last_distribution["nshards"] == len(workers) + 1
+
+        # re-announcing an already-active member is a no-op
+        with urllib.request.urlopen(urllib.request.Request(
+                f"{base}/v1/node", method="PUT",
+                data=json.dumps({"uri": w3.uri}).encode(),
+                headers={"Content-Type": "application/json"}),
+                timeout=10) as resp:
+            again = json.loads(resp.read())
+        assert again["workers"] == len(workers) + 1
+    finally:
+        # restore the module fixture's 2-worker shape for later tests
+        coord.workers[:] = [w for w in coord.workers
+                            if w.uri != w3.uri]
+        try:
+            w3.stop()
+        except Exception:  # noqa: BLE001
+            pass
+
+
 def test_process_gauges_on_both_roles(stats_cluster):
     """Coordinator and worker /metrics carry the /proc/self process
     gauges."""
